@@ -1,0 +1,22 @@
+"""Pytest root conftest: force an 8-virtual-device CPU mesh for all tests.
+
+This is the TPU-world upgrade of the reference's test affordances
+(SURVEY.md §4: injectable telemetry, mock fleet, dry-run): real mesh/pjit/
+FSDP semantics on one host, no TPU required.
+
+Note: the environment may import jax at interpreter startup (sitecustomize)
+with a TPU platform preset, so ``JAX_PLATFORMS`` env alone is too late —
+``jax.config.update`` is authoritative. ``XLA_FLAGS`` is still honoured
+because the CPU client is created lazily, at first device query.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

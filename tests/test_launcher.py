@@ -1,0 +1,75 @@
+"""Launcher: plan generation, dry-run, real in-process launch, registry."""
+
+import jax
+
+from tpu_engine.launcher import TPULauncher
+from tpu_engine.mesh_runtime import MeshConfig
+from tpu_engine.sharding import Precision, ShardingStage, TPUTrainConfig
+from tpu_engine.supervisor import JobStatus
+
+
+def tiny_config(**kw):
+    base = dict(
+        model_name="gpt-tiny",
+        sharding_stage=ShardingStage.FULL_PARTITIONING,
+        mesh=MeshConfig(data=2, fsdp=4),
+        micro_batch_size=1,
+        gradient_accumulation_steps=1,
+        seq_len=32,
+        precision=Precision.FP32,
+        total_steps=5,
+        activation_checkpointing=False,
+    )
+    base.update(kw)
+    return TPUTrainConfig(**base)
+
+
+def test_generate_plan_contents():
+    plan = TPULauncher().generate_plan(tiny_config())
+    assert plan["mesh"]["shape"] == {"data": 2, "fsdp": 4, "sequence": 1, "model": 1}
+    assert plan["sharding"]["stage"] == 3
+    assert plan["sharding"]["semantics"]["params"] == "sharded over fsdp"
+    assert plan["batch"]["effective_batch_size"] == 8
+    assert plan["optimizer"]["name"] == "adamw"
+    assert plan["precision"]["loss_scaling"].startswith("none")
+    rep = plan["sharding"]["representative_tensors"]
+    assert "fsdp" in rep["attention_qkv [embed, heads]"]["params"]
+
+
+def test_plan_stage_semantics_change_with_stage():
+    plan1 = TPULauncher().generate_plan(tiny_config(sharding_stage=ShardingStage.OPTIMIZER_STATE))
+    sem = plan1["sharding"]["semantics"]
+    assert sem["params"] == "replicated"
+    assert sem["gradients"] == "all-reduced"
+    assert sem["optimizer_state"] == "sharded over fsdp"
+
+
+def test_dry_run_does_not_start_a_job():
+    launcher = TPULauncher()
+    res = launcher.launch(tiny_config(), dry_run=True)
+    assert res.status == "dry_run"
+    assert res.plan and res.job_id.startswith("tpu_gpt-tiny_")
+    assert launcher.list_jobs() == []
+
+
+def test_unknown_model_fails_cleanly():
+    res = TPULauncher().launch(tiny_config(model_name="nope-9b"), dry_run=False)
+    assert res.status == "failed"
+    assert "unknown model" in res.error
+
+
+def test_real_launch_runs_to_completion():
+    launcher = TPULauncher()
+    res = launcher.launch(tiny_config(total_steps=4), dry_run=False, block=True)
+    assert res.status == "launched"
+    job = launcher.get_job(res.job_id)
+    assert job is not None
+    assert job.status == JobStatus.COMPLETED, job.error
+    assert job.current_step == 4
+    jobs = launcher.list_jobs()
+    assert len(jobs) == 1 and jobs[0]["job_id"] == res.job_id
+
+
+def test_presets_exposed():
+    p = TPULauncher.presets()
+    assert {"125m", "7b", "13b", "70b"} <= set(p)

@@ -1,0 +1,131 @@
+"""Loss monitor: all five detectors, cooldown, caps, reset, curve feed."""
+
+import math
+
+from tpu_engine.loss_monitor import (
+    AlertSeverity,
+    LossSpikeMonitor,
+    MonitorConfig,
+    TrainingMetrics,
+)
+
+
+def m(step, loss, lr=None, gnorm=None):
+    return TrainingMetrics(step=step, loss=loss, learning_rate=lr, gradient_norm=gnorm)
+
+
+def feed_flat(mon, n, loss=2.0, start=0):
+    for i in range(start, start + n):
+        mon.ingest(m(i, loss + 0.001 * (i % 3)))
+
+
+def test_nan_divergence_early_return_keeps_window_clean():
+    mon = LossSpikeMonitor("j")
+    feed_flat(mon, 20)
+    alerts = mon.ingest(m(20, float("nan")))
+    assert len(alerts) == 1
+    assert alerts[0].alert_type == "divergence"
+    assert alerts[0].severity == AlertSeverity.CRITICAL
+    # NaN never entered the rolling window (reference append-after-check semantics).
+    assert not math.isnan(mon.get_summary()["rolling_mean_loss"])
+
+
+def test_inf_and_threshold_divergence():
+    mon = LossSpikeMonitor("j")
+    assert mon.ingest(m(0, float("inf")))[0].alert_type == "divergence"
+    mon2 = LossSpikeMonitor("j2")
+    alerts = mon2.ingest(m(0, 2e6))
+    assert alerts and alerts[0].alert_type == "divergence"
+
+
+def test_spike_detection_with_sigma_levels():
+    # Window alternating 1.9/2.1 → mean 2.0, σ 0.1 → 3σ thr 2.3, 5σ thr 2.5.
+    mon = LossSpikeMonitor("j")
+    for i in range(30):
+        mon.ingest(m(i, 1.9 if i % 2 else 2.1))
+    warn = mon.ingest(m(30, 2.4))  # between 3σ and 5σ → WARNING
+    assert any(a.alert_type == "loss_spike" and a.severity == AlertSeverity.WARNING
+               for a in warn)
+    crit = mon.ingest(m(55, 3.0))  # past cooldown, above 5σ → CRITICAL
+    assert any(a.alert_type == "loss_spike" and a.severity == AlertSeverity.CRITICAL
+               for a in crit)
+
+
+def test_spike_needs_min_history():
+    mon = LossSpikeMonitor("j")
+    feed_flat(mon, 5)
+    assert mon.ingest(m(5, 100.0)) == []  # < min_history_for_spike and < divergence
+
+
+def test_spike_cooldown():
+    cfg = MonitorConfig(alert_cooldown_steps=20)
+    mon = LossSpikeMonitor("j", cfg)
+    feed_flat(mon, 30)
+    a1 = mon.ingest(m(30, 50.0))
+    assert a1
+    a2 = mon.ingest(m(31, 60.0))  # within cooldown
+    assert not any(x.alert_type == "loss_spike" for x in a2)
+    a3 = mon.ingest(m(55, 60.0))  # past cooldown
+    assert any(x.alert_type == "loss_spike" for x in a3)
+
+
+def test_plateau_detection():
+    cfg = MonitorConfig(plateau_patience_steps=50)
+    mon = LossSpikeMonitor("j", cfg)
+    mon.ingest(m(0, 1.0))
+    for i in range(1, 60):
+        alerts = mon.ingest(m(i, 1.0))  # never improves
+    assert any(a.alert_type == "plateau" for a in mon.alerts)
+
+
+def test_gradient_explosion():
+    mon = LossSpikeMonitor("j")
+    alerts = mon.ingest(m(0, 2.0, gnorm=150.0))
+    assert any(a.alert_type == "gradient_explosion"
+               and a.severity == AlertSeverity.CRITICAL for a in alerts)
+
+
+def test_lr_anomaly():
+    mon = LossSpikeMonitor("j")
+    for i in range(6):
+        mon.ingest(m(i, 2.0, lr=1e-4))
+    alerts = mon.ingest(m(6, 2.0, lr=5e-3))  # 50× rolling average
+    assert any(a.alert_type == "lr_anomaly" for a in alerts)
+
+
+def test_max_alerts_per_type_enforced():
+    cfg = MonitorConfig(max_alerts_per_type=2, alert_cooldown_steps=0)
+    mon = LossSpikeMonitor("j", cfg)
+    for i in range(5):
+        mon.ingest(m(i, 2e6))  # divergence every step
+    assert mon.get_summary()["alerts_by_type"]["divergence"] == 2
+
+
+def test_bounded_history():
+    cfg = MonitorConfig(max_history=100)
+    mon = LossSpikeMonitor("j", cfg)
+    feed_flat(mon, 500)
+    assert mon.get_summary()["total_steps_seen"] == 100  # bounded, no leak
+
+
+def test_summary_and_curve():
+    mon = LossSpikeMonitor("job-1")
+    for i in range(20):
+        mon.ingest(m(i, 3.0 - 0.1 * i, lr=1e-4, gnorm=1.0))
+    s = mon.get_summary()
+    assert s["job_id"] == "job-1"
+    assert s["best_loss"] == min(3.0 - 0.1 * i for i in range(20))
+    curve = mon.get_loss_curve()
+    assert len(curve["steps"]) == 20
+    assert len(curve["losses"]) == 20
+    assert curve["learning_rates"][0] == 1e-4
+
+
+def test_reset():
+    mon = LossSpikeMonitor("j")
+    feed_flat(mon, 30)
+    mon.ingest(m(31, 1e7))
+    mon.reset()
+    s = mon.get_summary()
+    assert s["total_steps_seen"] == 0 and s["total_alerts"] == 0
+    assert s["best_loss"] is None

@@ -1,0 +1,63 @@
+"""Mesh runtime: construction, shape resolution, topology introspection."""
+
+import jax
+import pytest
+
+from tpu_engine.mesh_runtime import MeshConfig, MeshRuntime, build_mesh, detect_topology
+
+
+def test_eight_virtual_devices():
+    assert jax.device_count() == 8
+
+
+def test_default_mesh_absorbs_all_devices():
+    mesh = build_mesh()
+    assert mesh.axis_names == ("data", "fsdp", "sequence", "model")
+    assert mesh.devices.shape == (8, 1, 1, 1)
+
+
+@pytest.mark.parametrize(
+    "cfg,expected",
+    [
+        (MeshConfig(fsdp=8), (1, 8, 1, 1)),
+        (MeshConfig(fsdp=4), (2, 4, 1, 1)),
+        (MeshConfig(model=2, fsdp=2), (2, 2, 1, 2)),
+        (MeshConfig(sequence=4), (2, 1, 4, 1)),
+        (MeshConfig(data=8), (8, 1, 1, 1)),
+    ],
+)
+def test_mesh_shape_resolution(cfg, expected):
+    assert cfg.resolved_shape(8) == expected
+    assert build_mesh(cfg).devices.shape == expected
+
+
+def test_mesh_shape_errors():
+    with pytest.raises(ValueError):
+        MeshConfig(fsdp=3).resolved_shape(8)  # 3 does not divide 8
+    with pytest.raises(ValueError):
+        MeshConfig(data=4, fsdp=4).resolved_shape(8)  # 16 != 8
+
+
+def test_runtime_shardings_and_sizes():
+    rt = MeshRuntime(MeshConfig(fsdp=4))
+    assert rt.axis_sizes == {"data": 2, "fsdp": 4, "sequence": 1, "model": 1}
+    assert rt.data_parallel_size() == 8
+    assert rt.n_devices == 8
+    sh = rt.batch_sharding()
+    assert sh.spec[0] == ("data", "fsdp")
+
+
+def test_topology_report_is_real():
+    rt = MeshRuntime()
+    report = rt.topology_report()
+    assert report["num_devices"] == 8
+    assert len(report["devices"]) == 8
+    assert report["mesh"]["axes"] == {"data": 8, "fsdp": 1, "sequence": 1, "model": 1}
+    ids = {d["id"] for d in report["devices"]}
+    assert len(ids) == 8  # real device ids, not a canned matrix
+
+
+def test_detect_topology_standalone():
+    t = detect_topology()
+    assert t["num_devices"] == 8
+    assert t["num_processes"] == 1

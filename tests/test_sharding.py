@@ -1,0 +1,89 @@
+"""Sharding stages: ZeRO semantics → PartitionSpecs, configs, presets."""
+
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpu_engine.mesh_runtime import MeshConfig
+from tpu_engine.models import transformer as tfm
+from tpu_engine.sharding import (
+    ShardingStage,
+    TPUTrainConfig,
+    grad_pspecs,
+    logical_to_mesh_axes,
+    opt_state_pspecs,
+    param_pspecs,
+    presets,
+)
+
+LG_2D = ("embed", "heads")  # e.g. an attention projection
+
+
+def test_tp_axes_always_sharded():
+    for fsdp in (False, True):
+        spec = logical_to_mesh_axes(LG_2D, shard_fsdp=fsdp)
+        assert spec[-1] == "model" or (len(spec) > 1 and spec[1] == "model")
+
+
+def test_stage_semantics_on_representative_param():
+    logical = {"w": LG_2D}
+    # Stage 0: params/grads/opt all replicated on fsdp (TP still applies).
+    assert param_pspecs(logical, ShardingStage.DISABLED)["w"] == P(None, "model")
+    assert grad_pspecs(logical, ShardingStage.DISABLED)["w"] == P(None, "model")
+    assert opt_state_pspecs(logical, ShardingStage.DISABLED)["w"] == P(None, "model")
+    # Stage 1: only optimizer state is fsdp-sharded.
+    assert param_pspecs(logical, ShardingStage.OPTIMIZER_STATE)["w"] == P(None, "model")
+    assert grad_pspecs(logical, ShardingStage.OPTIMIZER_STATE)["w"] == P(None, "model")
+    assert opt_state_pspecs(logical, ShardingStage.OPTIMIZER_STATE)["w"] == P("fsdp", "model")
+    # Stage 2: + gradients reduce-scattered.
+    assert grad_pspecs(logical, ShardingStage.GRADIENT_PARTITIONING)["w"] == P("fsdp", "model")
+    assert param_pspecs(logical, ShardingStage.GRADIENT_PARTITIONING)["w"] == P(None, "model")
+    # Stage 3: full FSDP.
+    assert param_pspecs(logical, ShardingStage.FULL_PARTITIONING)["w"] == P("fsdp", "model")
+
+
+def test_norm_scales_replicate_without_fsdp():
+    spec = logical_to_mesh_axes(("embed",), shard_fsdp=False)
+    assert spec == P()
+
+
+def test_model_logical_tree_matches_param_tree():
+    import jax
+
+    cfg = tfm.MODEL_CONFIGS["gpt-tiny"]
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    logical = tfm.logical_axes(cfg)
+    jax.tree.map(
+        lambda p, lg: None if len(p.shape) == len(lg) else pytest.fail(f"{p.shape} vs {lg}"),
+        params,
+        logical,
+        is_leaf=lambda x: isinstance(x, tuple) and all(isinstance(s, (str, type(None))) for s in x),
+    )
+
+
+def test_effective_batch_math():
+    cfg = TPUTrainConfig(
+        micro_batch_size=2,
+        gradient_accumulation_steps=16,
+        mesh=MeshConfig(data=1, fsdp=4),
+    )
+    # micro × accum × dp-world — reference deepspeed_launcher.py:323-328.
+    assert cfg.effective_batch_size == 2 * 16 * 4
+    # data=-1 resolves against the visible 8-device mesh (data=8, fsdp=1).
+    inferred = TPUTrainConfig(micro_batch_size=8, gradient_accumulation_steps=1)
+    assert inferred.effective_batch_size == 8 * 8
+
+
+def test_presets_cover_reference_scales():
+    p = presets()
+    assert {"125m", "7b", "13b", "70b"} <= set(p)
+    assert p["7b"].micro_batch_size == 2 and p["7b"].gradient_accumulation_steps == 16
+    assert p["13b"].micro_batch_size == 1 and p["13b"].gradient_accumulation_steps == 32
+    assert p["70b"].micro_batch_size == 1 and p["70b"].gradient_accumulation_steps == 64
+    assert all(c.sharding_stage == ShardingStage.FULL_PARTITIONING
+               for n, c in p.items() if n != "125m")
+
+
+def test_param_count_roughly_right():
+    assert 120e6 < tfm.param_count(tfm.MODEL_CONFIGS["gpt-125m"]) < 180e6
+    assert 6.0e9 < tfm.param_count(tfm.MODEL_CONFIGS["llama-7b"]) < 7.5e9
+    assert 60e9 < tfm.param_count(tfm.MODEL_CONFIGS["llama-70b"]) < 75e9

@@ -1,0 +1,111 @@
+"""Train program: loss decreases, stages change placement, accumulation works."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_engine.mesh_runtime import MeshConfig, MeshRuntime
+from tpu_engine.models import transformer as tfm
+from tpu_engine.sharding import Precision, ShardingStage, TPUTrainConfig
+from tpu_engine.train import build_train_program
+
+
+def tiny_config(**kw) -> TPUTrainConfig:
+    base = dict(
+        model_name="gpt-tiny",
+        sharding_stage=ShardingStage.FULL_PARTITIONING,
+        mesh=MeshConfig(data=2, fsdp=4),
+        micro_batch_size=1,
+        gradient_accumulation_steps=2,
+        seq_len=32,
+        precision=Precision.FP32,  # CPU test backend: bf16 is slow & noisy there
+        learning_rate=1e-2,
+        warmup_steps=2,
+        total_steps=100,
+        activation_checkpointing=True,
+    )
+    base.update(kw)
+    return TPUTrainConfig(**base)
+
+
+def run_steps(cfg, n=8, seed=0):
+    prog = build_train_program(cfg)
+    state = prog.init(jax.random.PRNGKey(cfg.seed))
+    losses = []
+    for i in range(n):
+        batch = prog.synthetic_batch(seed)  # fixed batch → loss must drop fast
+        state, metrics = prog.step(state, batch)
+        losses.append(float(metrics["loss"]))
+    return prog, state, losses
+
+
+def test_loss_decreases_stage3():
+    _, _, losses = run_steps(tiny_config(), n=10)
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_param_placement_per_stage():
+    cfg3 = tiny_config()
+    prog3, state3, _ = run_steps(cfg3, n=1)
+    q_sh = state3["params"]["layers"]["q"]["kernel"].sharding
+    # logical (layers, embed, heads) → (None, fsdp, model-axis-for-TP)
+    assert q_sh.spec == jax.sharding.PartitionSpec(None, "fsdp", "model")
+
+    cfg1 = tiny_config(sharding_stage=ShardingStage.OPTIMIZER_STATE)
+    prog1 = build_train_program(cfg1)
+    state1 = prog1.init(jax.random.PRNGKey(0))
+    # Params NOT fsdp-sharded at stage 1...
+    p_sh = state1["params"]["layers"]["q"]["kernel"].sharding
+    assert p_sh.spec == jax.sharding.PartitionSpec(None, None, "model")
+    # ...but adam mu for the same param is fsdp-sharded (ZeRO-1).
+    mu = state1["opt_state"][1].mu["layers"]["q"]["kernel"]
+    assert mu.sharding.spec == jax.sharding.PartitionSpec(None, "fsdp", "model")
+
+
+def test_stage0_and_stage3_agree():
+    # Same seed + same data → numerically equivalent training trajectories.
+    _, _, l0 = run_steps(tiny_config(sharding_stage=ShardingStage.DISABLED), n=3)
+    _, _, l3 = run_steps(tiny_config(sharding_stage=ShardingStage.FULL_PARTITIONING), n=3)
+    np.testing.assert_allclose(l0, l3, rtol=1e-3)
+
+
+def test_gradient_accumulation_shapes():
+    cfg = tiny_config(gradient_accumulation_steps=4)
+    prog = build_train_program(cfg)
+    assert prog.global_batch_shape() == (4, 1 * 8, 32)
+    batch = prog.synthetic_batch(0)
+    assert batch.shape == (4, 8, 32)
+
+
+def test_lr_schedule_and_metrics():
+    cfg = tiny_config(warmup_steps=5, learning_rate=1e-2)
+    prog = build_train_program(cfg)
+    state = prog.init(jax.random.PRNGKey(0))
+    lrs = []
+    for i in range(6):
+        state, m = prog.step(state, prog.synthetic_batch(i))
+        lrs.append(float(m["learning_rate"]))
+        assert float(m["grad_norm"]) > 0
+    assert lrs[0] < lrs[4]  # warmup ramps
+    assert int(jax.device_get(state["step"])) == 6
+
+
+def test_tensor_parallel_mesh_runs():
+    cfg = tiny_config(mesh=MeshConfig(data=2, fsdp=2, model=2))
+    _, state, losses = run_steps(cfg, n=3)
+    q = state["params"]["layers"]["q"]["kernel"]
+    assert q.sharding.spec == jax.sharding.PartitionSpec(None, "fsdp", "model")
+    # Actually split over 2 fsdp × 2 model devices.
+    assert q.addressable_shards[0].data.shape[1] == q.shape[1] // 2
+    assert q.addressable_shards[0].data.shape[2] == q.shape[2] // 2
+    assert losses[-1] < losses[0]
+
+
+def test_forward_shapes_and_dtype():
+    cfg = tfm.MODEL_CONFIGS["gpt-tiny"]
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits = tfm.forward(params, tokens, cfg, compute_dtype=jnp.float32)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32

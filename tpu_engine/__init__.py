@@ -1,0 +1,69 @@
+"""tpu_engine — TPU-native distributed LLM training engine.
+
+A ground-up JAX/XLA re-design of the capability surface of
+``webspoilt/distributed-llm-training-gpu-manager`` (the reference's public
+API is re-exported from ``ai_engine/__init__.py:9-17``): fleet telemetry,
+distributed-training launch with ZeRO-style sharding stages, loss-spike
+monitoring, and spot/preemption resiliency — built TPU-first:
+
+- training is **in-process** (pjit/jit over a ``jax.sharding.Mesh``), not a
+  subprocess launch of an external engine;
+- device telemetry comes from the JAX runtime / libtpu, not an
+  ``nvidia-smi`` subprocess parse;
+- ZeRO stages map to real sharding layouts (NamedSharding partition specs)
+  whose collectives XLA emits over ICI/DCN;
+- checkpoint/rollback/auto-resume are implemented for real (Orbax), not
+  README promises.
+"""
+
+from tpu_engine.mesh_runtime import (
+    MeshConfig,
+    MeshRuntime,
+    build_mesh,
+    detect_topology,
+)
+from tpu_engine.tpu_manager import (
+    TPUDevice,
+    TPUFleetStatus,
+    TPUHealthStatus,
+    TPUManager,
+)
+from tpu_engine.sharding import (
+    ShardingStage,
+    OffloadDevice,
+    TPUTrainConfig,
+)
+from tpu_engine.launcher import (
+    LaunchResult,
+    TPULauncher,
+)
+from tpu_engine.loss_monitor import (
+    AlertSeverity,
+    LossSpikeMonitor,
+    MonitorConfig,
+    SpikeAlert,
+    TrainingMetrics,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "MeshConfig",
+    "MeshRuntime",
+    "build_mesh",
+    "detect_topology",
+    "TPUDevice",
+    "TPUFleetStatus",
+    "TPUHealthStatus",
+    "TPUManager",
+    "ShardingStage",
+    "OffloadDevice",
+    "TPUTrainConfig",
+    "LaunchResult",
+    "TPULauncher",
+    "AlertSeverity",
+    "LossSpikeMonitor",
+    "MonitorConfig",
+    "SpikeAlert",
+    "TrainingMetrics",
+]

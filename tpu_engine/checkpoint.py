@@ -1,0 +1,189 @@
+"""Checkpoint / rollback / auto-resume — implemented for real.
+
+The reference *advertises* "Auto-Resume Capabilities: identifies corrupt
+checkpoints and automatically rolls back to the prior stable state"
+(``README.md:14``) but ships no checkpoint code at all (SURVEY.md §5
+checkpoint/resume). This module is the real mechanism, TPU-native:
+
+- async Orbax ``CheckpointManager`` (GCS-ready paths, ``max_to_keep``,
+  reference config analogue ``deepspeed_launcher.py:74,192``);
+- a **stable-checkpoint pointer**: steps are marked stable only after the
+  loss monitor has seen a healthy window beyond them, so divergence
+  rollback (``loss_monitor.py:131-136`` remediation, mechanised in
+  ``tpu_engine/supervisor.py``) restores a checkpoint from *before* the
+  anomaly, not the one that captured it;
+- validation-on-restore: a checkpoint that fails to load is quarantined and
+  the next older one is tried (the advertised corrupt-checkpoint rollback);
+- a fast synchronous ``save(force=True, wait=True)`` path for the SIGTERM /
+  preemption window (``tpu_engine/preemption.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+_STABLE_POINTER = "stable.json"
+
+
+class TrainCheckpointManager:
+    """Orbax-backed checkpoints with a stable pointer and quarantine-on-corrupt."""
+
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: int = 3,
+        save_interval_steps: int = 1,
+        async_save: bool = True,
+    ):
+        self.directory = os.path.abspath(os.path.expanduser(directory))
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                enable_async_checkpointing=async_save,
+                create=True,
+            ),
+        )
+        self._lock = threading.Lock()
+        self._quarantined: set[int] = set()
+
+    # -- save ----------------------------------------------------------------
+
+    def save(
+        self,
+        step: int,
+        state: Any,
+        metrics: Optional[dict[str, float]] = None,
+        force: bool = False,
+        wait: bool = False,
+    ) -> bool:
+        """Async save (sync when ``wait=True`` — the preemption path)."""
+        with self._lock:
+            try:
+                saved = self._mgr.save(
+                    step,
+                    args=ocp.args.StandardSave(state),
+                    metrics=metrics,
+                    force=force,
+                )
+            except ocp.checkpoint_manager.StepAlreadyExistsError:
+                saved = False
+            if wait:
+                self._mgr.wait_until_finished()
+            return bool(saved)
+
+    def wait_until_finished(self) -> None:
+        self._mgr.wait_until_finished()
+
+    # -- stable pointer ------------------------------------------------------
+
+    def _stable_path(self) -> str:
+        return os.path.join(self.directory, _STABLE_POINTER)
+
+    def mark_stable(self, step: int) -> None:
+        """Record ``step`` as the newest known-good checkpoint."""
+        tmp = self._stable_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"step": int(step), "timestamp": time.time()}, f)
+        os.replace(tmp, self._stable_path())
+
+    def last_stable_step(self) -> Optional[int]:
+        try:
+            with open(self._stable_path()) as f:
+                step = int(json.load(f)["step"])
+        except (OSError, ValueError, KeyError, json.JSONDecodeError):
+            return None
+        return step if step in self.all_steps() else None
+
+    # -- introspection -------------------------------------------------------
+
+    def all_steps(self) -> list[int]:
+        return sorted(s for s in self._mgr.all_steps() if s not in self._quarantined)
+
+    def delete_after(self, step: int) -> None:
+        """Delete checkpoints newer than ``step``.
+
+        Used after a rollback: the replayed timeline must not find stale
+        post-anomaly checkpoints on a crash-restart (they would be preferred
+        by latest-step auto-resume and silently undo the rollback).
+        """
+        for s in self._mgr.all_steps():
+            if s > step:
+                try:
+                    self._mgr.delete(s)
+                except Exception:
+                    self._quarantined.add(s)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    # -- restore -------------------------------------------------------------
+
+    def restore(
+        self,
+        abstract_state: Any,
+        step: Optional[int] = None,
+        fall_back: bool = True,
+    ) -> tuple[Optional[int], Any]:
+        """Restore ``step`` (default: latest), validating as we go.
+
+        ``abstract_state``: pytree of ``jax.ShapeDtypeStruct`` with shardings
+        (from ``jax.eval_shape`` + the program's state shardings) so Orbax
+        restores each leaf directly onto its mesh shards.
+
+        A checkpoint that fails to load is quarantined; with ``fall_back``
+        the next older checkpoint is tried — the reference's advertised (but
+        unimplemented) corrupt-checkpoint rollback, made real.
+        """
+        candidates: list[int]
+        if step is not None:
+            candidates = [step]
+        else:
+            candidates = list(reversed(self.all_steps()))
+        for s in candidates:
+            try:
+                state = self._mgr.restore(s, args=ocp.args.StandardRestore(abstract_state))
+                return s, state
+            except Exception:
+                self._quarantined.add(s)
+                if not fall_back:
+                    raise
+        return None, None
+
+    def restore_stable(self, abstract_state: Any, before_step: Optional[int] = None):
+        """Restore the last *stable* checkpoint (optionally strictly before a step)."""
+        stable = self.last_stable_step()
+        if stable is not None and (before_step is None or stable < before_step):
+            step, state = self.restore(abstract_state, step=stable)
+            if state is not None:
+                return step, state
+        # No usable stable pointer: walk backwards through whatever loads.
+        for s in reversed(self.all_steps()):
+            if before_step is not None and s >= before_step:
+                continue
+            step, state = self.restore(abstract_state, step=s)
+            if state is not None:
+                return step, state
+        return None, None
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+def abstract_state_like(state_shardings: Any, state_shape: Any) -> Any:
+    """Build the sharded abstract pytree Orbax needs for a placed restore."""
+    return jax.tree.map(
+        lambda shape, sh: jax.ShapeDtypeStruct(shape.shape, shape.dtype, sharding=sh),
+        state_shape,
+        state_shardings,
+    )

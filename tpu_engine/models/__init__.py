@@ -1,0 +1,28 @@
+"""Model zoo: TPU-first functional transformer implementations.
+
+The reference delegates the model entirely to the user's training script
+(``ai_engine/deepspeed_launcher.py:302`` launches an external script); its
+presets only *name* model scales (7b/13b/70b, ``deepspeed_launcher.py:369-407``).
+This package makes those scales real: decoder-only Llama-style transformers as
+pure-functional JAX code with logical-axis sharding annotations.
+"""
+
+from tpu_engine.models.transformer import (
+    ModelConfig,
+    MODEL_CONFIGS,
+    init_params,
+    forward,
+    logical_axes,
+    param_count,
+    train_flops_per_token,
+)
+
+__all__ = [
+    "ModelConfig",
+    "MODEL_CONFIGS",
+    "init_params",
+    "forward",
+    "logical_axes",
+    "param_count",
+    "train_flops_per_token",
+]

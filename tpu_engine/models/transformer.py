@@ -1,0 +1,256 @@
+"""Decoder-only Llama-style transformer, TPU-first.
+
+Pure-functional: parameters are a pytree of ``jnp`` arrays; the forward pass
+is a plain function, jit/pjit-friendly (static shapes, ``lax.scan`` over
+layers, no Python control flow on traced values). Every parameter carries
+*logical axis names* (see ``tpu_engine/sharding.py``) so the same model runs
+replicated, FSDP-sharded, tensor-parallel, or both, purely via sharding
+annotations.
+
+Design choices for the MXU/HBM (see SURVEY.md §7 and the task's TPU notes):
+
+- all heavy math is einsum/matmul in bfloat16 (MXU-friendly), softmax and
+  norms accumulate in float32;
+- layers are **stacked** on a leading ``layers`` axis and iterated with
+  ``lax.scan`` — one compiled block regardless of depth (fast compiles at
+  70B scale);
+- activation checkpointing is ``jax.checkpoint`` around the scanned block,
+  policy-selectable (reference activation-checkpointing config:
+  ``deepspeed_launcher.py:215-223``);
+- attention dispatches to the Pallas flash-attention kernel on TPU when
+  enabled (``tpu_engine/ops``), with a pure-XLA fallback that XLA fuses well.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str = "gpt-125m"
+    vocab_size: int = 32_000
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    n_kv_heads: int = 12
+    d_ff: int = 2048
+    max_seq_len: int = 2048
+    rope_theta: float = 10_000.0
+    norm_eps: float = 1e-5
+    # Attention implementation: "xla" (fallback) or "flash" (Pallas kernel).
+    attention_impl: str = "xla"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def with_(self, **kw) -> "ModelConfig":
+        return replace(self, **kw)
+
+
+# Model scales matching the reference's preset names (7b/13b/70b at
+# ``deepspeed_launcher.py:369-407``) plus small smoke/bench configs.
+MODEL_CONFIGS: dict[str, ModelConfig] = {
+    "gpt-tiny": ModelConfig(
+        name="gpt-tiny", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+        n_kv_heads=4, d_ff=128, max_seq_len=256,
+    ),
+    "gpt-125m": ModelConfig(
+        name="gpt-125m", vocab_size=32_000, d_model=768, n_layers=12, n_heads=12,
+        n_kv_heads=12, d_ff=2048, max_seq_len=2048,
+    ),
+    "llama-1b": ModelConfig(
+        name="llama-1b", vocab_size=32_000, d_model=2048, n_layers=16, n_heads=16,
+        n_kv_heads=16, d_ff=5504, max_seq_len=4096,
+    ),
+    "llama-7b": ModelConfig(
+        name="llama-7b", vocab_size=32_000, d_model=4096, n_layers=32, n_heads=32,
+        n_kv_heads=32, d_ff=11_008, max_seq_len=4096,
+    ),
+    "llama-13b": ModelConfig(
+        name="llama-13b", vocab_size=32_000, d_model=5120, n_layers=40, n_heads=40,
+        n_kv_heads=40, d_ff=13_824, max_seq_len=4096,
+    ),
+    "llama-70b": ModelConfig(
+        name="llama-70b", vocab_size=32_000, d_model=8192, n_layers=80, n_heads=64,
+        n_kv_heads=8, d_ff=28_672, max_seq_len=4096,
+    ),
+}
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+
+def init_params(rng: jax.Array, cfg: ModelConfig, dtype=jnp.float32) -> dict[str, Any]:
+    """Initialise parameters (normal(0.02); residual-out projections scaled
+    by 1/sqrt(2·n_layers), GPT-2 style)."""
+    k_embed, k_q, k_k, k_v, k_o, k_gate, k_up, k_down, k_head = jax.random.split(rng, 9)
+    L, D, V, F = cfg.n_layers, cfg.d_model, cfg.vocab_size, cfg.d_ff
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    std = 0.02
+    res_std = std / (2 * L) ** 0.5
+
+    def norm(key, shape, s):
+        return (jax.random.normal(key, shape, jnp.float32) * s).astype(dtype)
+
+    return {
+        "embed": {"embedding": norm(k_embed, (V, D), std)},
+        "layers": {
+            "attn_norm": {"scale": jnp.ones((L, D), dtype)},
+            "q": {"kernel": norm(k_q, (L, D, H * HD), std)},
+            "k": {"kernel": norm(k_k, (L, D, KV * HD), std)},
+            "v": {"kernel": norm(k_v, (L, D, KV * HD), std)},
+            "o": {"kernel": norm(k_o, (L, H * HD, D), res_std)},
+            "mlp_norm": {"scale": jnp.ones((L, D), dtype)},
+            "gate": {"kernel": norm(k_gate, (L, D, F), std)},
+            "up": {"kernel": norm(k_up, (L, D, F), std)},
+            "down": {"kernel": norm(k_down, (L, F, D), res_std)},
+        },
+        "final_norm": {"scale": jnp.ones((D,), dtype)},
+        "lm_head": {"kernel": norm(k_head, (D, V), std)},
+    }
+
+
+def logical_axes(cfg: ModelConfig) -> dict[str, Any]:
+    """Logical-axis tree matching :func:`init_params`' structure exactly."""
+    return {
+        "embed": {"embedding": ("vocab", "embed")},
+        "layers": {
+            "attn_norm": {"scale": ("layers", "embed")},
+            "q": {"kernel": ("layers", "embed", "heads")},
+            "k": {"kernel": ("layers", "embed", "kv_heads")},
+            "v": {"kernel": ("layers", "embed", "kv_heads")},
+            "o": {"kernel": ("layers", "heads", "embed")},
+            "mlp_norm": {"scale": ("layers", "embed")},
+            "gate": {"kernel": ("layers", "embed", "mlp")},
+            "up": {"kernel": ("layers", "embed", "mlp")},
+            "down": {"kernel": ("layers", "mlp", "embed")},
+        },
+        "final_norm": {"scale": ("embed",)},
+        "lm_head": {"kernel": ("embed", "vocab")},
+    }
+
+
+def param_count(cfg: ModelConfig) -> int:
+    L, D, V, F = cfg.n_layers, cfg.d_model, cfg.vocab_size, cfg.d_ff
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    per_layer = D * H * HD + 2 * D * KV * HD + H * HD * D + 3 * D * F + 2 * D
+    return V * D + L * per_layer + D + D * V
+
+
+def train_flops_per_token(cfg: ModelConfig, seq_len: int) -> float:
+    """Approximate training FLOPs/token: 6·N_matmul + attention term
+    (12·L·D·S accounting fwd+bwd of the S×S score/value matmuls)."""
+    n = param_count(cfg) - cfg.vocab_size * cfg.d_model  # embedding lookup is not a matmul
+    return 6.0 * n + 12.0 * cfg.n_layers * cfg.d_model * seq_len
+
+
+# ---------------------------------------------------------------------------
+# Forward pass
+# ---------------------------------------------------------------------------
+
+
+def _rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    out = x32 * lax.rsqrt(var + eps)
+    return (out * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embeddings. x: [B, S, H, HD], positions: [B, S]."""
+    hd = x.shape[-1]
+    half = hd // 2
+    freqs = jnp.exp(-jnp.arange(0, half, dtype=jnp.float32) * (jnp.log(theta) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
+def _attention(q, k, v, impl: str):
+    """Causal attention via the ops package (single implementation home:
+    Pallas flash kernel on TPU, XLA fallback — ``tpu_engine/ops``)."""
+    from tpu_engine.ops import flash_attention  # lazy: avoids import cycles
+
+    return flash_attention.mha(q, k, v, causal=True, force_xla=(impl != "flash"))
+
+
+def _block(x, layer_params, cfg: ModelConfig, positions):
+    """One transformer block. x: [B, S, D]."""
+    B, S, D = x.shape
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    h = _rms_norm(x, layer_params["attn_norm"]["scale"], cfg.norm_eps)
+    q = jnp.einsum("bsd,de->bse", h, layer_params["q"]["kernel"]).reshape(B, S, H, HD)
+    k = jnp.einsum("bsd,de->bse", h, layer_params["k"]["kernel"]).reshape(B, S, KV, HD)
+    v = jnp.einsum("bsd,de->bse", h, layer_params["v"]["kernel"]).reshape(B, S, KV, HD)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    attn = _attention(q, k, v, cfg.attention_impl)
+    attn = attn.reshape(B, S, H * HD)
+    x = x + jnp.einsum("bse,ed->bsd", attn, layer_params["o"]["kernel"])
+
+    h = _rms_norm(x, layer_params["mlp_norm"]["scale"], cfg.norm_eps)
+    gate = jnp.einsum("bsd,df->bsf", h, layer_params["gate"]["kernel"])
+    up = jnp.einsum("bsd,df->bsf", h, layer_params["up"]["kernel"])
+    x = x + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, layer_params["down"]["kernel"])
+    return x
+
+
+_REMAT_POLICIES = {
+    "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+    "dots_saveable": jax.checkpoint_policies.dots_saveable,
+    "dots_with_no_batch_dims_saveable": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "everything_saveable": jax.checkpoint_policies.everything_saveable,
+}
+
+
+def forward(
+    params: dict[str, Any],
+    tokens: jax.Array,
+    cfg: ModelConfig,
+    compute_dtype=jnp.bfloat16,
+    remat: bool = False,
+    remat_policy: str = "nothing_saveable",
+    positions: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Forward pass: tokens [B, S] int32 → logits [B, S, V] float32."""
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+
+    embed = params["embed"]["embedding"].astype(compute_dtype)
+    x = jnp.take(embed, tokens, axis=0)  # [B, S, D]
+
+    layer_stack = jax.tree.map(lambda a: a.astype(compute_dtype)
+                               if jnp.issubdtype(a.dtype, jnp.floating) else a,
+                               params["layers"])
+
+    def scan_body(carry, layer_params):
+        y = _block(carry, layer_params, cfg, positions)
+        return y, None
+
+    body = scan_body
+    if remat:
+        policy = _REMAT_POLICIES.get(remat_policy, jax.checkpoint_policies.nothing_saveable)
+        body = jax.checkpoint(scan_body, policy=policy, prevent_cse=True)
+
+    x, _ = lax.scan(body, x, layer_stack)
+
+    x = _rms_norm(x, params["final_norm"]["scale"].astype(compute_dtype), cfg.norm_eps)
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, params["lm_head"]["kernel"].astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return logits

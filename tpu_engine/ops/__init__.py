@@ -1,0 +1,11 @@
+"""Hot-op kernels (Pallas TPU) with pure-XLA fallbacks.
+
+The reference has no first-party kernels (SURVEY.md §2.2 — all compute is
+delegated to DeepSpeed/torch); here the hot ops are owned by the framework:
+flash attention as a Pallas TPU kernel, falling back to an XLA implementation
+on non-TPU backends (e.g. the 8-virtual-device CPU test mesh).
+"""
+
+from tpu_engine.ops import flash_attention
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,47 @@
+"""Flash attention for TPU (Pallas), with an XLA fallback.
+
+Phase-7 home of the Pallas kernel; the public entry point :func:`mha` is
+stable from day one so the model can dispatch to it unconditionally.
+
+Layout convention: q [B, S, H, D], k/v [B, S, KV, D] (GQA when KV < H),
+causal masking only (decoder-only LM).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _xla_mha(q, k, v, causal: bool = True):
+    B, S, H, D = q.shape
+    KV = k.shape[2]
+    if KV != H:
+        k = jnp.repeat(k, H // KV, axis=2)
+        v = jnp.repeat(v, H // KV, axis=2)
+    scale = 1.0 / (D ** 0.5)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+        scores = jnp.where(mask[None, None, :, :], scores, -1e9)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def tpu_available() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def mha(q, k, v, causal: bool = True, force_xla: bool = False):
+    """Multi-head attention; Pallas flash kernel on TPU, XLA elsewhere."""
+    if force_xla or not tpu_available():
+        return _xla_mha(q, k, v, causal=causal)
+    try:
+        from tpu_engine.ops._flash_pallas import flash_mha
+
+        return flash_mha(q, k, v, causal=causal)
+    except ImportError:
+        return _xla_mha(q, k, v, causal=causal)

@@ -1,0 +1,8 @@
+"""Parallelism building blocks beyond GSPMD annotations.
+
+Home of sequence/context parallelism (ring attention via ``shard_map`` +
+``ppermute``) and named-axis collective helpers — capabilities absent from
+the reference entirely (SURVEY.md §5 long-context), first-class here.
+Modules are added as they land; check this package's contents rather than
+this docstring for the current set.
+"""

@@ -1,0 +1,321 @@
+"""Supervised in-process training jobs: launch, monitor, rollback, resume.
+
+The reference launches training as a fire-and-forget subprocess — stdout
+piped and dropped, only the pid kept, no tracking after launch
+(``ai_engine/deepspeed_launcher.py:354-362``; SURVEY.md §5 "no failure
+detector for a running job"). Here the training task is an in-process thread
+the supervisor actually owns:
+
+- every step's metrics feed the :class:`~tpu_engine.loss_monitor.LossSpikeMonitor`
+  directly (no HTTP hop for the local case — SURVEY.md §3.3);
+- a critical divergence/spike alert triggers halt → restore last *stable*
+  checkpoint → cut LR → continue (mechanising the remediation strings at
+  reference ``loss_monitor.py:131-136,167-172``);
+- periodic async Orbax saves; a checkpoint is marked stable only after a
+  healthy margin of steps passes with no critical alert;
+- preemption (metadata, SIGTERM, or the simulation seam) triggers a
+  synchronous emergency save (``tpu_engine/preemption.py``);
+- on restart, a job with the same checkpoint directory auto-resumes from the
+  newest loadable checkpoint (corrupt ones are quarantined) — MTTR is
+  bounded by restore + one warm compile (persistent XLA compilation cache).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import traceback
+from enum import Enum
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+
+from tpu_engine.checkpoint import TrainCheckpointManager, abstract_state_like
+from tpu_engine.loss_monitor import (
+    AlertSeverity,
+    LossSpikeMonitor,
+    MonitorConfig,
+    TrainingMetrics,
+)
+from tpu_engine.preemption import PreemptionWatcher
+from tpu_engine.sharding import TPUTrainConfig
+from tpu_engine.train import TrainProgram, build_train_program
+
+log = logging.getLogger(__name__)
+
+
+class JobStatus(str, Enum):
+    PENDING = "pending"
+    COMPILING = "compiling"
+    RUNNING = "running"
+    COMPLETED = "completed"
+    FAILED = "failed"
+    STOPPED = "stopped"
+    PREEMPTED = "preempted"
+
+
+class TrainingJob:
+    """One supervised training run (thread-owned)."""
+
+    def __init__(
+        self,
+        job_id: str,
+        config: TPUTrainConfig,
+        program: Optional[TrainProgram] = None,
+        data_fn: Optional[Callable[[int], jax.Array]] = None,
+        monitor_config: Optional[MonitorConfig] = None,
+        max_steps: Optional[int] = None,
+        auto_rollback: bool = True,
+        lr_cut_on_rollback: float = 0.5,
+        max_rollbacks: int = 3,
+        stable_margin_steps: int = 50,
+        watch_preemption: bool = False,
+        install_signal_handlers: bool = False,
+        simulate_preemption_check: Optional[Callable[[], bool]] = None,
+    ):
+        self.job_id = job_id
+        self.config = config
+        self.program = program
+        self.data_fn = data_fn
+        self.monitor = LossSpikeMonitor(job_id=job_id, config=monitor_config)
+        self.max_steps = max_steps if max_steps is not None else config.total_steps
+        self.auto_rollback = auto_rollback
+        self.lr_cut_on_rollback = lr_cut_on_rollback
+        self.max_rollbacks = max_rollbacks
+        self.stable_margin_steps = stable_margin_steps
+
+        self.status = JobStatus.PENDING
+        self.error: Optional[str] = None
+        self.rollback_count = 0
+        self.resumed_from_step: Optional[int] = None
+        self.preemption_reason: Optional[str] = None
+        self.started_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.last_step_time_s: Optional[float] = None
+        self.tokens_per_sec: Optional[float] = None
+        self.current_step: int = 0
+
+        self._state: Any = None
+        self._state_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_critical_step = -1
+        self._pending_stable: list[int] = []
+
+        self.ckpt: Optional[TrainCheckpointManager] = None
+        if config.checkpoint_dir:
+            self.ckpt = TrainCheckpointManager(
+                config.checkpoint_dir,
+                max_to_keep=config.max_checkpoints_to_keep,
+                save_interval_steps=1,
+            )
+
+        self.watcher: Optional[PreemptionWatcher] = None
+        if watch_preemption:
+            kwargs: dict[str, Any] = {}
+            if simulate_preemption_check is not None:
+                # Test seam: poll the injected check fast instead of GCE metadata.
+                kwargs = {
+                    "metadata_check": simulate_preemption_check,
+                    "check_interval_s": 0.05,
+                }
+            self.watcher = PreemptionWatcher(
+                on_preemption=self._on_preemption,
+                install_signal_handlers=install_signal_handlers,
+                **kwargs,
+            )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True, name=f"job-{self.job_id}")
+        self._thread.start()
+
+    def stop(self, timeout: float = 30.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    @property
+    def is_alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    # -- preemption ----------------------------------------------------------
+
+    def _on_preemption(self, reason: str) -> None:
+        """Emergency path: flag stop; the train loop does the synchronous save."""
+        log.warning("job %s: preemption (%s) — emergency checkpoint", self.job_id, reason)
+        self.preemption_reason = reason
+        self._stop.set()
+
+    # -- training loop -------------------------------------------------------
+
+    def _abstract_state(self):
+        prog = self.program
+        state_shape = jax.eval_shape(lambda: prog.init(jax.random.PRNGKey(self.config.seed)))
+        return abstract_state_like(prog.state_shardings, state_shape)
+
+    def _run(self) -> None:
+        self.started_at = time.time()
+        try:
+            self.status = JobStatus.COMPILING
+            if self.program is None:
+                self.program = build_train_program(self.config)
+            prog = self.program
+
+            # Resume if checkpoints exist (auto-resume; MTTR path).
+            start_step = 0
+            if self.ckpt is not None and self.ckpt.latest_step() is not None:
+                step, state = self.ckpt.restore(self._abstract_state())
+                if state is not None:
+                    self._state = state
+                    start_step = int(step)
+                    self.resumed_from_step = start_step
+                    log.info("job %s: resumed from checkpoint step %d", self.job_id, start_step)
+            if self._state is None:
+                self._state = prog.init(jax.random.PRNGKey(self.config.seed))
+
+            if self.watcher is not None:
+                self.watcher.start()
+
+            self.status = JobStatus.RUNNING
+            tokens_per_batch = 1
+            for d in prog.global_batch_shape():
+                tokens_per_batch *= d
+
+            step = start_step
+            while step < self.max_steps and not self._stop.is_set():
+                batch = (
+                    self.data_fn(step) if self.data_fn is not None else prog.synthetic_batch(step)
+                )
+                t0 = time.perf_counter()
+                with self._state_lock:
+                    self._state, metrics = prog.step(self._state, batch)
+                host = {k: float(v) for k, v in jax.device_get(metrics).items()}
+                dt = time.perf_counter() - t0
+                self.last_step_time_s = dt
+                self.tokens_per_sec = tokens_per_batch / dt if dt > 0 else None
+                step = int(host["step"])
+                self.current_step = step
+
+                alerts = self.monitor.ingest(
+                    TrainingMetrics(
+                        step=step,
+                        loss=host["loss"],
+                        learning_rate=host["learning_rate"],
+                        gradient_norm=host["grad_norm"],
+                        throughput_tokens_per_sec=self.tokens_per_sec,
+                    )
+                )
+
+                critical = [a for a in alerts if a.severity == AlertSeverity.CRITICAL]
+                if critical:
+                    self._last_critical_step = step
+                    if self.auto_rollback and self.ckpt is not None:
+                        rolled = self._rollback(before_step=step)
+                        if rolled is not None:
+                            step = rolled
+                            continue
+                        if any(a.alert_type == "divergence" for a in critical):
+                            raise RuntimeError(
+                                f"diverged at step {step} with no stable checkpoint to roll back to"
+                            )
+                    elif any(a.alert_type == "divergence" for a in critical):
+                        raise RuntimeError(f"training diverged at step {step}")
+
+                # Periodic checkpoint + stable-pointer advancement.
+                if self.ckpt is not None:
+                    if step % self.config.checkpoint_interval_steps == 0:
+                        self.ckpt.save(step, self._state, metrics={"loss": host["loss"]})
+                        self._pending_stable.append(step)
+                    self._advance_stable(step)
+
+            # Final save + status.
+            if self.ckpt is not None and self._state is not None:
+                self.ckpt.save(step, self._state, force=True, wait=True)
+                self._advance_stable(step)
+            if self.preemption_reason is not None:
+                self.status = JobStatus.PREEMPTED
+            elif self._stop.is_set() and step < self.max_steps:
+                self.status = JobStatus.STOPPED
+            else:
+                self.status = JobStatus.COMPLETED
+        except Exception as e:  # noqa: BLE001 — job boundary
+            self.error = f"{type(e).__name__}: {e}"
+            log.error("job %s failed:\n%s", self.job_id, traceback.format_exc())
+            self.status = JobStatus.FAILED
+        finally:
+            self.finished_at = time.time()
+            if self.watcher is not None:
+                self.watcher.stop()
+            if self.ckpt is not None:
+                try:
+                    self.ckpt.wait_until_finished()
+                except Exception:
+                    pass
+
+    def _advance_stable(self, current_step: int) -> None:
+        """Mark saved steps stable once a healthy margin has passed them."""
+        still_pending: list[int] = []
+        for s in self._pending_stable:
+            if self._last_critical_step >= s:
+                continue  # anomaly at/after this save — never stable
+            if current_step >= s + self.stable_margin_steps or current_step >= self.max_steps:
+                self.ckpt.mark_stable(s)
+            else:
+                still_pending.append(s)
+        self._pending_stable = still_pending
+
+    def _rollback(self, before_step: int) -> Optional[int]:
+        """Restore last stable checkpoint and cut LR; returns restored step."""
+        if self.rollback_count >= self.max_rollbacks:
+            log.error("job %s: max rollbacks (%d) reached", self.job_id, self.max_rollbacks)
+            return None
+        self.ckpt.wait_until_finished()
+        step, state = self.ckpt.restore_stable(self._abstract_state(), before_step=before_step)
+        if state is None:
+            return None
+        # Purge post-anomaly checkpoints: a crash-restart must not auto-resume
+        # into the diverged timeline (latest-step restore would prefer them).
+        self.ckpt.delete_after(int(step))
+        self._pending_stable = [s for s in self._pending_stable if s <= int(step)]
+        new_scale = jax.device_get(state["lr_scale"]) * self.lr_cut_on_rollback
+        state["lr_scale"] = jax.device_put(
+            jax.numpy.asarray(new_scale, jax.numpy.float32),
+            self.program.state_shardings["lr_scale"],
+        )
+        with self._state_lock:
+            self._state = state
+        self.rollback_count += 1
+        self.monitor.reset()
+        log.warning(
+            "job %s: rolled back to stable step %d (rollback #%d, lr_scale=%.4f)",
+            self.job_id, step, self.rollback_count, float(new_scale),
+        )
+        return int(step)
+
+    # -- views ---------------------------------------------------------------
+
+    def describe(self) -> dict[str, Any]:
+        return {
+            "job_id": self.job_id,
+            "status": self.status.value,
+            "error": self.error,
+            "model_name": self.config.model_name,
+            "sharding_stage": int(self.config.sharding_stage),
+            "max_steps": self.max_steps,
+            "current_step": self.current_step,
+            "rollback_count": self.rollback_count,
+            "resumed_from_step": self.resumed_from_step,
+            "preemption_reason": self.preemption_reason,
+            "started_at": self.started_at,
+            "finished_at": self.finished_at,
+            "last_step_time_s": self.last_step_time_s,
+            "tokens_per_sec": self.tokens_per_sec,
+            "monitor": self.monitor.get_summary(),
+        }

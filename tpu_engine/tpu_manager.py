@@ -1,0 +1,353 @@
+"""TPU fleet telemetry and health management.
+
+Capability parity with the reference's GPU fleet manager
+(``ai_engine/gpu_manager.py``): device table, health classification with
+warning/critical thresholds, fleet aggregation + alert rollup, best-device
+selection, a mock fleet for tests, and injectable raw telemetry — but sourced
+from the JAX runtime / libtpu rather than an ``nvidia-smi`` subprocess parse
+(reference ``gpu_manager.py:100-117``).
+
+TPU-honest schema notes (SURVEY.md §7 hard part e): there is no fan speed and
+no per-process memory attribution on TPU; instead we report HBM usage from
+``device.memory_stats()``, TensorCore duty cycle / temperature / power when a
+telemetry source provides them (libtpu metrics or an injected snapshot), and
+``None`` otherwise. Health thresholds mirror the reference's semantics
+(``gpu_manager.py:92-98``): temp 80/90 °C, memory 85/95 %, utilization 95 %,
+power 0.9× limit.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from enum import Enum
+from typing import Any, Optional, Sequence
+
+import jax
+from pydantic import BaseModel, Field
+
+# Default HBM per chip when the runtime doesn't report a limit (GiB).
+_DEFAULT_HBM_GIB = {
+    "TPU v4": 32.0,
+    "TPU v5 lite": 16.0,
+    "TPU v5e": 16.0,
+    "TPU v5": 16.0,
+    "TPU v5p": 95.0,
+    "TPU v6 lite": 32.0,
+    "TPU v6e": 32.0,
+}
+
+
+class TPUHealthStatus(str, Enum):
+    """Mirrors reference ``GPUHealthStatus`` (``gpu_manager.py:20-25``)."""
+
+    HEALTHY = "healthy"
+    WARNING = "warning"
+    CRITICAL = "critical"
+    UNKNOWN = "unknown"
+
+
+class TPUDevice(BaseModel):
+    """One TPU chip/core. Reference analogue: ``GPUDevice`` (``gpu_manager.py:35-62``)."""
+
+    index: int
+    name: str = "TPU"
+    device_kind: str = "unknown"
+    platform: str = "tpu"
+    process_index: int = 0
+    coords: Optional[tuple[int, ...]] = None
+    core_on_chip: Optional[int] = None
+
+    hbm_total_gb: float = 0.0
+    hbm_used_gb: float = 0.0
+    hbm_utilization_pct: float = 0.0
+
+    duty_cycle_pct: Optional[float] = None  # TensorCore utilization
+    temperature_c: Optional[float] = None
+    power_draw_w: Optional[float] = None
+    power_limit_w: Optional[float] = None
+
+    health_status: TPUHealthStatus = TPUHealthStatus.UNKNOWN
+    alerts: list[str] = Field(default_factory=list)
+
+    @property
+    def hbm_free_gb(self) -> float:
+        return max(self.hbm_total_gb - self.hbm_used_gb, 0.0)
+
+    @property
+    def is_available(self) -> bool:
+        """Schedulable: <80% HBM used, duty cycle <90% (if known), not critical.
+
+        Same semantics as reference ``GPUDevice.is_available``
+        (``gpu_manager.py:57-62`` — the code, not its stale docstring; see
+        SURVEY.md §5 quirks).
+        """
+        if self.health_status == TPUHealthStatus.CRITICAL:
+            return False
+        if self.hbm_utilization_pct >= 80.0:
+            return False
+        if self.duty_cycle_pct is not None and self.duty_cycle_pct >= 90.0:
+            return False
+        return True
+
+
+class TPUFleetStatus(BaseModel):
+    """Fleet aggregate. Reference analogue: ``GPUFleetStatus`` (``gpu_manager.py:65-77``)."""
+
+    timestamp: float = Field(default_factory=time.time)
+    total_devices: int = 0
+    available_devices: int = 0
+    total_hbm_gb: float = 0.0
+    used_hbm_gb: float = 0.0
+    average_duty_cycle_pct: Optional[float] = None
+    average_temperature_c: Optional[float] = None
+    devices: list[TPUDevice] = Field(default_factory=list)
+    fleet_alerts: list[str] = Field(default_factory=list)
+
+
+class TPUManager:
+    """Fleet manager over the JAX runtime (reference ``GPUManager``, ``gpu_manager.py:80``).
+
+    Telemetry sources, in priority order:
+
+    1. injected snapshot (``metrics=`` argument or :meth:`parse_metrics_json`)
+       — the test seam, parity with ``parse_xml(xml_str=...)`` /
+       ``parse_csv(csv_str=...)`` (``gpu_manager.py:119-130,219-232``);
+    2. the live JAX runtime: ``jax.devices()`` + ``device.memory_stats()``.
+    """
+
+    # Health thresholds — reference ``gpu_manager.py:92-98``.
+    TEMP_WARNING_C = 80.0
+    TEMP_CRITICAL_C = 90.0
+    HBM_WARNING_PCT = 85.0
+    HBM_CRITICAL_PCT = 95.0
+    DUTY_WARNING_PCT = 95.0
+    POWER_WARNING_RATIO = 0.9
+
+    def __init__(self, devices: Optional[Sequence[jax.Device]] = None):
+        self._devices = devices  # None = resolve lazily from jax.devices()
+
+    # -- telemetry ingestion -------------------------------------------------
+
+    def _runtime_devices(self) -> list[jax.Device]:
+        return list(self._devices if self._devices is not None else jax.devices())
+
+    def _device_from_runtime(self, i: int, d: jax.Device) -> TPUDevice:
+        kind = getattr(d, "device_kind", "unknown")
+        hbm_total = 0.0
+        hbm_used = 0.0
+        stats: Optional[dict[str, Any]]
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit") or 0
+            used = stats.get("bytes_in_use", 0)
+            hbm_total = limit / 2**30
+            hbm_used = used / 2**30
+        if hbm_total <= 0.0:
+            for prefix, gib in _DEFAULT_HBM_GIB.items():
+                if kind.startswith(prefix):
+                    hbm_total = gib
+                    break
+        util = (hbm_used / hbm_total * 100.0) if hbm_total > 0 else 0.0
+        coords = getattr(d, "coords", None)
+        dev = TPUDevice(
+            index=i,
+            name=f"{kind} #{d.id}",
+            device_kind=kind,
+            platform=d.platform,
+            process_index=d.process_index,
+            coords=tuple(int(c) for c in coords) if coords is not None else None,
+            core_on_chip=getattr(d, "core_on_chip", None),
+            hbm_total_gb=round(hbm_total, 3),
+            hbm_used_gb=round(hbm_used, 3),
+            hbm_utilization_pct=round(util, 2),
+        )
+        self._assess_health(dev)
+        return dev
+
+    def parse_metrics(self, metrics: Sequence[dict[str, Any]]) -> list[TPUDevice]:
+        """Build the device table from an injected telemetry snapshot.
+
+        Each entry may carry: index, device_kind, hbm_total_gb, hbm_used_gb,
+        duty_cycle_pct, temperature_c, power_draw_w, power_limit_w, coords,
+        process_index. Unknown keys are ignored.
+        """
+        out: list[TPUDevice] = []
+        for i, m in enumerate(metrics):
+            total = float(m.get("hbm_total_gb", 0.0))
+            used = float(m.get("hbm_used_gb", 0.0))
+            util = m.get("hbm_utilization_pct")
+            if util is None:
+                util = (used / total * 100.0) if total > 0 else 0.0
+            dev = TPUDevice(
+                index=int(m.get("index", i)),
+                name=m.get("name", f"{m.get('device_kind', 'TPU')} #{m.get('index', i)}"),
+                device_kind=m.get("device_kind", "unknown"),
+                platform=m.get("platform", "tpu"),
+                process_index=int(m.get("process_index", 0)),
+                coords=tuple(m["coords"]) if m.get("coords") is not None else None,
+                core_on_chip=m.get("core_on_chip"),
+                hbm_total_gb=total,
+                hbm_used_gb=used,
+                hbm_utilization_pct=round(float(util), 2),
+                duty_cycle_pct=m.get("duty_cycle_pct"),
+                temperature_c=m.get("temperature_c"),
+                power_draw_w=m.get("power_draw_w"),
+                power_limit_w=m.get("power_limit_w"),
+            )
+            self._assess_health(dev)
+            out.append(dev)
+        return out
+
+    def parse_metrics_json(self, raw: str) -> list[TPUDevice]:
+        """Injectable raw-telemetry seam: JSON list of per-chip metric dicts
+        (the ``tpu-info``/libtpu analogue of canned nvidia-smi XML/CSV)."""
+        data = json.loads(raw)
+        if isinstance(data, dict):
+            data = data.get("devices", [])
+        return self.parse_metrics(data)
+
+    # -- health --------------------------------------------------------------
+
+    def _assess_health(self, dev: TPUDevice) -> None:
+        """Classify health; mirrors reference ``_assess_health`` (``gpu_manager.py:348-379``)."""
+        alerts: list[str] = []
+        status = TPUHealthStatus.HEALTHY
+
+        if dev.temperature_c is not None:
+            if dev.temperature_c >= self.TEMP_CRITICAL_C:
+                alerts.append(f"CRITICAL: temperature {dev.temperature_c:.0f}C >= {self.TEMP_CRITICAL_C:.0f}C")
+                status = TPUHealthStatus.CRITICAL
+            elif dev.temperature_c >= self.TEMP_WARNING_C:
+                alerts.append(f"WARNING: temperature {dev.temperature_c:.0f}C >= {self.TEMP_WARNING_C:.0f}C")
+                status = TPUHealthStatus.WARNING
+
+        if dev.hbm_total_gb > 0:
+            if dev.hbm_utilization_pct >= self.HBM_CRITICAL_PCT:
+                alerts.append(f"CRITICAL: HBM {dev.hbm_utilization_pct:.1f}% >= {self.HBM_CRITICAL_PCT:.0f}%")
+                status = TPUHealthStatus.CRITICAL
+            elif dev.hbm_utilization_pct >= self.HBM_WARNING_PCT:
+                alerts.append(f"WARNING: HBM {dev.hbm_utilization_pct:.1f}% >= {self.HBM_WARNING_PCT:.0f}%")
+                if status != TPUHealthStatus.CRITICAL:
+                    status = TPUHealthStatus.WARNING
+
+        if dev.duty_cycle_pct is not None and dev.duty_cycle_pct >= self.DUTY_WARNING_PCT:
+            alerts.append(f"WARNING: duty cycle {dev.duty_cycle_pct:.1f}% >= {self.DUTY_WARNING_PCT:.0f}%")
+            if status == TPUHealthStatus.HEALTHY:
+                status = TPUHealthStatus.WARNING
+
+        if (
+            dev.power_draw_w is not None
+            and dev.power_limit_w is not None
+            and dev.power_limit_w > 0
+            and dev.power_draw_w >= self.POWER_WARNING_RATIO * dev.power_limit_w
+        ):
+            alerts.append(
+                f"WARNING: power draw {dev.power_draw_w:.0f}W >= "
+                f"{self.POWER_WARNING_RATIO:.0%} of limit {dev.power_limit_w:.0f}W"
+            )
+            if status == TPUHealthStatus.HEALTHY:
+                status = TPUHealthStatus.WARNING
+
+        dev.alerts = alerts
+        dev.health_status = status
+
+    # -- fleet ---------------------------------------------------------------
+
+    def get_fleet_status(
+        self,
+        metrics: Optional[Sequence[dict[str, Any]]] = None,
+        metrics_json: Optional[str] = None,
+    ) -> TPUFleetStatus:
+        """Aggregate fleet view (reference ``get_fleet_status``, ``gpu_manager.py:275-321``)."""
+        if metrics_json is not None:
+            devices = self.parse_metrics_json(metrics_json)
+        elif metrics is not None:
+            devices = self.parse_metrics(metrics)
+        else:
+            try:
+                devices = [
+                    self._device_from_runtime(i, d) for i, d in enumerate(self._runtime_devices())
+                ]
+            except Exception as e:  # runtime unavailable
+                return TPUFleetStatus(
+                    fleet_alerts=[f"TPU runtime unavailable: {type(e).__name__}: {e}"]
+                )
+
+        fleet_alerts: list[str] = []
+        for dev in devices:
+            for a in dev.alerts:
+                fleet_alerts.append(f"chip {dev.index}: {a}")
+
+        duty = [d.duty_cycle_pct for d in devices if d.duty_cycle_pct is not None]
+        temps = [d.temperature_c for d in devices if d.temperature_c is not None]
+        available = sum(1 for d in devices if d.is_available)
+        if devices and available == 0:
+            fleet_alerts.append("No TPU devices available for new work")
+        if not devices:
+            fleet_alerts.append("No TPU devices detected")
+
+        return TPUFleetStatus(
+            total_devices=len(devices),
+            available_devices=available,
+            total_hbm_gb=round(sum(d.hbm_total_gb for d in devices), 3),
+            used_hbm_gb=round(sum(d.hbm_used_gb for d in devices), 3),
+            average_duty_cycle_pct=round(sum(duty) / len(duty), 2) if duty else None,
+            average_temperature_c=round(sum(temps) / len(temps), 2) if temps else None,
+            devices=devices,
+            fleet_alerts=fleet_alerts,
+        )
+
+    def select_best_device(
+        self,
+        min_free_hbm_gb: float = 0.0,
+        metrics: Optional[Sequence[dict[str, Any]]] = None,
+        metrics_json: Optional[str] = None,
+    ) -> Optional[TPUDevice]:
+        """Pick the least-loaded schedulable chip.
+
+        Reference ``select_best_gpu`` (``gpu_manager.py:323-346``): filter by
+        availability + free-memory requirement, sort by (−free HBM, duty).
+        """
+        fleet = self.get_fleet_status(metrics=metrics, metrics_json=metrics_json)
+        candidates = [
+            d for d in fleet.devices if d.is_available and d.hbm_free_gb >= min_free_hbm_gb
+        ]
+        if not candidates:
+            return None
+        candidates.sort(key=lambda d: (-d.hbm_free_gb, d.duty_cycle_pct or 0.0))
+        return candidates[0]
+
+    # -- fixtures ------------------------------------------------------------
+
+    @staticmethod
+    def get_mock_fleet() -> TPUFleetStatus:
+        """Hand-built v5e-8 fleet: 7 healthy chips + 1 warning chip.
+
+        Test/demo fixture, parity with reference ``get_mock_fleet``
+        (``gpu_manager.py:400-431``).
+        """
+        mgr = TPUManager(devices=[])
+        metrics = []
+        for i in range(8):
+            hot = i == 5
+            metrics.append(
+                {
+                    "index": i,
+                    "device_kind": "TPU v5e",
+                    "platform": "tpu",
+                    "coords": (i % 4, i // 4, 0),
+                    "hbm_total_gb": 16.0,
+                    "hbm_used_gb": 14.2 if hot else 6.4,
+                    "duty_cycle_pct": 97.5 if hot else 62.0,
+                    "temperature_c": 83.0 if hot else 54.0,
+                    "power_draw_w": 170.0 if hot else 120.0,
+                    "power_limit_w": 192.0,
+                    "process_index": 0,
+                }
+            )
+        fleet = mgr.get_fleet_status(metrics=metrics)
+        return fleet

@@ -1,0 +1,1 @@
+"""Shared utilities: pytree helpers, structured logging, native-extension shims."""
