@@ -1,0 +1,92 @@
+"""Minimal HTTP helpers for the aiohttp-based control plane.
+
+The reference uses FastAPI (``backend/main.py:5``); this image bakes aiohttp
+instead, so the control plane is aiohttp with the same endpoint paths, JSON
+shapes, and FastAPI-like semantics: pydantic request validation with 422 on
+failure, pydantic response serialisation, structured error bodies
+(``{"detail": ...}``), and permissive CORS.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Type, TypeVar
+
+from aiohttp import web
+from pydantic import BaseModel, ValidationError
+
+M = TypeVar("M", bound=BaseModel)
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, detail: str):
+        self.status = status
+        self.detail = detail
+        super().__init__(detail)
+
+
+def dump(obj: Any) -> Any:
+    """Recursively serialise pydantic models / enums / tuples to JSON types."""
+    if isinstance(obj, BaseModel):
+        return obj.model_dump(mode="json")
+    if isinstance(obj, dict):
+        return {k: dump(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [dump(v) for v in obj]
+    return obj
+
+
+def json_response(data: Any, status: int = 200) -> web.Response:
+    return web.json_response(dump(data), status=status)
+
+
+async def parse_body(request: web.Request, model: Type[M]) -> M:
+    """Validate the JSON body against a pydantic model (FastAPI-style 422)."""
+    try:
+        raw = await request.json()
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        raise ApiError(422, "request body is not valid JSON")
+    try:
+        return model.model_validate(raw)
+    except ValidationError as e:
+        raise ApiError(422, str(e))
+
+
+@web.middleware
+async def error_middleware(request: web.Request, handler):
+    try:
+        return await handler(request)
+    except ApiError as e:
+        return web.json_response({"detail": e.detail}, status=e.status)
+    except web.HTTPException:
+        raise
+    except Exception as e:  # noqa: BLE001 — API boundary
+        return web.json_response(
+            {"detail": f"internal error: {type(e).__name__}: {e}"}, status=500
+        )
+
+
+@web.middleware
+async def cors_middleware(request: web.Request, handler):
+    """Permissive CORS, parity with reference ``backend/main.py:11-17``.
+
+    Router-raised HTTPExceptions (404/405 on unregistered paths/methods) are
+    Responses too — they must carry the CORS headers or browsers report an
+    opaque network error instead of the status.
+    """
+    if request.method == "OPTIONS":
+        resp = web.Response(status=204)
+    else:
+        try:
+            resp = await handler(request)
+        except web.HTTPException as exc:
+            _add_cors(exc)
+            raise
+    _add_cors(resp)
+    return resp
+
+
+def _add_cors(resp) -> None:
+    resp.headers["Access-Control-Allow-Origin"] = "*"
+    resp.headers["Access-Control-Allow-Methods"] = "*"
+    resp.headers["Access-Control-Allow-Headers"] = "*"

@@ -1,0 +1,125 @@
+"""Monitoring routes — endpoint-parity with the reference's monitoring router
+(``backend/routers/monitoring.py``): create, ingest, ingest/single,
+summary/{job}, loss-curve/{job}, reset/{job}, jobs.
+
+Monitors for jobs launched through this control plane resolve to the
+supervisor's own monitor (unified job identity — the reference keeps two
+unlinked namespaces, SURVEY.md §5); HTTP-created monitors serve external
+jobs pushing metrics remotely.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from aiohttp import web
+from pydantic import BaseModel
+
+from backend import state
+from backend.http import ApiError, json_response, parse_body
+from tpu_engine.loss_monitor import MonitorConfig, SpikeAlert, TrainingMetrics
+
+
+class CreateMonitorRequest(BaseModel):
+    """Mirrors reference ``CreateMonitorRequest`` (``monitoring.py:24-31``)."""
+
+    job_id: str
+    config: Optional[MonitorConfig] = None
+
+
+class IngestRequest(BaseModel):
+    """Mirrors reference ``IngestRequest`` (``monitoring.py:34-38``)."""
+
+    job_id: str
+    metrics: list[TrainingMetrics]
+
+
+class IngestSingleRequest(BaseModel):
+    """Mirrors reference single-metric ingest (``monitoring.py:41-45``)."""
+
+    job_id: str
+    step: int
+    loss: float
+    learning_rate: Optional[float] = None
+    gradient_norm: Optional[float] = None
+    throughput_tokens_per_sec: Optional[float] = None
+
+
+async def create_monitor(request: web.Request) -> web.Response:
+    """Create (or return) a monitor for a job (reference ``monitoring.py:49-64``)."""
+    req = await parse_body(request, CreateMonitorRequest)
+    mon = state.get_or_create_monitor(req.job_id, req.config)
+    return json_response(
+        {"job_id": req.job_id, "created": True, "config": mon.config.model_dump()}
+    )
+
+
+async def ingest_metrics(request: web.Request) -> web.Response:
+    """Batch metrics ingest → alerts (reference ``monitoring.py:67-80``)."""
+    req = await parse_body(request, IngestRequest)
+    mon = state.get_or_create_monitor(req.job_id)
+    alerts: list[SpikeAlert] = []
+    for m in req.metrics:
+        alerts.extend(mon.ingest(m))
+    return json_response(alerts)
+
+
+async def ingest_single_metric(request: web.Request) -> web.Response:
+    """Single-step ingest (reference ``monitoring.py:83-101``)."""
+    req = await parse_body(request, IngestSingleRequest)
+    mon = state.get_or_create_monitor(req.job_id)
+    alerts = mon.ingest(
+        TrainingMetrics(
+            step=req.step,
+            loss=req.loss,
+            learning_rate=req.learning_rate,
+            gradient_norm=req.gradient_norm,
+            throughput_tokens_per_sec=req.throughput_tokens_per_sec,
+        )
+    )
+    return json_response(alerts)
+
+
+def _require_monitor(job_id: str):
+    mon = state.get_monitor(job_id)
+    if mon is None:
+        raise ApiError(404, f"no monitor for job '{job_id}'")
+    return mon
+
+
+async def get_monitor_summary(request: web.Request) -> web.Response:
+    """Rolling-stats summary (reference ``monitoring.py:104-109``)."""
+    return json_response(_require_monitor(request.match_info["job_id"]).get_summary())
+
+
+async def get_loss_curve(request: web.Request) -> web.Response:
+    """Visualization feed (reference ``monitoring.py:112-117``)."""
+    return json_response(_require_monitor(request.match_info["job_id"]).get_loss_curve())
+
+
+async def get_alerts(request: web.Request) -> web.Response:
+    """Full alert history for a job."""
+    return json_response(_require_monitor(request.match_info["job_id"]).alerts)
+
+
+async def reset_monitor(request: web.Request) -> web.Response:
+    """Reset after checkpoint restore (reference ``monitoring.py:120-126``)."""
+    job_id = request.match_info["job_id"]
+    _require_monitor(job_id).reset()
+    return json_response({"job_id": job_id, "reset": True})
+
+
+async def list_monitored_jobs(request: web.Request) -> web.Response:
+    """All monitored job ids (reference ``monitoring.py:129-133``)."""
+    return json_response({"jobs": state.list_monitored_jobs()})
+
+
+def setup(app: web.Application, prefix: str = "/api/v1/monitoring") -> None:
+    app.router.add_post(f"{prefix}/create", create_monitor)
+    app.router.add_post(f"{prefix}/ingest", ingest_metrics)
+    app.router.add_post(f"{prefix}/ingest/single", ingest_single_metric)
+    app.router.add_get(f"{prefix}/summary/{{job_id}}", get_monitor_summary)
+    app.router.add_get(f"{prefix}/loss-curve/{{job_id}}", get_loss_curve)
+    app.router.add_get(f"{prefix}/alerts/{{job_id}}", get_alerts)
+    app.router.add_post(f"{prefix}/reset/{{job_id}}", reset_monitor)
+    app.router.add_get(f"{prefix}/jobs", list_monitored_jobs)

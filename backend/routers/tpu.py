@@ -1,0 +1,93 @@
+"""TPU fleet routes — endpoint-parity with the reference's GPU router
+(``backend/routers/gpu.py``): fleet, fleet/mock, select, devices/{i}, alerts.
+
+Route-level behavior preserved: every live route falls back to the mock
+fleet when the runtime is unreachable (reference ``gpu.py:17-19,36-40``).
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from backend import state
+from backend.http import ApiError, json_response
+from tpu_engine.tpu_manager import TPUFleetStatus
+
+
+def _fleet_or_mock() -> TPUFleetStatus:
+    try:
+        fleet = state.manager.get_fleet_status()
+        if fleet.total_devices == 0:
+            return state.manager.get_mock_fleet()
+        return fleet
+    except Exception:
+        return state.manager.get_mock_fleet()
+
+
+async def get_fleet_status(request: web.Request) -> web.Response:
+    """Live fleet telemetry (mock fallback when no runtime is available)."""
+    return json_response(_fleet_or_mock())
+
+
+async def get_mock_fleet(request: web.Request) -> web.Response:
+    """Hand-built v5e-8 fixture fleet (reference ``gpu.py:22-25``)."""
+    return json_response(state.manager.get_mock_fleet())
+
+
+async def select_best_device(request: web.Request) -> web.Response:
+    """Least-loaded schedulable chip (reference ``gpu.py:29-51``)."""
+    try:
+        min_free = float(request.query.get("min_free_hbm_gb", 0.0))
+    except ValueError:
+        raise ApiError(422, "min_free_hbm_gb must be a number")
+    if min_free < 0:
+        raise ApiError(422, "min_free_hbm_gb must be >= 0")
+    try:
+        best = state.manager.select_best_device(min_free_hbm_gb=min_free)
+    except Exception:
+        best = None
+    if best is None:
+        # Same shape as reference: fall back to the mock fleet for a usable answer.
+        best = state.manager.select_from_fleet(
+            state.manager.get_mock_fleet(), min_free_hbm_gb=min_free
+        )
+        if best is None:
+            raise ApiError(404, "no TPU device satisfies the request")
+    return json_response(best)
+
+
+async def get_device(request: web.Request) -> web.Response:
+    """Single-device view (reference ``gpu.py:54-66``)."""
+    try:
+        index = int(request.match_info["index"])
+    except ValueError:
+        raise ApiError(422, "device index must be an integer")
+    fleet = _fleet_or_mock()
+    for d in fleet.devices:
+        if d.index == index:
+            return json_response(d)
+    raise ApiError(404, f"TPU device {index} not found")
+
+
+async def get_tpu_alerts(request: web.Request) -> web.Response:
+    """Fleet alert rollup (reference ``gpu.py:69-83``)."""
+    fleet = _fleet_or_mock()
+    return json_response(
+        {
+            "total_alerts": len(fleet.fleet_alerts),
+            "alerts": fleet.fleet_alerts,
+            "devices_with_alerts": [
+                {"index": d.index, "health": d.health_status.value, "alerts": d.alerts}
+                for d in fleet.devices
+                if d.alerts
+            ],
+        }
+    )
+
+
+def setup(app: web.Application, prefix: str = "/api/v1/tpu") -> None:
+    app.router.add_get(f"{prefix}/fleet", get_fleet_status)
+    app.router.add_get(f"{prefix}/fleet/mock", get_mock_fleet)
+    app.router.add_get(f"{prefix}/select", select_best_device)
+    app.router.add_get(f"{prefix}/devices/{{index}}", get_device)
+    app.router.add_get(f"{prefix}/alerts", get_tpu_alerts)

@@ -1,0 +1,170 @@
+"""Training routes — endpoint-parity with the reference's training router
+(``backend/routers/training.py``): launch, launch/preset, presets,
+config/generate — plus real job tracking (jobs, jobs/{id}, stop), which the
+reference cannot offer because its launch is fire-and-forget.
+
+``dry_run`` defaults **True** at this layer, exactly like the reference
+(``training.py:44``; SURVEY.md §5 quirks — keep the API-safe default).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from aiohttp import web
+from pydantic import BaseModel, Field
+
+from backend import state
+from backend.http import ApiError, json_response, parse_body
+from tpu_engine.mesh_runtime import MeshConfig
+from tpu_engine.sharding import OffloadDevice, Precision, ShardingStage, TPUTrainConfig
+
+
+class TrainingLaunchRequest(BaseModel):
+    """Mirrors reference ``TrainingLaunchRequest`` (``training.py:16-45``),
+    re-based to TPU fields (mesh instead of num_gpus/num_nodes etc.)."""
+
+    model_name: str = "gpt-125m"
+    sharding_stage: int = Field(default=3, ge=0, le=3)
+    mesh: MeshConfig = Field(default_factory=MeshConfig)
+    micro_batch_size: int = Field(default=1, ge=1)
+    gradient_accumulation_steps: int = Field(default=1, ge=1)
+    seq_len: int = Field(default=2048, ge=1)
+    precision: str = "bf16"
+    learning_rate: float = Field(default=3e-4, gt=0)
+    warmup_steps: int = Field(default=100, ge=0)
+    total_steps: int = Field(default=10_000, ge=1)
+    weight_decay: float = Field(default=0.1, ge=0)
+    grad_clip_norm: float = Field(default=1.0, gt=0)
+    optimizer_offload: str = "none"
+    activation_checkpointing: bool = True
+    checkpoint_dir: Optional[str] = None
+    checkpoint_interval_steps: int = Field(default=500, ge=1)
+    max_steps: Optional[int] = Field(default=None, ge=1, description="stop early after N steps")
+    watch_preemption: bool = False
+    dry_run: bool = True  # API-safe default (reference training.py:44)
+
+
+class PresetLaunchRequest(BaseModel):
+    """Mirrors reference ``PresetLaunchRequest`` (``training.py:47-53``)."""
+
+    preset_name: str
+    overrides: dict[str, Any] = Field(default_factory=dict)
+    max_steps: Optional[int] = Field(default=None, ge=1)
+    dry_run: bool = True
+
+
+def _to_config(req: TrainingLaunchRequest) -> TPUTrainConfig:
+    try:
+        return TPUTrainConfig(
+            model_name=req.model_name,
+            sharding_stage=ShardingStage(req.sharding_stage),
+            mesh=req.mesh,
+            micro_batch_size=req.micro_batch_size,
+            gradient_accumulation_steps=req.gradient_accumulation_steps,
+            seq_len=req.seq_len,
+            precision=Precision(req.precision),
+            learning_rate=req.learning_rate,
+            warmup_steps=req.warmup_steps,
+            total_steps=req.total_steps,
+            weight_decay=req.weight_decay,
+            grad_clip_norm=req.grad_clip_norm,
+            optimizer_offload=OffloadDevice(req.optimizer_offload),
+            activation_checkpointing=req.activation_checkpointing,
+            checkpoint_dir=req.checkpoint_dir,
+            checkpoint_interval_steps=req.checkpoint_interval_steps,
+        )
+    except ValueError as e:
+        raise ApiError(422, str(e))
+
+
+async def launch_training(request: web.Request) -> web.Response:
+    """Launch (or dry-run) a supervised in-process training job
+    (reference ``launch_training``, ``training.py:56-80``)."""
+    req = await parse_body(request, TrainingLaunchRequest)
+    config = _to_config(req)
+    result = state.launcher.launch(
+        config,
+        dry_run=req.dry_run,
+        max_steps=req.max_steps,
+        watch_preemption=req.watch_preemption,
+    )
+    return json_response(result)
+
+
+async def launch_from_preset(request: web.Request) -> web.Response:
+    """Launch from a named preset with overrides (reference ``training.py:83-97``)."""
+    req = await parse_body(request, PresetLaunchRequest)
+    presets = state.launcher.presets()
+    if req.preset_name not in presets:
+        raise ApiError(
+            404, f"preset '{req.preset_name}' not found; available: {sorted(presets)}"
+        )
+    config = presets[req.preset_name]
+    if req.overrides:
+        try:
+            config = TPUTrainConfig(**{**config.model_dump(), **req.overrides})
+        except ValueError as e:
+            raise ApiError(422, str(e))
+    result = state.launcher.launch(config, dry_run=req.dry_run, max_steps=req.max_steps)
+    return json_response(result)
+
+
+async def list_presets(request: web.Request) -> web.Response:
+    """Named config registry (reference ``training.py:101-118``)."""
+    return json_response(
+        {
+            name: {
+                "model_name": cfg.model_name,
+                "sharding_stage": int(cfg.sharding_stage),
+                "mesh": cfg.mesh.model_dump(),
+                "micro_batch_size": cfg.micro_batch_size,
+                "gradient_accumulation_steps": cfg.gradient_accumulation_steps,
+                "effective_batch_size": cfg.effective_batch_size,
+                "seq_len": cfg.seq_len,
+                "precision": cfg.precision.value,
+                "optimizer_offload": cfg.optimizer_offload.value,
+            }
+            for name, cfg in state.launcher.presets().items()
+        }
+    )
+
+
+async def generate_config(request: web.Request) -> web.Response:
+    """Plan generation without launching (reference ``training.py:121-153``)."""
+    req = await parse_body(request, TrainingLaunchRequest)
+    config = _to_config(req)
+    return json_response(
+        {"config": config.model_dump(mode="json"), "plan": state.launcher.generate_plan(config)}
+    )
+
+
+async def list_jobs(request: web.Request) -> web.Response:
+    """All launched jobs with live status (no reference analogue — its
+    launches are untracked after Popen, ``deepspeed_launcher.py:354-362``)."""
+    return json_response({"jobs": state.launcher.list_jobs()})
+
+
+async def get_job(request: web.Request) -> web.Response:
+    job_id = request.match_info["job_id"]
+    job = state.launcher.get_job(job_id)
+    if job is None:
+        raise ApiError(404, f"job '{job_id}' not found")
+    return json_response(job.describe())
+
+
+async def stop_job(request: web.Request) -> web.Response:
+    job_id = request.match_info["job_id"]
+    if not state.launcher.stop_job(job_id):
+        raise ApiError(404, f"job '{job_id}' not found")
+    return json_response({"job_id": job_id, "stopped": True})
+
+
+def setup(app: web.Application, prefix: str = "/api/v1/training") -> None:
+    app.router.add_post(f"{prefix}/launch", launch_training)
+    app.router.add_post(f"{prefix}/launch/preset", launch_from_preset)
+    app.router.add_get(f"{prefix}/presets", list_presets)
+    app.router.add_post(f"{prefix}/config/generate", generate_config)
+    app.router.add_get(f"{prefix}/jobs", list_jobs)
+    app.router.add_get(f"{prefix}/jobs/{{job_id}}", get_job)
+    app.router.add_post(f"{prefix}/jobs/{{job_id}}/stop", stop_job)

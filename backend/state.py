@@ -1,0 +1,59 @@
+"""Shared control-plane state: engine singletons and per-job monitors.
+
+The reference scatters its singletons across router modules
+(``backend/routers/gpu.py:9``, ``training.py:13``, ``monitoring.py:14``) and
+mutates the monitor dict without a lock (racy under multi-worker servers —
+SURVEY.md §5 race detection). Centralised here, with a lock, and with
+**unified job identity**: monitors for jobs launched through this control
+plane are the supervisor's own monitors (the reference keeps two unlinked
+job-id namespaces — SURVEY.md §5 quirks).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from tpu_engine.launcher import TPULauncher
+from tpu_engine.loss_monitor import LossSpikeMonitor, MonitorConfig
+from tpu_engine.tpu_manager import TPUManager
+
+manager = TPUManager()
+launcher = TPULauncher()
+
+_monitors: dict[str, LossSpikeMonitor] = {}
+_monitors_lock = threading.Lock()
+
+
+def get_monitor(job_id: str) -> Optional[LossSpikeMonitor]:
+    """Monitor for a job: the supervisor's own monitor for launched jobs,
+    else a standalone HTTP-ingest monitor if one was created."""
+    job = launcher.get_job(job_id)
+    if job is not None:
+        return job.monitor
+    with _monitors_lock:
+        return _monitors.get(job_id)
+
+
+def get_or_create_monitor(
+    job_id: str, config: Optional[MonitorConfig] = None
+) -> LossSpikeMonitor:
+    job = launcher.get_job(job_id)
+    if job is not None:
+        return job.monitor
+    with _monitors_lock:
+        if job_id not in _monitors:
+            _monitors[job_id] = LossSpikeMonitor(job_id=job_id, config=config)
+        return _monitors[job_id]
+
+
+def list_monitored_jobs() -> list[str]:
+    with _monitors_lock:
+        external = set(_monitors)
+    launched = {j["job_id"] for j in launcher.list_jobs()}
+    return sorted(external | launched)
+
+
+def remove_monitor(job_id: str) -> bool:
+    with _monitors_lock:
+        return _monitors.pop(job_id, None) is not None

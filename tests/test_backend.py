@@ -1,0 +1,236 @@
+"""Control-plane API tests: real aiohttp server on an ephemeral port, driven
+with httpx against the real engine on the 8-virtual-device CPU mesh — the
+reference has no tests at all (SURVEY.md §4)."""
+
+import asyncio
+import threading
+import time
+
+import httpx
+import pytest
+from aiohttp import web
+
+from backend.main import create_app
+
+
+@pytest.fixture(scope="module")
+def client():
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    state = {}
+
+    def run():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(create_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        loop.run_until_complete(site.start())
+        state["port"] = runner.addresses[0][1]
+        started.set()
+        loop.run_forever()
+        loop.run_until_complete(runner.cleanup())
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(timeout=30)
+    with httpx.Client(base_url=f"http://127.0.0.1:{state['port']}", timeout=60) as c:
+        yield c
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=10)
+
+
+# -- assembly ---------------------------------------------------------------
+
+
+def test_root_and_health(client):
+    r = client.get("/")
+    assert r.status_code == 200
+    assert "features" in r.json()
+    h = client.get("/health").json()
+    assert h["status"] == "healthy"
+    assert h["devices"] == 8
+
+
+def test_cors_headers(client):
+    r = client.get("/health")
+    assert r.headers.get("Access-Control-Allow-Origin") == "*"
+
+
+def test_topology_is_mounted_and_real(client):
+    # The reference's topology router exists but is never mounted (SURVEY §2 C9).
+    r = client.get("/api/v1/topology")
+    assert r.status_code == 200
+    body = r.json()
+    assert body["num_devices"] == 8
+    assert body["mesh"]["axes"]["data"] == 8
+
+
+# -- tpu router -------------------------------------------------------------
+
+
+def test_fleet_and_mock(client):
+    fleet = client.get("/api/v1/tpu/fleet").json()
+    assert fleet["total_devices"] == 8
+    mock = client.get("/api/v1/tpu/fleet/mock").json()
+    assert mock["total_devices"] == 8
+    assert mock["available_devices"] == 7
+    assert mock["devices"][5]["health_status"] == "warning"
+
+
+def test_select_and_device_detail(client):
+    best = client.get("/api/v1/tpu/select").json()
+    assert best is not None and "index" in best
+    assert client.get("/api/v1/tpu/devices/0").status_code == 200
+    assert client.get("/api/v1/tpu/devices/99").status_code == 404
+    assert client.get("/api/v1/tpu/select", params={"min_free_hbm_gb": "bogus"}).status_code == 422
+
+
+def test_alerts_endpoint(client):
+    r = client.get("/api/v1/tpu/alerts").json()
+    assert "total_alerts" in r and "alerts" in r
+
+
+# -- training router --------------------------------------------------------
+
+
+def test_launch_dry_run_default(client):
+    r = client.post("/api/v1/training/launch", json={"model_name": "gpt-125m"})
+    assert r.status_code == 200
+    body = r.json()
+    assert body["status"] == "dry_run"  # dry_run defaults True at the API layer
+    assert body["plan"]["sharding"]["stage"] == 3
+    # No job created by a dry run.
+    jobs = client.get("/api/v1/training/jobs").json()["jobs"]
+    assert body["job_id"] not in [j["job_id"] for j in jobs]
+
+
+def test_config_generate(client):
+    r = client.post(
+        "/api/v1/training/config/generate",
+        json={"model_name": "llama-7b", "sharding_stage": 1, "mesh": {"data": 1, "fsdp": 4}},
+    )
+    assert r.status_code == 200
+    plan = r.json()["plan"]
+    assert plan["sharding"]["semantics"]["optimizer_state"] == "sharded over fsdp"
+    assert plan["sharding"]["semantics"]["params"] == "replicated"
+
+
+def test_presets_listing(client):
+    r = client.get("/api/v1/training/presets").json()
+    assert {"125m", "7b", "13b", "70b"} <= set(r)
+    assert r["7b"]["effective_batch_size"] == 2 * 16 * 4
+
+
+def test_preset_launch_not_found_and_overrides(client):
+    assert (
+        client.post("/api/v1/training/launch/preset", json={"preset_name": "900b"}).status_code
+        == 404
+    )
+    r = client.post(
+        "/api/v1/training/launch/preset",
+        json={"preset_name": "7b", "overrides": {"micro_batch_size": 4}, "dry_run": True},
+    )
+    assert r.status_code == 200
+    assert r.json()["plan"]["batch"]["micro_batch_size"] == 4
+
+
+def test_invalid_bodies_rejected(client):
+    r = client.post(
+        "/api/v1/training/launch", json={"model_name": "gpt-125m", "precision": "fp64"}
+    )
+    assert r.status_code == 422
+    r = client.post(
+        "/api/v1/training/launch", json={"micro_batch_size": -1}
+    )
+    assert r.status_code == 422
+    r = client.post(
+        "/api/v1/training/launch",
+        content=b"not json",
+        headers={"content-type": "application/json"},
+    )
+    assert r.status_code == 422
+
+
+def test_real_launch_job_lifecycle(client):
+    r = client.post(
+        "/api/v1/training/launch",
+        json={
+            "model_name": "gpt-tiny",
+            "mesh": {"data": 2, "fsdp": 4},
+            "micro_batch_size": 1,
+            "seq_len": 32,
+            "precision": "fp32",
+            "total_steps": 4,
+            "activation_checkpointing": False,
+            "warmup_steps": 1,
+            "dry_run": False,
+        },
+    )
+    assert r.status_code == 200
+    job_id = r.json()["job_id"]
+    assert r.json()["status"] == "launched"
+
+    deadline = time.time() + 240
+    status = None
+    while time.time() < deadline:
+        status = client.get(f"/api/v1/training/jobs/{job_id}").json()
+        if status["status"] in ("completed", "failed"):
+            break
+        time.sleep(1)
+    assert status["status"] == "completed", status
+    assert status["current_step"] == 4
+
+    # Unified job identity: the monitoring routes see the supervisor's monitor.
+    summary = client.get(f"/api/v1/monitoring/summary/{job_id}").json()
+    assert summary["total_steps_seen"] == 4
+    curve = client.get(f"/api/v1/monitoring/loss-curve/{job_id}").json()
+    assert len(curve["losses"]) == 4
+    assert job_id in client.get("/api/v1/monitoring/jobs").json()["jobs"]
+
+
+def test_stop_unknown_job(client):
+    assert client.post("/api/v1/training/jobs/nope/stop").status_code == 404
+
+
+# -- monitoring router ------------------------------------------------------
+
+
+def test_monitor_create_ingest_summary_reset(client):
+    jid = "external-job-1"
+    r = client.post("/api/v1/monitoring/create", json={"job_id": jid})
+    assert r.json()["created"]
+
+    metrics = [{"step": i, "loss": 2.0 + 0.001 * i} for i in range(30)]
+    r = client.post("/api/v1/monitoring/ingest", json={"job_id": jid, "metrics": metrics})
+    assert r.status_code == 200 and r.json() == []
+
+    r = client.post(
+        "/api/v1/monitoring/ingest/single", json={"job_id": jid, "step": 30, "loss": 50.0}
+    )
+    alerts = r.json()
+    assert any(a["alert_type"] == "loss_spike" for a in alerts)
+
+    summary = client.get(f"/api/v1/monitoring/summary/{jid}").json()
+    assert summary["total_steps_seen"] == 31
+    assert summary["alerts_by_type"]["loss_spike"] == 1
+
+    assert client.post(f"/api/v1/monitoring/reset/{jid}").json()["reset"]
+    assert client.get(f"/api/v1/monitoring/summary/{jid}").json()["total_steps_seen"] == 0
+
+
+def test_monitor_divergence_alert_over_http(client):
+    jid = "external-job-2"
+    r = client.post(
+        "/api/v1/monitoring/ingest/single", json={"job_id": jid, "step": 0, "loss": 2e9}
+    )
+    assert any(
+        a["alert_type"] == "divergence" and a["severity"] == "critical" for a in r.json()
+    )
+    alerts = client.get(f"/api/v1/monitoring/alerts/{jid}").json()
+    assert len(alerts) == 1
+
+
+def test_monitor_404s(client):
+    assert client.get("/api/v1/monitoring/summary/ghost").status_code == 404
+    assert client.get("/api/v1/monitoring/loss-curve/ghost").status_code == 404
+    assert client.post("/api/v1/monitoring/reset/ghost").status_code == 404

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import math
 import statistics
+import threading
 import time
 from collections import deque
 from enum import Enum
@@ -94,6 +95,10 @@ class LossSpikeMonitor:
     def __init__(self, job_id: str = "", config: Optional[MonitorConfig] = None):
         self.job_id = job_id
         self.config = config or MonitorConfig()
+        # The training thread ingests while HTTP handlers read summaries:
+        # all public entry points take this lock (the reference mutates its
+        # monitor dict unlocked — SURVEY.md §5 race detection).
+        self._lock = threading.RLock()
         self._loss_window: deque[float] = deque(maxlen=self.config.window_size)
         self._lr_window: deque[float] = deque(maxlen=self.config.window_size)
         self._metrics: deque[TrainingMetrics] = deque(maxlen=self.config.max_history)
@@ -107,6 +112,10 @@ class LossSpikeMonitor:
     # -- ingestion (the per-step hot path; reference ``ingest`` :111-243) ----
 
     def ingest(self, m: TrainingMetrics) -> list[SpikeAlert]:
+        with self._lock:
+            return self._ingest_locked(m)
+
+    def _ingest_locked(self, m: TrainingMetrics) -> list[SpikeAlert]:
         alerts: list[SpikeAlert] = []
 
         # 1. Divergence: NaN/Inf — EARLY RETURN, do not append to history.
@@ -292,12 +301,18 @@ class LossSpikeMonitor:
 
     @property
     def alerts(self) -> list[SpikeAlert]:
-        return list(self._alerts)
+        with self._lock:
+            return list(self._alerts)
 
     def has_critical_alert(self) -> bool:
-        return any(a.severity == AlertSeverity.CRITICAL for a in self._alerts)
+        with self._lock:
+            return any(a.severity == AlertSeverity.CRITICAL for a in self._alerts)
 
     def get_summary(self) -> dict[str, Any]:
+        with self._lock:
+            return self._summary_locked()
+
+    def _summary_locked(self) -> dict[str, Any]:
         losses = [m.loss for m in self._metrics if not (math.isnan(m.loss) or math.isinf(m.loss))]
         return {
             "job_id": self.job_id,
@@ -318,6 +333,10 @@ class LossSpikeMonitor:
 
     def get_loss_curve(self) -> dict[str, list]:
         """Visualization feed: steps/losses/lrs/grad-norms/spike-steps arrays."""
+        with self._lock:
+            return self._loss_curve_locked()
+
+    def _loss_curve_locked(self) -> dict[str, list]:
         return {
             "steps": [m.step for m in self._metrics],
             "losses": [m.loss for m in self._metrics],
@@ -329,6 +348,10 @@ class LossSpikeMonitor:
 
     def reset(self) -> None:
         """Clear all state, e.g. after checkpoint restore (reference :273-280)."""
+        with self._lock:
+            self._reset_locked()
+
+    def _reset_locked(self) -> None:
         self._loss_window.clear()
         self._lr_window.clear()
         self._metrics.clear()

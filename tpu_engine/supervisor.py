@@ -284,6 +284,9 @@ class TrainingJob:
         # into the diverged timeline (latest-step restore would prefer them).
         self.ckpt.delete_after(int(step))
         self._pending_stable = [s for s in self._pending_stable if s <= int(step)]
+        # New timeline: the old anomaly step must not veto fresh post-rollback
+        # checkpoints from ever being marked stable.
+        self._last_critical_step = -1
         new_scale = jax.device_get(state["lr_scale"]) * self.lr_cut_on_rollback
         state["lr_scale"] = jax.device_put(
             jax.numpy.asarray(new_scale, jax.numpy.float32),

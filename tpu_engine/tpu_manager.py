@@ -147,9 +147,10 @@ class TPUManager:
             hbm_total = limit / 2**30
             hbm_used = used / 2**30
         if hbm_total <= 0.0:
-            for prefix, gib in _DEFAULT_HBM_GIB.items():
+            # Longest prefix wins: "TPU v5p" must not fall into "TPU v5"'s bucket.
+            for prefix in sorted(_DEFAULT_HBM_GIB, key=len, reverse=True):
                 if kind.startswith(prefix):
-                    hbm_total = gib
+                    hbm_total = _DEFAULT_HBM_GIB[prefix]
                     break
         util = (hbm_used / hbm_total * 100.0) if hbm_total > 0 else 0.0
         coords = getattr(d, "coords", None)
@@ -313,6 +314,13 @@ class TPUManager:
         availability + free-memory requirement, sort by (−free HBM, duty).
         """
         fleet = self.get_fleet_status(metrics=metrics, metrics_json=metrics_json)
+        return self.select_from_fleet(fleet, min_free_hbm_gb=min_free_hbm_gb)
+
+    @staticmethod
+    def select_from_fleet(
+        fleet: TPUFleetStatus, min_free_hbm_gb: float = 0.0
+    ) -> Optional[TPUDevice]:
+        """The selection policy, shared by live and mock/fallback paths."""
         candidates = [
             d for d in fleet.devices if d.is_available and d.hbm_free_gb >= min_free_hbm_gb
         ]
