@@ -45,19 +45,32 @@ class IngestSingleRequest(BaseModel):
     throughput_tokens_per_sec: Optional[float] = None
 
 
+def _reject_supervised_write(job_id: str) -> None:
+    """Supervised jobs own their monitors: external writes would pollute the
+    rolling stats that drive auto-rollback. Reads stay unified; writes 409."""
+    if state.is_supervised(job_id):
+        raise ApiError(
+            409,
+            f"job '{job_id}' is supervised by this control plane; its monitor "
+            "is read-only over HTTP (use the job endpoints to manage it)",
+        )
+
+
 async def create_monitor(request: web.Request) -> web.Response:
     """Create (or return) a monitor for a job (reference ``monitoring.py:49-64``)."""
     req = await parse_body(request, CreateMonitorRequest)
-    mon = state.get_or_create_monitor(req.job_id, req.config)
+    _reject_supervised_write(req.job_id)
+    mon, created = state.get_or_create_monitor(req.job_id, req.config)
     return json_response(
-        {"job_id": req.job_id, "created": True, "config": mon.config.model_dump()}
+        {"job_id": req.job_id, "created": created, "config": mon.config.model_dump()}
     )
 
 
 async def ingest_metrics(request: web.Request) -> web.Response:
     """Batch metrics ingest → alerts (reference ``monitoring.py:67-80``)."""
     req = await parse_body(request, IngestRequest)
-    mon = state.get_or_create_monitor(req.job_id)
+    _reject_supervised_write(req.job_id)
+    mon, _ = state.get_or_create_monitor(req.job_id)
     alerts: list[SpikeAlert] = []
     for m in req.metrics:
         alerts.extend(mon.ingest(m))
@@ -67,7 +80,8 @@ async def ingest_metrics(request: web.Request) -> web.Response:
 async def ingest_single_metric(request: web.Request) -> web.Response:
     """Single-step ingest (reference ``monitoring.py:83-101``)."""
     req = await parse_body(request, IngestSingleRequest)
-    mon = state.get_or_create_monitor(req.job_id)
+    _reject_supervised_write(req.job_id)
+    mon, _ = state.get_or_create_monitor(req.job_id)
     alerts = mon.ingest(
         TrainingMetrics(
             step=req.step,
@@ -105,6 +119,7 @@ async def get_alerts(request: web.Request) -> web.Response:
 async def reset_monitor(request: web.Request) -> web.Response:
     """Reset after checkpoint restore (reference ``monitoring.py:120-126``)."""
     job_id = request.match_info["job_id"]
+    _reject_supervised_write(job_id)
     _require_monitor(job_id).reset()
     return json_response({"job_id": job_id, "reset": True})
 

@@ -35,24 +35,22 @@ async def get_mock_fleet(request: web.Request) -> web.Response:
 
 
 async def select_best_device(request: web.Request) -> web.Response:
-    """Least-loaded schedulable chip (reference ``gpu.py:29-51``)."""
+    """Least-loaded schedulable chip (reference ``gpu.py:29-51``).
+
+    The mock-fleet fallback applies only when the runtime itself is
+    unreachable/empty; a reachable fleet with no qualifying device is an
+    honest 404, never a fabricated mock answer.
+    """
     try:
         min_free = float(request.query.get("min_free_hbm_gb", 0.0))
     except ValueError:
         raise ApiError(422, "min_free_hbm_gb must be a number")
     if min_free < 0:
         raise ApiError(422, "min_free_hbm_gb must be >= 0")
-    try:
-        best = state.manager.select_best_device(min_free_hbm_gb=min_free)
-    except Exception:
-        best = None
+    fleet = _fleet_or_mock()
+    best = state.manager.select_from_fleet(fleet, min_free_hbm_gb=min_free)
     if best is None:
-        # Same shape as reference: fall back to the mock fleet for a usable answer.
-        best = state.manager.select_from_fleet(
-            state.manager.get_mock_fleet(), min_free_hbm_gb=min_free
-        )
-        if best is None:
-            raise ApiError(404, "no TPU device satisfies the request")
+        raise ApiError(404, "no TPU device satisfies the request")
     return json_response(best)
 
 

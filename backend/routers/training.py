@@ -37,6 +37,7 @@ class TrainingLaunchRequest(BaseModel):
     weight_decay: float = Field(default=0.1, ge=0)
     grad_clip_norm: float = Field(default=1.0, gt=0)
     optimizer_offload: str = "none"
+    attention_impl: str = "auto"  # auto | xla | flash | ring
     activation_checkpointing: bool = True
     checkpoint_dir: Optional[str] = None
     checkpoint_interval_steps: int = Field(default=500, ge=1)
@@ -70,6 +71,7 @@ def _to_config(req: TrainingLaunchRequest) -> TPUTrainConfig:
             weight_decay=req.weight_decay,
             grad_clip_norm=req.grad_clip_norm,
             optimizer_offload=OffloadDevice(req.optimizer_offload),
+            attention_impl=req.attention_impl,
             activation_checkpointing=req.activation_checkpointing,
             checkpoint_dir=req.checkpoint_dir,
             checkpoint_interval_steps=req.checkpoint_interval_steps,

@@ -25,9 +25,20 @@ _monitors: dict[str, LossSpikeMonitor] = {}
 _monitors_lock = threading.Lock()
 
 
+def is_supervised(job_id: str) -> bool:
+    """True when the job was launched through this control plane (its monitor
+    is owned by the supervisor's training thread)."""
+    return launcher.get_job(job_id) is not None
+
+
 def get_monitor(job_id: str) -> Optional[LossSpikeMonitor]:
     """Monitor for a job: the supervisor's own monitor for launched jobs,
-    else a standalone HTTP-ingest monitor if one was created."""
+    else a standalone HTTP-ingest monitor if one was created.
+
+    Read paths only — HTTP writes into a supervisor-owned monitor would
+    pollute the rolling stats that drive auto-rollback (the router returns
+    409 for those; see ``backend/routers/monitoring.py``).
+    """
     job = launcher.get_job(job_id)
     if job is not None:
         return job.monitor
@@ -37,14 +48,16 @@ def get_monitor(job_id: str) -> Optional[LossSpikeMonitor]:
 
 def get_or_create_monitor(
     job_id: str, config: Optional[MonitorConfig] = None
-) -> LossSpikeMonitor:
-    job = launcher.get_job(job_id)
-    if job is not None:
-        return job.monitor
+) -> tuple[LossSpikeMonitor, bool]:
+    """External-job monitor registry; returns (monitor, created).
+
+    Callers must have rejected supervised job ids first (write-safety).
+    """
     with _monitors_lock:
-        if job_id not in _monitors:
+        created = job_id not in _monitors
+        if created:
             _monitors[job_id] = LossSpikeMonitor(job_id=job_id, config=config)
-        return _monitors[job_id]
+        return _monitors[job_id], created
 
 
 def list_monitored_jobs() -> list[str]:

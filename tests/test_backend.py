@@ -187,6 +187,17 @@ def test_real_launch_job_lifecycle(client):
     assert len(curve["losses"]) == 4
     assert job_id in client.get("/api/v1/monitoring/jobs").json()["jobs"]
 
+    # Supervisor-owned monitors are read-only over HTTP: writes must 409.
+    r = client.post(
+        "/api/v1/monitoring/ingest/single",
+        json={"job_id": job_id, "step": 999, "loss": 1e9},
+    )
+    assert r.status_code == 409
+    assert client.post(f"/api/v1/monitoring/reset/{job_id}").status_code == 409
+    assert client.post("/api/v1/monitoring/create", json={"job_id": job_id}).status_code == 409
+    # The fake metric did not pollute the real history.
+    assert client.get(f"/api/v1/monitoring/summary/{job_id}").json()["total_steps_seen"] == 4
+
 
 def test_stop_unknown_job(client):
     assert client.post("/api/v1/training/jobs/nope/stop").status_code == 404
@@ -199,6 +210,8 @@ def test_monitor_create_ingest_summary_reset(client):
     jid = "external-job-1"
     r = client.post("/api/v1/monitoring/create", json={"job_id": jid})
     assert r.json()["created"]
+    # Idempotent re-create reports created:false (config is NOT replaced).
+    assert client.post("/api/v1/monitoring/create", json={"job_id": jid}).json()["created"] is False
 
     metrics = [{"step": i, "loss": 2.0 + 0.001 * i} for i in range(30)]
     r = client.post("/api/v1/monitoring/ingest", json={"job_id": jid, "metrics": metrics})

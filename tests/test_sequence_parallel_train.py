@@ -1,0 +1,57 @@
+"""End-to-end sequence-parallel training: a >1 'sequence' mesh axis trains
+with ring attention and matches the non-SP trajectory."""
+
+import jax
+import numpy as np
+
+from tpu_engine.mesh_runtime import MeshConfig
+from tpu_engine.sharding import Precision, ShardingStage, TPUTrainConfig
+from tpu_engine.train import build_train_program
+
+
+def _cfg(**kw):
+    base = dict(
+        model_name="gpt-tiny",
+        sharding_stage=ShardingStage.FULL_PARTITIONING,
+        mesh=MeshConfig(data=2, fsdp=4),
+        micro_batch_size=1,
+        gradient_accumulation_steps=1,
+        seq_len=64,
+        precision=Precision.FP32,
+        learning_rate=1e-2,
+        warmup_steps=2,
+        total_steps=100,
+        activation_checkpointing=False,
+    )
+    base.update(kw)
+    return TPUTrainConfig(**base)
+
+
+def _run(cfg, n=3):
+    prog = build_train_program(cfg)
+    state = prog.init(jax.random.PRNGKey(0))
+    losses = []
+    for _ in range(n):
+        state, m = prog.step(state, prog.synthetic_batch(0))
+        losses.append(float(m["loss"]))
+    return prog, losses
+
+
+def test_sequence_parallel_training_matches_baseline():
+    # Same global batch (8×64 tokens): SP mesh dp=2 × micro 4 vs ref mesh
+    # dp=8 × micro 1 — synthetic_batch depends only on shape+seed, so the
+    # trajectories must agree numerically.
+    prog_sp, losses_sp = _run(
+        _cfg(mesh=MeshConfig(data=1, fsdp=2, sequence=4), micro_batch_size=4)
+    )
+    assert prog_sp.model_config.attention_impl == "ring"
+    _, losses_ref = _run(_cfg(mesh=MeshConfig(data=2, fsdp=4), micro_batch_size=1))
+    np.testing.assert_allclose(losses_sp, losses_ref, rtol=1e-3)
+    assert losses_sp[-1] < losses_sp[0]
+
+
+def test_sequence_parallel_batch_sharded_over_sequence():
+    prog, _ = _run(_cfg(mesh=MeshConfig(data=1, fsdp=2, sequence=4)), n=1)
+    assert prog.batch_sharding.spec == jax.sharding.PartitionSpec(
+        None, ("data", "fsdp"), "sequence"
+    )

@@ -178,15 +178,26 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
 
 
-def _attention(q, k, v, impl: str):
-    """Causal attention via the ops package (single implementation home:
-    Pallas flash kernel on TPU, XLA fallback — ``tpu_engine/ops``)."""
+def _attention(q, k, v, impl: str, mesh=None):
+    """Causal attention dispatch:
+
+    - ``"ring"`` — sequence-parallel ring attention over the mesh's
+      ``sequence`` axis (``tpu_engine/parallel/ring_attention.py``);
+    - ``"flash"`` — Pallas TPU flash kernel (``tpu_engine/ops``);
+    - ``"xla"``  — plain XLA attention (fallback / reference semantics).
+    """
+    if impl == "ring":
+        if mesh is None:
+            raise ValueError("attention_impl='ring' requires a mesh")
+        from tpu_engine.parallel.ring_attention import ring_mha
+
+        return ring_mha(q, k, v, mesh=mesh, causal=True)
     from tpu_engine.ops import flash_attention  # lazy: avoids import cycles
 
     return flash_attention.mha(q, k, v, causal=True, force_xla=(impl != "flash"))
 
 
-def _block(x, layer_params, cfg: ModelConfig, positions):
+def _block(x, layer_params, cfg: ModelConfig, positions, mesh=None):
     """One transformer block. x: [B, S, D]."""
     B, S, D = x.shape
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -197,7 +208,7 @@ def _block(x, layer_params, cfg: ModelConfig, positions):
     v = jnp.einsum("bsd,de->bse", h, layer_params["v"]["kernel"]).reshape(B, S, KV, HD)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    attn = _attention(q, k, v, cfg.attention_impl)
+    attn = _attention(q, k, v, cfg.attention_impl, mesh=mesh)
     attn = attn.reshape(B, S, H * HD)
     x = x + jnp.einsum("bse,ed->bsd", attn, layer_params["o"]["kernel"])
 
@@ -224,8 +235,14 @@ def forward(
     remat: bool = False,
     remat_policy: str = "nothing_saveable",
     positions: Optional[jax.Array] = None,
+    mesh=None,
 ) -> jax.Array:
-    """Forward pass: tokens [B, S] int32 → logits [B, S, V] float32."""
+    """Forward pass: tokens [B, S] int32 → logits [B, S, V] float32.
+
+    ``mesh`` is only needed for ``attention_impl="ring"`` (sequence
+    parallelism), where the attention runs as a shard_map over the mesh's
+    ``sequence`` axis.
+    """
     B, S = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
@@ -238,7 +255,7 @@ def forward(
                                params["layers"])
 
     def scan_body(carry, layer_params):
-        y = _block(carry, layer_params, cfg, positions)
+        y = _block(carry, layer_params, cfg, positions, mesh=mesh)
         return y, None
 
     body = scan_body

@@ -1,0 +1,198 @@
+"""First-party Pallas TPU flash attention (causal), with a memory-bounded
+blockwise backward pass.
+
+Forward: one Pallas program per (batch·head, Q-block); K/V stream through
+VMEM while an online-softmax accumulator keeps peak memory at
+O(BLOCK_Q · D + BLOCK_Q · BLOCK_K) — the S×S score matrix is never
+materialised (the ``_xla_mha`` fallback materialises it; kernel pattern per
+the Pallas TPU guide's double-buffered matmul/softmax recipes).
+
+Backward: custom_vjp. The forward saves the log-sum-exp rows; the backward
+reconstructs attention probabilities block-by-block in plain JAX
+(``lax.scan`` over K/V blocks) — memory O(S · BLOCK_K), XLA-fused, and it
+avoids a second Pallas kernel while keeping the flash memory property.
+
+Layout: q/k/v [B, S, H, D] (GQA expanded by the caller, ``flash_attention.mha``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+class FlashUnsupported(Exception):
+    """Raised (at trace time) when a shape/config can't use the flash kernel."""
+
+
+def _pick_block(s: int) -> int:
+    for b in (512, 256, 128, 64):
+        if s % b == 0:
+            return b
+    return 0  # caller falls back to XLA attention
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int, block_k: int,
+                scale: float):
+    q_idx = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # [BQ, D]
+
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+
+    q_pos = q_idx * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [BQ, BK]
+        k_pos = j * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    # Causal with BLOCK_Q == BLOCK_K: only blocks j <= q_idx contribute.
+    m, l, acc = lax.fori_loop(0, q_idx + 1, body, (m0, l0, acc0))
+
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(l_safe)
+
+
+def _flash_fwd(q, k, v, block: int, interpret: bool):
+    """q/k/v: [BH, S, D] → (o [BH, S, D], lse [BH, S])."""
+    BH, S, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    grid = (BH, S // block)
+    kernel = partial(_fwd_kernel, block_q=block, block_k=block, scale=scale)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block, D), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block, D), lambda bh, i: (bh, i, 0)),
+            # lse as [BH, 1, S]: TPU block tiling needs the last two block
+            # dims (1, block) to be (equal-to-array, 128-divisible).
+            pl.BlockSpec((1, 1, block), lambda bh, i: (bh, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, 1, S), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse.reshape(BH, S)
+
+
+# ---------------------------------------------------------------------------
+# Backward (blockwise JAX, flash memory profile)
+# ---------------------------------------------------------------------------
+
+
+def _flash_bwd(block: int, res, do):
+    q, k, v, o, lse = res  # q/k/v/o: [BH, S, D]; lse: [BH, S]
+    BH, S, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+
+    q32 = q.astype(jnp.float32)
+    do32 = do.astype(jnp.float32)
+    # D_i = rowsum(dO ∘ O) — the softmax-jacobian diagonal term.
+    delta = jnp.sum(do32 * o.astype(jnp.float32), axis=-1)  # [BH, S]
+    q_pos = jnp.arange(S)
+
+    def kv_block(carry, j):
+        dq_acc = carry
+        k_blk = lax.dynamic_slice_in_dim(k, j * block, block, axis=1).astype(jnp.float32)
+        v_blk = lax.dynamic_slice_in_dim(v, j * block, block, axis=1).astype(jnp.float32)
+        s = jnp.einsum("zqd,zkd->zqk", q32, k_blk) * scale  # [BH, S, BK]
+        k_pos = j * block + jnp.arange(block)
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None], s, _NEG_INF)
+        p = jnp.exp(s - lse[..., None])  # [BH, S, BK]
+        p = jnp.where(mask[None], p, 0.0)
+        dv = jnp.einsum("zqk,zqd->zkd", p, do32)
+        dp = jnp.einsum("zqd,zkd->zqk", do32, v_blk)
+        ds = p * (dp - delta[..., None]) * scale
+        dk = jnp.einsum("zqk,zqd->zkd", ds, q32)
+        dq_acc = dq_acc + jnp.einsum("zqk,zkd->zqd", ds, k_blk)
+        return dq_acc, (dk, dv)
+
+    dq0 = jnp.zeros((BH, S, D), jnp.float32)
+    dq, (dk_blocks, dv_blocks) = lax.scan(kv_block, dq0, jnp.arange(S // block))
+    dk = jnp.moveaxis(dk_blocks, 0, 1).reshape(BH, S, D)
+    dv = jnp.moveaxis(dv_blocks, 0, 1).reshape(BH, S, D)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Public entry (custom_vjp over [BH, S, D] layout)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_bhsd(q, k, v, block: int, interpret: bool):
+    o, _ = _flash_fwd(q, k, v, block, interpret)
+    return o
+
+
+def _flash_bhsd_fwd(q, k, v, block, interpret):
+    o, lse = _flash_fwd(q, k, v, block, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bhsd_bwd(block, interpret, res, do):
+    return _flash_bwd(block, res, do)
+
+
+_flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
+
+
+def flash_mha(q, k, v, causal: bool = True, interpret: bool | None = None):
+    """Flash attention on [B, S, H, D]; returns [B, S, H, D].
+
+    Raises :class:`FlashUnsupported` (at trace time) when the shape doesn't
+    tile or attention is non-causal; the dispatcher in
+    ``flash_attention.mha`` then falls back to the XLA path.
+    """
+    B, S, H, D = q.shape
+    KV = k.shape[2]
+    block = _pick_block(S)
+    if not causal or block == 0 or S < 64:
+        raise FlashUnsupported(f"no flash tiling for seq_len={S}, causal={causal}")
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    if KV != H:
+        k = jnp.repeat(k, H // KV, axis=2)
+        v = jnp.repeat(v, H // KV, axis=2)
+
+    def to_bhsd(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+    o = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), block, interpret)
+    return o.reshape(B, H, S, D).transpose(0, 2, 1, 3)

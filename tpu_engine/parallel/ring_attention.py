@@ -1,0 +1,118 @@
+"""Ring attention: sequence/context parallelism over the ``sequence`` mesh axis.
+
+Long-context capability absent from the reference entirely (SURVEY.md §5:
+"no ring attention, context parallel, blockwise attention, or Ulysses
+anywhere"; sequence length is not even a config field). First-class here:
+
+Each device holds a shard of the sequence. Q stays put; K/V shards rotate
+around the ring via ``lax.ppermute`` while every device accumulates its
+queries' attention over each visiting K/V block with an online
+(flash-style) log-sum-exp update. After ``ring_size`` hops every Q block has
+attended to every K/V block — peak memory is O(S_local²·ring) score blocks
+instead of O(S²), and the ring hops ride neighbouring ICI links.
+
+Differentiable end-to-end: the loop is a ``lax.scan`` (reverse-mode safe)
+and ``ppermute`` transposes to the reverse rotation.
+
+Layout convention matches ``tpu_engine.ops``: q/k/v are [B, S, H, D]
+(GQA allowed: KV heads < Q heads).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_engine.mesh_runtime import BATCH_AXES
+
+_NEG_INF = -1e30
+
+
+def _ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = True,
+) -> jax.Array:
+    """Per-shard ring attention body (runs inside shard_map).
+
+    q: [B, Sq, H, D] local query shard; k/v: [B, Sk, KV, D] local shards.
+    Returns [B, Sq, H, D].
+    """
+    B, Sq, H, D = q.shape
+    Sk, KV = k.shape[1], k.shape[2]
+    if KV != H:  # GQA: expand before the ring so every hop is one einsum
+        k = jnp.repeat(k, H // KV, axis=2)
+        v = jnp.repeat(v, H // KV, axis=2)
+
+    ring = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    scale = 1.0 / (D ** 0.5)
+
+    q32 = q.astype(jnp.float32)
+    q_pos = my_idx * Sq + jnp.arange(Sq)  # global query positions
+
+    # Online-softmax accumulators (fp32).
+    m0 = jnp.full((B, H, Sq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Sq), jnp.float32)
+    o0 = jnp.zeros((B, H, Sq, D), jnp.float32)
+
+    perm = [(j, (j + 1) % ring) for j in range(ring)]
+
+    def hop(carry, i):
+        m, l, o, k_blk, v_blk = carry
+        kv_idx = (my_idx - i) % ring  # which global block we hold this hop
+        s = jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)) * scale
+        if causal:
+            k_pos = kv_idx * Sk + jnp.arange(Sk)
+            mask = q_pos[:, None] >= k_pos[None, :]  # [Sq, Sk]
+            s = jnp.where(mask[None, None, :, :], s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        # Rows that have seen no valid key yet: m_new == _NEG_INF → p ≈ e^0 = 1
+        # for masked entries; zero them explicitly.
+        p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        o = o * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
+        )
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return (m_new, l, o, k_next, v_next), None
+
+    (m, l, o, _, _), _ = lax.scan(hop, (m0, l0, o0, k, v), jnp.arange(ring))
+
+    out = o / jnp.maximum(l, 1e-30)[..., None]  # [B, H, Sq, D]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ring_mha(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    causal: bool = True,
+    axis_name: str = "sequence",
+) -> jax.Array:
+    """Sequence-parallel attention over ``mesh``'s ``sequence`` axis.
+
+    Call with *global* [B, S, H, D] arrays from inside (or outside) jit; the
+    shard_map distributes: batch over (data, fsdp), sequence over
+    ``sequence``, heads over ``model``.
+    """
+    spec = P(BATCH_AXES, axis_name, "model", None)
+    f = jax.shard_map(
+        partial(_ring_attention_local, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return f(q, k, v)

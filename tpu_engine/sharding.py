@@ -34,7 +34,7 @@ streamed to device inside the update. NVMe offload has no TPU-VM equivalent
 from __future__ import annotations
 
 from enum import Enum, IntEnum
-from typing import Any, Optional
+from typing import Any, Literal, Optional
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -224,6 +224,12 @@ class TPUTrainConfig(BaseModel):
     # Offload (reference :39-40,197-212).
     optimizer_offload: OffloadDevice = OffloadDevice.NONE
     param_offload: OffloadDevice = OffloadDevice.NONE
+
+    # Attention implementation: "auto" = flash kernel on TPU, XLA elsewhere;
+    # a >1 sequence mesh axis always switches to ring attention.
+    attention_impl: Literal["auto", "xla", "flash", "ring"] = Field(
+        default="auto", description="auto | xla | flash | ring"
+    )
 
     # Activation checkpointing (reference :64-67,215-223) → jax.remat.
     activation_checkpointing: bool = True

@@ -130,6 +130,19 @@ def build_train_program(
     if runtime is None:
         runtime = MeshRuntime(cfg.mesh)
     mesh = runtime.mesh
+    # Attention implementation resolution:
+    # - a >1 'sequence' axis forces ring attention (GSPMD alone would
+    #   all-gather the sequence dim);
+    # - "auto" → the Pallas flash kernel on TPU, XLA elsewhere;
+    # - explicit "xla" / "flash" / "ring" is honoured.
+    if runtime.axis_sizes["sequence"] > 1:
+        impl = "ring"
+    elif cfg.attention_impl == "auto":
+        impl = "flash" if mesh.devices.flat[0].platform == "tpu" else "xla"
+    else:
+        impl = cfg.attention_impl
+    if model_cfg.attention_impl != impl:
+        model_cfg = model_cfg.with_(attention_impl=impl)
     stage = cfg.sharding_stage
     compute_dtype = cfg.compute_dtype()
     master_dtype = cfg.master_dtype()
@@ -187,7 +200,8 @@ def build_train_program(
 
     jit_init = jax.jit(init_fn, out_shardings=state_shardings)
 
-    batch_sharding = NamedSharding(mesh, P(None, BATCH_AXES, None))
+    seq_ax = "sequence" if runtime.axis_sizes["sequence"] > 1 else None
+    batch_sharding = NamedSharding(mesh, P(None, BATCH_AXES, seq_ax))
 
     def loss_fn(params, tokens):
         logits = tfm.forward(
@@ -197,6 +211,7 @@ def build_train_program(
             compute_dtype=compute_dtype,
             remat=cfg.activation_checkpointing,
             remat_policy=cfg.remat_policy,
+            mesh=mesh if model_cfg.attention_impl == "ring" else None,
         )
         return lm_loss(logits, tokens)
 
