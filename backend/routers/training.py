@@ -9,7 +9,7 @@ reference cannot offer because its launch is fire-and-forget.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, Literal, Optional
 
 from aiohttp import web
 from pydantic import BaseModel, Field
@@ -37,7 +37,7 @@ class TrainingLaunchRequest(BaseModel):
     weight_decay: float = Field(default=0.1, ge=0)
     grad_clip_norm: float = Field(default=1.0, gt=0)
     optimizer_offload: str = "none"
-    attention_impl: str = "auto"  # auto | xla | flash | ring
+    attention_impl: Literal["auto", "xla", "flash", "ring"] = "auto"
     activation_checkpointing: bool = True
     checkpoint_dir: Optional[str] = None
     checkpoint_interval_steps: int = Field(default=500, ge=1)

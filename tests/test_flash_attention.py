@@ -20,9 +20,11 @@ def _rand_qkv(key, B=2, S=128, H=4, KV=4, D=64, dtype=jnp.float32):
 
 
 def test_block_picker():
+    assert _pick_block(4096) == 1024
     assert _pick_block(1024) == 512
-    assert _pick_block(128) == 128
+    assert _pick_block(128) == 64
     assert _pick_block(192) == 64
+    assert _pick_block(64) == 64  # single-block path (block == seq)
     assert _pick_block(100) == 0
 
 

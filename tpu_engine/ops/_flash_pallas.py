@@ -1,11 +1,13 @@
 """First-party Pallas TPU flash attention (causal), with a memory-bounded
 blockwise backward pass.
 
-Forward: one Pallas program per (batch·head, Q-block); K/V stream through
-VMEM while an online-softmax accumulator keeps peak memory at
-O(BLOCK_Q · D + BLOCK_Q · BLOCK_K) — the S×S score matrix is never
-materialised (the ``_xla_mha`` fallback materialises it; kernel pattern per
-the Pallas TPU guide's double-buffered matmul/softmax recipes).
+Forward: grid (batch·head, Q-block, K-block) with the K dimension innermost;
+each program sees one [BLOCK_Q, D] query tile and one [BLOCK_K, D] key/value
+tile (never the whole sequence), and online-softmax state (m/l/acc) lives in
+VMEM scratch that persists across the K iterations. Peak VMEM is
+O(BLOCK_Q · D + BLOCK_K · D + BLOCK_Q · BLOCK_K) regardless of sequence
+length — the S×S score matrix is never materialised, and neither is a full
+[S, D] K/V copy (the ``_xla_mha`` fallback materialises S×S).
 
 Backward: custom_vjp. The forward saves the log-sum-exp rows; the backward
 reconstructs attention probabilities block-by-block in plain JAX
@@ -23,6 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
@@ -32,9 +35,11 @@ class FlashUnsupported(Exception):
 
 
 def _pick_block(s: int) -> int:
-    for b in (512, 256, 128, 64):
-        if s % b == 0:
+    for b in (1024, 512, 256, 128, 64):
+        if s % b == 0 and s // b >= 2:
             return b
+    if s % 64 == 0:
+        return min(s, 1024)
     return 0  # caller falls back to XLA attention
 
 
@@ -43,68 +48,84 @@ def _pick_block(s: int) -> int:
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int, block_k: int,
-                scale: float):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
+                block_q: int, block_k: int, scale: float):
     q_idx = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)  # [BQ, D]
+    k_idx = pl.program_id(2)
+    n_k = pl.num_programs(2)
 
-    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    @pl.when(k_idx == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    q_pos = q_idx * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-
-    def body(j, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+    # Causal with BLOCK_Q == BLOCK_K: only K blocks with k_idx <= q_idx
+    # contribute; later iterations are skipped entirely.
+    @pl.when(k_idx <= q_idx)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)        # [BQ, D]
+        k_blk = k_ref[0].astype(jnp.float32)    # [BK, D]
+        v_blk = v_ref[0].astype(jnp.float32)    # [BK, D]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [BQ, BK]
-        k_pos = j * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        q_pos = q_idx * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_pos = k_idx * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m = m_scr[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
         p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
         corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+        m_scr[...] = m_new
+        l_scr[...] = l_scr[...] * corr + jnp.sum(p, axis=-1)
+        acc_scr[...] = acc_scr[...] * corr[:, None] + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return m_new, l_new, acc_new
 
-    # Causal with BLOCK_Q == BLOCK_K: only blocks j <= q_idx contribute.
-    m, l, acc = lax.fori_loop(0, q_idx + 1, body, (m0, l0, acc0))
-
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0, 0] = m + jnp.log(l_safe)
+    @pl.when(k_idx == n_k - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_scr[...], 1e-30)
+        o_ref[0] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_scr[...] + jnp.log(l_safe)
 
 
 def _flash_fwd(q, k, v, block: int, interpret: bool):
     """q/k/v: [BH, S, D] → (o [BH, S, D], lse [BH, S])."""
     BH, S, D = q.shape
     scale = 1.0 / (D ** 0.5)
-    grid = (BH, S // block)
+    grid = (BH, S // block, S // block)  # K-block dim innermost (sequential)
     kernel = partial(_fwd_kernel, block_q=block, block_k=block, scale=scale)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block, D), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, block, D), lambda bh, i, j: (bh, i, 0)),
+            # K/V block index clamped to min(i, j): for the causally-masked
+            # iterations (j > i) the index repeats, so the pipeline skips the
+            # DMA — no bandwidth is spent on blocks the kernel won't read.
+            pl.BlockSpec((1, block, D), lambda bh, i, j: (bh, jnp.minimum(i, j), 0)),
+            pl.BlockSpec((1, block, D), lambda bh, i, j: (bh, jnp.minimum(i, j), 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block, D), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, block, D), lambda bh, i, j: (bh, i, 0)),
             # lse as [BH, 1, S]: TPU block tiling needs the last two block
             # dims (1, block) to be (equal-to-array, 128-divisible).
-            pl.BlockSpec((1, 1, block), lambda bh, i: (bh, 0, i)),
+            pl.BlockSpec((1, 1, block), lambda bh, i, j: (bh, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, S, D), q.dtype),
             jax.ShapeDtypeStruct((BH, 1, S), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block,), jnp.float32),      # running max m
+            pltpu.VMEM((block,), jnp.float32),      # running sum l
+            pltpu.VMEM((block, D), jnp.float32),    # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(q, k, v)
     return o, lse.reshape(BH, S)
@@ -186,7 +207,12 @@ def flash_mha(q, k, v, causal: bool = True, interpret: bool | None = None):
     if not causal or block == 0 or S < 64:
         raise FlashUnsupported(f"no flash tiling for seq_len={S}, causal={causal}")
     if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
+        # Off-TPU the kernel would only run in interpret mode — orders of
+        # magnitude slower than XLA attention. Don't do that silently; let
+        # the dispatcher fall back to XLA. Tests opt in with interpret=True.
+        if jax.devices()[0].platform != "tpu":
+            raise FlashUnsupported("no TPU present (pass interpret=True to force)")
+        interpret = False
     if KV != H:
         k = jnp.repeat(k, H // KV, axis=2)
         v = jnp.repeat(v, H // KV, axis=2)

@@ -65,8 +65,8 @@ def _ring_attention_local(
 
     perm = [(j, (j + 1) % ring) for j in range(ring)]
 
-    def hop(carry, i):
-        m, l, o, k_blk, v_blk = carry
+    def attend(m, l, o, k_blk, v_blk, i):
+        """Online-softmax update of (m, l, o) with the K/V block held at hop i."""
         kv_idx = (my_idx - i) % ring  # which global block we hold this hop
         s = jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)) * scale
         if causal:
@@ -83,11 +83,21 @@ def _ring_attention_local(
         o = o * corr[..., None] + jnp.einsum(
             "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
         )
+        return m_new, l, o
+
+    def hop(carry, i):
+        m, l, o, k_blk, v_blk = carry
+        m, l, o = attend(m, l, o, k_blk, v_blk, i)
         k_next = lax.ppermute(k_blk, axis_name, perm)
         v_next = lax.ppermute(v_blk, axis_name, perm)
-        return (m_new, l, o, k_next, v_next), None
+        return (m, l, o, k_next, v_next), None
 
-    (m, l, o, _, _), _ = lax.scan(hop, (m0, l0, o0, k, v), jnp.arange(ring))
+    # ring-1 hops rotate K/V after attending; the final block is consumed
+    # outside the scan so no wasted ppermute pair is issued on the last hop.
+    (m, l, o, k_last, v_last), _ = lax.scan(
+        hop, (m0, l0, o0, k, v), jnp.arange(ring - 1)
+    )
+    m, l, o = attend(m, l, o, k_last, v_last, ring - 1)
 
     out = o / jnp.maximum(l, 1e-30)[..., None]  # [B, H, Sq, D]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
