@@ -241,3 +241,92 @@ class TPULauncher:
             return False
         job.stop()
         return True
+
+
+# ---------------------------------------------------------------------------
+# CLI — `python -m tpu_engine.launcher` (the worker entrypoint used by
+# infra/tpu-jobset.yaml; role-parity with the external `deepspeed` CLI the
+# reference shells out to at deepspeed_launcher.py:354, except training runs
+# in this process).
+# ---------------------------------------------------------------------------
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+    import json
+    import os
+
+    parser = argparse.ArgumentParser(description="TPU training launcher")
+    parser.add_argument("--preset", help="named preset (see --list-presets)")
+    parser.add_argument("--model", help="model name (overrides preset's)")
+    parser.add_argument("--list-presets", action="store_true")
+    parser.add_argument("--max-steps", type=int, default=None)
+    parser.add_argument("--checkpoint-dir", default=os.environ.get("CHECKPOINT_DIR"))
+    parser.add_argument("--watch-preemption", action="store_true",
+                        help="poll the GCE preemption notice; checkpoint on warning")
+    parser.add_argument("--dry-run", action="store_true",
+                        help="print the execution plan and exit")
+    parser.add_argument("--set", action="append", default=[], metavar="KEY=VALUE",
+                        help="config override, e.g. --set seq_len=4096 "
+                        "--set mesh.fsdp=8 (repeatable)")
+    args = parser.parse_args(argv)
+
+    launcher = TPULauncher()
+    if args.list_presets:
+        for name, cfg in launcher.presets().items():
+            print(f"{name}: {cfg.model_name} stage={int(cfg.sharding_stage)} "
+                  f"eff_batch={cfg.effective_batch_size}")
+        return 0
+
+    if args.preset:
+        all_presets = launcher.presets()
+        if args.preset not in all_presets:
+            parser.error(f"unknown preset '{args.preset}'; known: {sorted(all_presets)}")
+        cfg_dict = all_presets[args.preset].model_dump()
+    else:
+        cfg_dict = TPUTrainConfig().model_dump()
+    if args.model:
+        cfg_dict["model_name"] = args.model
+    if args.checkpoint_dir:
+        cfg_dict["checkpoint_dir"] = args.checkpoint_dir
+    for item in args.set:
+        key, _, value = item.partition("=")
+        if not value:
+            parser.error(f"--set expects KEY=VALUE, got '{item}'")
+        target, leaf = cfg_dict, key
+        if "." in key:
+            head, leaf = key.rsplit(".", 1)
+            for part in head.split("."):
+                target = target.setdefault(part, {})
+        try:
+            target[leaf] = json.loads(value)
+        except json.JSONDecodeError:
+            target[leaf] = value
+    config = TPUTrainConfig(**cfg_dict)
+
+    # Multi-host rendezvous (no-op single-process; GKE env autodetected).
+    from tpu_engine.mesh_runtime import initialize_distributed
+
+    initialize_distributed()
+
+    result = launcher.launch(
+        config,
+        dry_run=args.dry_run,
+        max_steps=args.max_steps,
+        watch_preemption=args.watch_preemption,
+        install_signal_handlers=not args.dry_run,
+        block=not args.dry_run,
+    )
+    print(json.dumps(result.model_dump(), indent=2, default=str))
+    if result.status == "failed":
+        return 1
+    if result.status == "dry_run":
+        return 0
+    job = launcher.get_job(result.job_id)
+    final = job.describe() if job else {}
+    print(json.dumps(final, indent=2, default=str))
+    return 0 if final.get("status") == "completed" else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
