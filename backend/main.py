@@ -18,7 +18,7 @@ import time
 from aiohttp import web
 
 from backend.http import cors_middleware, error_middleware, json_response
-from backend.routers import monitoring, topology, tpu, training
+from backend.routers import monitoring, profiling, topology, tpu, training
 
 VERSION = "0.1.0"
 _started_at = time.time()
@@ -38,12 +38,14 @@ async def root(request: web.Request) -> web.Response:
                 "Orbax checkpointing with stable-pointer rollback and auto-resume",
                 "preemption watcher with emergency checkpoint",
                 "real ICI topology introspection",
+                "jax.profiler trace capture and per-step wall-clock breakdown",
             ],
             "endpoints": {
                 "tpu": "/api/v1/tpu",
                 "training": "/api/v1/training",
                 "monitoring": "/api/v1/monitoring",
                 "topology": "/api/v1/topology",
+                "profile": "/api/v1/profile",
             },
         }
     )
@@ -74,6 +76,7 @@ def create_app() -> web.Application:
     training.setup(app)
     monitoring.setup(app)
     topology.setup(app)
+    profiling.setup(app)
     app.router.add_get("/", root)
     app.router.add_get("/health", health_check)
     return app

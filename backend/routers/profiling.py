@@ -1,0 +1,74 @@
+"""Profiling routes — the control-plane surface for SURVEY.md §5's tracing
+plan (the reference's only profiling is a pass-through DeepSpeed flag,
+``wall_clock_breakdown`` at ``deepspeed_launcher.py:79,129``):
+
+- ``POST /api/v1/profile/trace/start`` — begin a ``jax.profiler`` trace
+  (XPlane/TensorBoard format), optional ``duration_s`` auto-stop;
+- ``POST /api/v1/profile/trace/stop`` — end it;
+- ``GET  /api/v1/profile/trace``       — trace status;
+- ``GET  /api/v1/profile/jobs/{job_id}`` — per-step wall-clock breakdown
+  (data/dispatch/device/other, rolling mean/p50/p95) + tokens/sec + MFU for
+  a supervised job.
+"""
+
+from __future__ import annotations
+
+import tempfile
+from typing import Optional
+
+from aiohttp import web
+from pydantic import BaseModel, Field
+
+from backend import state
+from backend.http import ApiError, json_response, parse_body
+from tpu_engine.profiler import TraceSession
+
+trace_session = TraceSession()
+
+
+class TraceStartRequest(BaseModel):
+    log_dir: Optional[str] = Field(
+        default=None, description="trace output dir (default: a tmp dir)"
+    )
+    duration_s: Optional[float] = Field(
+        default=None, gt=0, le=600, description="auto-stop after this many seconds"
+    )
+
+
+async def trace_start(request: web.Request) -> web.Response:
+    req = await parse_body(request, TraceStartRequest)
+    log_dir = req.log_dir or tempfile.mkdtemp(prefix="tpu_trace_")
+    try:
+        info = trace_session.start(log_dir, duration_s=req.duration_s)
+    except RuntimeError as e:
+        raise ApiError(409, str(e))
+    return json_response(info)
+
+
+async def trace_stop(request: web.Request) -> web.Response:
+    try:
+        info = trace_session.stop()
+    except RuntimeError as e:
+        raise ApiError(409, str(e))
+    return json_response(info)
+
+
+async def trace_status(request: web.Request) -> web.Response:
+    return json_response(trace_session.status())
+
+
+async def job_profile(request: web.Request) -> web.Response:
+    job_id = request.match_info["job_id"]
+    job = state.launcher.get_job(job_id)
+    if job is None:
+        raise ApiError(404, f"no supervised job '{job_id}'")
+    if job.profiler is None:
+        raise ApiError(409, f"job '{job_id}' has not started its train loop yet")
+    return json_response({"job_id": job_id, "profile": job.profiler.summary()})
+
+
+def setup(app: web.Application, prefix: str = "/api/v1") -> None:
+    app.router.add_post(f"{prefix}/profile/trace/start", trace_start)
+    app.router.add_post(f"{prefix}/profile/trace/stop", trace_stop)
+    app.router.add_get(f"{prefix}/profile/trace", trace_status)
+    app.router.add_get(f"{prefix}/profile/jobs/{{job_id}}", job_profile)

@@ -25,27 +25,9 @@ import jax
 
 from tpu_engine.mesh_runtime import MeshConfig, MeshRuntime
 from tpu_engine.models import transformer as tfm
+from tpu_engine.profiler import peak_flops_per_chip
 from tpu_engine.sharding import ShardingStage, TPUTrainConfig
 from tpu_engine.train import build_train_program
-
-# Peak bf16 FLOP/s per chip by device kind (public spec sheets).
-_PEAK_FLOPS = {
-    "v4": 275e12,
-    "v5 lite": 197e12,
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v6 lite": 918e12,
-    "v6e": 918e12,
-    "trillium": 918e12,
-}
-
-
-def peak_flops_per_chip(device: jax.Device) -> float | None:
-    kind = getattr(device, "device_kind", "").lower()
-    for key, flops in _PEAK_FLOPS.items():
-        if key in kind:
-            return flops
-    return None
 
 
 def _candidates(n_dev: int, on_tpu: bool) -> list[TPUTrainConfig]:

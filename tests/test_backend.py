@@ -247,3 +247,51 @@ def test_monitor_404s(client):
     assert client.get("/api/v1/monitoring/summary/ghost").status_code == 404
     assert client.get("/api/v1/monitoring/loss-curve/ghost").status_code == 404
     assert client.post("/api/v1/monitoring/reset/ghost").status_code == 404
+
+
+# -- profiling routes --------------------------------------------------------
+
+
+def test_profile_trace_routes(client, tmp_path_factory):
+    assert client.get("/api/v1/profile/trace").json()["active"] is False
+    # Stop with no active trace → 409.
+    assert client.post("/api/v1/profile/trace/stop").status_code == 409
+
+    log_dir = str(tmp_path_factory.mktemp("trace"))
+    r = client.post("/api/v1/profile/trace/start", json={"log_dir": log_dir})
+    assert r.status_code == 200 and r.json()["active"] is True
+    # Second start while active → 409.
+    assert client.post("/api/v1/profile/trace/start", json={}).status_code == 409
+    out = client.post("/api/v1/profile/trace/stop").json()
+    assert out["active"] is False and out["log_dir"] == log_dir
+
+
+def test_profile_job_routes(client):
+    assert client.get("/api/v1/profile/jobs/ghost").status_code == 404
+
+    # Launch a tiny supervised job; its profile must expose the breakdown.
+    r = client.post(
+        "/api/v1/training/launch",
+        json={
+            "model_name": "gpt-tiny",
+            "mesh": {"data": 2, "fsdp": 4},
+            "seq_len": 32,
+            "precision": "fp32",
+            "total_steps": 3,
+            "max_steps": 3,
+            "warmup_steps": 1,
+            "activation_checkpointing": False,
+            "dry_run": False,
+        },
+    )
+    job_id = r.json()["job_id"]
+    for _ in range(120):
+        d = client.get(f"/api/v1/training/jobs/{job_id}").json()
+        if d["status"] in ("completed", "failed"):
+            break
+        time.sleep(0.5)
+    assert d["status"] == "completed"
+    prof = client.get(f"/api/v1/profile/jobs/{job_id}").json()["profile"]
+    assert prof["steps_seen"] == 3
+    assert set(prof["phases"]) == {"data", "dispatch", "device", "other"}
+    assert d["profile"]["steps_seen"] == 3  # also embedded in job describe()

@@ -1,0 +1,71 @@
+"""Profiler subsystem: step breakdown stats, MFU accounting, trace session,
+and the /api/v1/profile routes."""
+
+import time
+
+import pytest
+
+from tpu_engine.profiler import PEAK_FLOPS_BF16, StepProfiler, TraceSession, mfu
+
+
+def test_step_profiler_phases_and_stats():
+    prof = StepProfiler(window=10, tokens_per_step=1000, n_devices=2)
+    for _ in range(3):
+        prof.begin_step()
+        time.sleep(0.01)
+        prof.mark("data")
+        time.sleep(0.02)
+        prof.mark("dispatch")
+        time.sleep(0.005)
+        prof.mark("device")
+        total = prof.end_step()
+        assert total >= 0.035
+
+    s = prof.summary()
+    assert s["steps_seen"] == 3
+    assert s["window"] == 3
+    assert s["phases"]["data"]["mean_ms"] == pytest.approx(10, rel=0.8)
+    assert s["phases"]["dispatch"]["mean_ms"] > s["phases"]["device"]["mean_ms"]
+    # Fractions cover the whole step.
+    fracs = sum(s["phases"][p]["fraction"] for p in StepProfiler.PHASES)
+    assert fracs == pytest.approx(1.0, abs=0.02)
+    # Throughput is derived from mean total.
+    assert s["tokens_per_sec"] > 0
+    # Both values are rounded to 0.1 independently.
+    assert s["tokens_per_sec_per_chip"] == pytest.approx(s["tokens_per_sec"] / 2, abs=0.06)
+
+
+def test_step_profiler_window_bounded():
+    prof = StepProfiler(window=5)
+    for _ in range(20):
+        prof.begin_step()
+        prof.end_step()
+    s = prof.summary()
+    assert s["steps_seen"] == 20
+    assert s["window"] == 5  # deque bounded — no unbounded growth
+
+
+def test_mfu_accounting():
+    # On the CPU test mesh there is no known peak → None.
+    assert mfu(1e9, 1e4) is None or isinstance(mfu(1e9, 1e4), float)
+
+    # Against a known chip entry the math is exact.
+    class FakeDev:
+        device_kind = "TPU v5e"
+
+    v = mfu(1e9, 88_650.0, device=FakeDev())  # 88650 tok/s × 1 GF/tok / 197 TF
+    assert v == pytest.approx(88_650e9 / PEAK_FLOPS_BF16["v5e"], rel=1e-6)
+
+
+def test_trace_session_lifecycle(tmp_path):
+    ts = TraceSession()
+    assert ts.status() == {"active": False}
+    with pytest.raises(RuntimeError):
+        ts.stop()
+    info = ts.start(str(tmp_path / "trace"))
+    assert info["active"] and ts.active
+    with pytest.raises(RuntimeError):
+        ts.start(str(tmp_path / "other"))  # one at a time
+    out = ts.stop()
+    assert out["active"] is False
+    assert not ts.active

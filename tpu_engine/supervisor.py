@@ -39,6 +39,7 @@ from tpu_engine.loss_monitor import (
     TrainingMetrics,
 )
 from tpu_engine.preemption import PreemptionWatcher
+from tpu_engine.profiler import StepProfiler
 from tpu_engine.sharding import TPUTrainConfig
 from tpu_engine.train import TrainProgram, build_train_program
 
@@ -95,6 +96,7 @@ class TrainingJob:
         self.last_step_time_s: Optional[float] = None
         self.tokens_per_sec: Optional[float] = None
         self.current_step: int = 0
+        self.profiler: Optional[StepProfiler] = None
 
         self._state: Any = None
         self._state_lock = threading.Lock()
@@ -187,17 +189,27 @@ class TrainingJob:
             tokens_per_batch = 1
             for d in prog.global_batch_shape():
                 tokens_per_batch *= d
+            from tpu_engine.models import transformer as tfm
+
+            self.profiler = StepProfiler(
+                tokens_per_step=tokens_per_batch,
+                flops_per_token=tfm.train_flops_per_token(prog.model_config, self.config.seq_len),
+                n_devices=prog.runtime.n_devices,
+            )
 
             step = start_step
             while step < self.max_steps and not self._stop.is_set():
+                self.profiler.begin_step()
                 batch = (
                     self.data_fn(step) if self.data_fn is not None else prog.synthetic_batch(step)
                 )
-                t0 = time.perf_counter()
+                self.profiler.mark("data")
                 with self._state_lock:
                     self._state, metrics = prog.step(self._state, batch)
+                self.profiler.mark("dispatch")
                 host = {k: float(v) for k, v in jax.device_get(metrics).items()}
-                dt = time.perf_counter() - t0
+                self.profiler.mark("device")
+                dt = self.profiler.end_step()
                 self.last_step_time_s = dt
                 self.tokens_per_sec = tokens_per_batch / dt if dt > 0 else None
                 step = int(host["step"])
@@ -321,4 +333,5 @@ class TrainingJob:
             "last_step_time_s": self.last_step_time_s,
             "tokens_per_sec": self.tokens_per_sec,
             "monitor": self.monitor.get_summary(),
+            "profile": self.profiler.summary() if self.profiler is not None else None,
         }
