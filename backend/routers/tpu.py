@@ -83,9 +83,38 @@ async def get_tpu_alerts(request: web.Request) -> web.Response:
     )
 
 
+async def get_host_stats(request: web.Request) -> web.Response:
+    """Host-plane telemetry (memory/load/CPUs) from the native /proc probe,
+    with a pure-Python fallback when the toolchain is unavailable."""
+    from tpu_engine import native
+
+    stats = native.host_stats()
+    source = "native"
+    if stats is None:
+        source = "python"
+        stats = {}
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        stats["mem_total_gb"] = round(int(line.split()[1]) / 1048576, 3)
+                    elif line.startswith("MemAvailable:"):
+                        stats["mem_available_gb"] = round(int(line.split()[1]) / 1048576, 3)
+            with open("/proc/loadavg") as f:
+                parts = f.read().split()
+                stats["load_1m"], stats["load_5m"] = float(parts[0]), float(parts[1])
+            import os
+
+            stats["n_cpus"] = os.cpu_count()
+        except OSError:
+            raise ApiError(503, "host telemetry unavailable on this platform")
+    return json_response({"source": source, **stats})
+
+
 def setup(app: web.Application, prefix: str = "/api/v1/tpu") -> None:
     app.router.add_get(f"{prefix}/fleet", get_fleet_status)
     app.router.add_get(f"{prefix}/fleet/mock", get_mock_fleet)
     app.router.add_get(f"{prefix}/select", select_best_device)
     app.router.add_get(f"{prefix}/devices/{{index}}", get_device)
     app.router.add_get(f"{prefix}/alerts", get_tpu_alerts)
+    app.router.add_get(f"{prefix}/host", get_host_stats)

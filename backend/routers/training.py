@@ -39,6 +39,8 @@ class TrainingLaunchRequest(BaseModel):
     optimizer_offload: str = "none"
     attention_impl: Literal["auto", "xla", "flash", "ring"] = "auto"
     activation_checkpointing: bool = True
+    dataset_path: Optional[str] = None  # flat binary token file; None = synthetic
+    dataset_dtype: Literal["uint16", "int32"] = "uint16"
     checkpoint_dir: Optional[str] = None
     checkpoint_interval_steps: int = Field(default=500, ge=1)
     max_steps: Optional[int] = Field(default=None, ge=1, description="stop early after N steps")
@@ -73,6 +75,8 @@ def _to_config(req: TrainingLaunchRequest) -> TPUTrainConfig:
             optimizer_offload=OffloadDevice(req.optimizer_offload),
             attention_impl=req.attention_impl,
             activation_checkpointing=req.activation_checkpointing,
+            dataset_path=req.dataset_path,
+            dataset_dtype=req.dataset_dtype,
             checkpoint_dir=req.checkpoint_dir,
             checkpoint_interval_steps=req.checkpoint_interval_steps,
         )

@@ -1,0 +1,232 @@
+"""Input pipeline: tokenized datasets → sharded global batches.
+
+The reference has no data pipeline at all — training data is the external
+training script's problem (the launcher only passes script args,
+``deepspeed_launcher.py:302-367``). A complete in-process engine owns its
+input path:
+
+- :class:`TokenFileDataset` — flat binary token files (uint16/int32), read
+  through the native mmap+prefetch reader (``tpu_engine/native``) when the
+  toolchain is available, else a NumPy memmap fallback with the same
+  deterministic shuffle;
+- :class:`SyntheticDataset` — deterministic random tokens (smoke/bench);
+- :func:`make_data_fn` — adapts a dataset to the supervisor's ``data_fn``
+  contract: ``step -> [accum, global_micro_batch, seq_len] int32`` placed
+  with the program's batch sharding (single- and multi-process aware).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+from tpu_engine import native
+
+_DTYPE_CODES = {"uint16": 2, "int32": 4}
+_NP_DTYPES = {"uint16": np.uint16, "int32": np.int32}
+
+
+def write_token_file(tokens: np.ndarray, path: str, dtype: str = "uint16") -> str:
+    """Serialize a 1-D token array to the flat binary format both readers use."""
+    arr = np.asarray(tokens).astype(_NP_DTYPES[dtype])
+    arr.tofile(path)
+    return path
+
+
+def _splitmix64(state: np.uint64) -> tuple[np.uint64, np.uint64]:
+    """One splitmix64 step — must match the native RNG bit-for-bit so the
+    Python fallback yields the identical shuffle order."""
+    mask = np.uint64(0xFFFFFFFFFFFFFFFF)
+    state = (state + np.uint64(0x9E3779B97F4A7C15)) & mask
+    z = state
+    z = ((z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)) & mask
+    z = ((z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)) & mask
+    return state, z ^ (z >> np.uint64(31))
+
+
+def _shuffled_perm(n: int, seed: int, epoch: int) -> np.ndarray:
+    """Fisher–Yates with splitmix64 — identical to Reader::reshuffle()."""
+    perm = np.arange(n, dtype=np.int64)
+    state = np.uint64(seed) ^ (np.uint64(0xA5A5A5A5) * np.uint64(epoch + 1))
+    with np.errstate(over="ignore"):
+        for i in range(n - 1, 0, -1):
+            state, z = _splitmix64(state)
+            j = int(z % np.uint64(i + 1))
+            perm[i], perm[j] = perm[j], perm[i]
+    return perm
+
+
+class _PyTokenReader:
+    """NumPy-memmap fallback with the same stream semantics as the native
+    reader (deterministic epoch shuffle, sequential cursor)."""
+
+    def __init__(self, path: str, seq_len: int, dtype: str):
+        self.seq_len = int(seq_len)
+        self._mm = np.memmap(path, dtype=_NP_DTYPES[dtype], mode="r")
+        self.num_tokens = int(self._mm.shape[0])
+        self.num_sequences = self.num_tokens // self.seq_len
+        if self.num_sequences < 1:
+            raise FileNotFoundError(f"{path}: smaller than one sequence")
+        self.epoch = 0
+        self._batch: Optional[int] = None
+        self._seed = 0
+        self._shuffle = True
+        self._cursor = 0
+        self._perm: Optional[np.ndarray] = None
+
+    def read_batch(self, indices: np.ndarray, n_threads: int = 0) -> np.ndarray:
+        out = np.empty((len(indices), self.seq_len), dtype=np.int32)
+        for i, idx in enumerate(np.asarray(indices, dtype=np.int64)):
+            if not 0 <= idx < self.num_sequences:
+                raise IndexError(f"sequence index {idx} out of range")
+            out[i] = self._mm[idx * self.seq_len:(idx + 1) * self.seq_len]
+        return out
+
+    def _reshuffle(self) -> None:
+        if self._shuffle:
+            self._perm = _shuffled_perm(self.num_sequences, self._seed, self.epoch)
+        else:
+            self._perm = np.arange(self.num_sequences, dtype=np.int64)
+
+    def start_prefetch(self, batch: int, seed: int = 0, shuffle: bool = True) -> None:
+        if batch > self.num_sequences:
+            raise ValueError("batch > num_sequences")
+        self._batch, self._seed, self._shuffle = int(batch), int(seed), shuffle
+        self._cursor, self.epoch = 0, 0
+        self._reshuffle()
+
+    def next_batch(self) -> np.ndarray:
+        if self._batch is None:
+            raise RuntimeError("call start_prefetch first")
+        idx = np.empty(self._batch, dtype=np.int64)
+        for i in range(self._batch):
+            if self._cursor >= self.num_sequences:
+                self.epoch += 1
+                self._cursor = 0
+                self._reshuffle()
+            idx[i] = self._perm[self._cursor]
+            self._cursor += 1
+        return self.read_batch(idx)
+
+    def close(self) -> None:
+        self._mm = None
+
+
+class TokenFileDataset:
+    """Sequences from a flat binary token file; native reader when possible.
+
+    The stream is deterministic given (seed, batch): restarting after a crash
+    replays the same shuffle order, so resume-from-checkpoint sees the data
+    it would have seen (the step index keys the stream position).
+    """
+
+    def __init__(self, path: str, seq_len: int, dtype: str = "uint16",
+                 prefer_native: bool = True):
+        if dtype not in _DTYPE_CODES:
+            raise ValueError(f"dtype must be one of {sorted(_DTYPE_CODES)}")
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        self.path, self.seq_len, self.dtype = path, int(seq_len), dtype
+        self.native = False
+        if prefer_native and native.available():
+            self._reader: Any = native.NativeTokenReader(
+                path, seq_len, _DTYPE_CODES[dtype]
+            )
+            self.native = True
+        else:
+            self._reader = _PyTokenReader(path, seq_len, dtype)
+
+    @property
+    def num_sequences(self) -> int:
+        return self._reader.num_sequences
+
+    @property
+    def num_tokens(self) -> int:
+        return self._reader.num_tokens
+
+    @property
+    def epoch(self) -> int:
+        return self._reader.epoch
+
+    def read_batch(self, indices: np.ndarray) -> np.ndarray:
+        return self._reader.read_batch(np.asarray(indices, dtype=np.int64))
+
+    def start(self, batch: int, seed: int = 0, shuffle: bool = True) -> None:
+        self._reader.start_prefetch(batch, seed, shuffle)
+
+    def next_batch(self) -> np.ndarray:
+        return self._reader.next_batch()
+
+    def close(self) -> None:
+        self._reader.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class SyntheticDataset:
+    """Deterministic random tokens (the default when no dataset is given)."""
+
+    def __init__(self, vocab_size: int, seq_len: int):
+        self.vocab_size, self.seq_len = vocab_size, seq_len
+        self._batch: Optional[int] = None
+        self._seed = 0
+        self._step = 0
+
+    def start(self, batch: int, seed: int = 0, shuffle: bool = True) -> None:
+        self._batch, self._seed, self._step = int(batch), int(seed), 0
+
+    def next_batch(self) -> np.ndarray:
+        if self._batch is None:
+            raise RuntimeError("call start first")
+        rng = np.random.default_rng((self._seed << 20) ^ self._step)
+        self._step += 1
+        return rng.integers(
+            0, self.vocab_size, (self._batch, self.seq_len), dtype=np.int32
+        )
+
+    def close(self) -> None:
+        pass
+
+
+def make_data_fn(program: Any, dataset: Any, seed: int = 0) -> Callable[[int], jax.Array]:
+    """Adapt a dataset into the supervisor's ``data_fn(step)`` contract.
+
+    Pulls ``accum × global_micro`` sequences per step and places them with
+    the program's batch sharding. Multi-process: every process pulls the
+    same global stream (deterministic) and contributes its addressable
+    shards via ``jax.make_array_from_process_local_data``.
+    """
+    accum, global_micro, seq_len = program.global_batch_shape()
+    if dataset.seq_len != seq_len:
+        raise ValueError(
+            f"dataset seq_len {dataset.seq_len} != program seq_len {seq_len}"
+        )
+    dataset.start(accum * global_micro, seed=seed)
+    sharding = program.batch_sharding
+    multiprocess = jax.process_count() > 1
+
+    def data_fn(step: int) -> jax.Array:
+        flat = dataset.next_batch()  # [accum*global_micro, seq_len] int32
+        batch = flat.reshape(accum, global_micro, seq_len)
+        if multiprocess:
+            # Every process pulls the identical deterministic stream and
+            # keeps its contiguous row block (mesh devices are ordered by
+            # process, so batch-axis shards are process-contiguous). The
+            # sequence axis, if sharded, stays process-local on one host's
+            # slice under the canonical (data, fsdp, sequence, model) order.
+            rows = global_micro // jax.process_count()
+            r0 = jax.process_index() * rows
+            local = batch[:, r0:r0 + rows]
+            return jax.make_array_from_process_local_data(
+                sharding, local, global_shape=batch.shape
+            )
+        return jax.device_put(batch, sharding)
+
+    return data_fn

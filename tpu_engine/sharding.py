@@ -249,6 +249,11 @@ class TPUTrainConfig(BaseModel):
     max_checkpoints_to_keep: int = Field(default=3, ge=1)
 
     # Data / misc.
+    dataset_path: Optional[str] = Field(
+        default=None,
+        description="flat binary token file (tpu_engine.data); None = synthetic",
+    )
+    dataset_dtype: Literal["uint16", "int32"] = "uint16"
     seed: int = 0
     log_every_steps: int = Field(default=100, ge=1)  # reference steps_per_print :128
 

@@ -97,6 +97,7 @@ class TrainingJob:
         self.tokens_per_sec: Optional[float] = None
         self.current_step: int = 0
         self.profiler: Optional[StepProfiler] = None
+        self._dataset: Any = None
 
         self._state: Any = None
         self._state_lock = threading.Lock()
@@ -185,6 +186,22 @@ class TrainingJob:
             if self.watcher is not None:
                 self.watcher.start()
 
+            # Input pipeline: explicit data_fn > config dataset file > synthetic.
+            if self.data_fn is None and self.config.dataset_path:
+                from tpu_engine.data import TokenFileDataset, make_data_fn
+
+                self._dataset = TokenFileDataset(
+                    self.config.dataset_path,
+                    seq_len=self.config.seq_len,
+                    dtype=self.config.dataset_dtype,
+                )
+                self.data_fn = make_data_fn(prog, self._dataset, seed=self.config.seed)
+                log.info(
+                    "job %s: dataset %s (%d sequences, native=%s)",
+                    self.job_id, self.config.dataset_path,
+                    self._dataset.num_sequences, self._dataset.native,
+                )
+
             self.status = JobStatus.RUNNING
             tokens_per_batch = 1
             for d in prog.global_batch_shape():
@@ -263,6 +280,11 @@ class TrainingJob:
             self.status = JobStatus.FAILED
         finally:
             self.finished_at = time.time()
+            if self._dataset is not None:
+                try:
+                    self._dataset.close()
+                except Exception:
+                    pass
             if self.watcher is not None:
                 self.watcher.stop()
             if self.ckpt is not None:
