@@ -10,8 +10,10 @@ pure-functional JAX code with logical-axis sharding annotations.
 from tpu_engine.models.transformer import (
     ModelConfig,
     MODEL_CONFIGS,
+    active_param_count,
     init_params,
     forward,
+    forward_and_aux,
     logical_axes,
     param_count,
     train_flops_per_token,
@@ -20,8 +22,10 @@ from tpu_engine.models.transformer import (
 __all__ = [
     "ModelConfig",
     "MODEL_CONFIGS",
+    "active_param_count",
     "init_params",
     "forward",
+    "forward_and_aux",
     "logical_axes",
     "param_count",
     "train_flops_per_token",

@@ -46,10 +46,26 @@ class ModelConfig:
     norm_eps: float = 1e-5
     # Attention implementation: "xla" (fallback) or "flash" (Pallas kernel).
     attention_impl: str = "xla"
+    # Mixture-of-Experts (0 experts = dense MLP). Experts ride the "expert"
+    # logical axis → "model" mesh axis (expert parallelism). Routing is
+    # top-k with a fixed per-expert capacity (static shapes for XLA).
+    n_experts: int = 0
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 0
+
+    def expert_capacity(self, seq_len: int) -> int:
+        """Tokens each expert accepts per sequence (static)."""
+        cap = int(self.capacity_factor * self.top_k * seq_len / self.n_experts)
+        return max(cap, 1)
 
     def with_(self, **kw) -> "ModelConfig":
         return replace(self, **kw)
@@ -82,6 +98,15 @@ MODEL_CONFIGS: dict[str, ModelConfig] = {
         name="llama-70b", vocab_size=32_000, d_model=8192, n_layers=80, n_heads=64,
         n_kv_heads=8, d_ff=28_672, max_seq_len=4096,
     ),
+    # Mixture-of-Experts family (expert parallelism over the "model" axis).
+    "moe-tiny": ModelConfig(
+        name="moe-tiny", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+        n_kv_heads=4, d_ff=128, max_seq_len=256, n_experts=4, top_k=2,
+    ),
+    "moe-8x7b": ModelConfig(  # Mixtral-8x7B shape
+        name="moe-8x7b", vocab_size=32_000, d_model=4096, n_layers=32, n_heads=32,
+        n_kv_heads=8, d_ff=14_336, max_seq_len=4096, n_experts=8, top_k=2,
+    ),
 }
 
 
@@ -102,19 +127,29 @@ def init_params(rng: jax.Array, cfg: ModelConfig, dtype=jnp.float32) -> dict[str
     def norm(key, shape, s):
         return (jax.random.normal(key, shape, jnp.float32) * s).astype(dtype)
 
+    layers: dict[str, Any] = {
+        "attn_norm": {"scale": jnp.ones((L, D), dtype)},
+        "q": {"kernel": norm(k_q, (L, D, H * HD), std)},
+        "k": {"kernel": norm(k_k, (L, D, KV * HD), std)},
+        "v": {"kernel": norm(k_v, (L, D, KV * HD), std)},
+        "o": {"kernel": norm(k_o, (L, H * HD, D), res_std)},
+        "mlp_norm": {"scale": jnp.ones((L, D), dtype)},
+    }
+    if cfg.is_moe:
+        E = cfg.n_experts
+        k_router = jax.random.fold_in(k_gate, 1)
+        layers["router"] = {"kernel": norm(k_router, (L, D, E), std)}
+        layers["gate"] = {"kernel": norm(k_gate, (L, E, D, F), std)}
+        layers["up"] = {"kernel": norm(k_up, (L, E, D, F), std)}
+        layers["down"] = {"kernel": norm(k_down, (L, E, F, D), res_std)}
+    else:
+        layers["gate"] = {"kernel": norm(k_gate, (L, D, F), std)}
+        layers["up"] = {"kernel": norm(k_up, (L, D, F), std)}
+        layers["down"] = {"kernel": norm(k_down, (L, F, D), res_std)}
+
     return {
         "embed": {"embedding": norm(k_embed, (V, D), std)},
-        "layers": {
-            "attn_norm": {"scale": jnp.ones((L, D), dtype)},
-            "q": {"kernel": norm(k_q, (L, D, H * HD), std)},
-            "k": {"kernel": norm(k_k, (L, D, KV * HD), std)},
-            "v": {"kernel": norm(k_v, (L, D, KV * HD), std)},
-            "o": {"kernel": norm(k_o, (L, H * HD, D), res_std)},
-            "mlp_norm": {"scale": jnp.ones((L, D), dtype)},
-            "gate": {"kernel": norm(k_gate, (L, D, F), std)},
-            "up": {"kernel": norm(k_up, (L, D, F), std)},
-            "down": {"kernel": norm(k_down, (L, F, D), res_std)},
-        },
+        "layers": layers,
         "final_norm": {"scale": jnp.ones((D,), dtype)},
         "lm_head": {"kernel": norm(k_head, (D, V), std)},
     }
@@ -122,19 +157,26 @@ def init_params(rng: jax.Array, cfg: ModelConfig, dtype=jnp.float32) -> dict[str
 
 def logical_axes(cfg: ModelConfig) -> dict[str, Any]:
     """Logical-axis tree matching :func:`init_params`' structure exactly."""
+    layers: dict[str, Any] = {
+        "attn_norm": {"scale": ("layers", "embed")},
+        "q": {"kernel": ("layers", "embed", "heads")},
+        "k": {"kernel": ("layers", "embed", "kv_heads")},
+        "v": {"kernel": ("layers", "embed", "kv_heads")},
+        "o": {"kernel": ("layers", "heads", "embed")},
+        "mlp_norm": {"scale": ("layers", "embed")},
+    }
+    if cfg.is_moe:
+        layers["router"] = {"kernel": ("layers", "embed", None)}
+        layers["gate"] = {"kernel": ("layers", "expert", "embed", "mlp")}
+        layers["up"] = {"kernel": ("layers", "expert", "embed", "mlp")}
+        layers["down"] = {"kernel": ("layers", "expert", "mlp", "embed")}
+    else:
+        layers["gate"] = {"kernel": ("layers", "embed", "mlp")}
+        layers["up"] = {"kernel": ("layers", "embed", "mlp")}
+        layers["down"] = {"kernel": ("layers", "mlp", "embed")}
     return {
         "embed": {"embedding": ("vocab", "embed")},
-        "layers": {
-            "attn_norm": {"scale": ("layers", "embed")},
-            "q": {"kernel": ("layers", "embed", "heads")},
-            "k": {"kernel": ("layers", "embed", "kv_heads")},
-            "v": {"kernel": ("layers", "embed", "kv_heads")},
-            "o": {"kernel": ("layers", "heads", "embed")},
-            "mlp_norm": {"scale": ("layers", "embed")},
-            "gate": {"kernel": ("layers", "embed", "mlp")},
-            "up": {"kernel": ("layers", "embed", "mlp")},
-            "down": {"kernel": ("layers", "mlp", "embed")},
-        },
+        "layers": layers,
         "final_norm": {"scale": ("embed",)},
         "lm_head": {"kernel": ("embed", "vocab")},
     }
@@ -143,14 +185,26 @@ def logical_axes(cfg: ModelConfig) -> dict[str, Any]:
 def param_count(cfg: ModelConfig) -> int:
     L, D, V, F = cfg.n_layers, cfg.d_model, cfg.vocab_size, cfg.d_ff
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    per_layer = D * H * HD + 2 * D * KV * HD + H * HD * D + 3 * D * F + 2 * D
+    mlp = 3 * D * F * (cfg.n_experts if cfg.is_moe else 1)
+    router = D * cfg.n_experts if cfg.is_moe else 0
+    per_layer = D * H * HD + 2 * D * KV * HD + H * HD * D + mlp + router + 2 * D
     return V * D + L * per_layer + D + D * V
 
 
+def active_param_count(cfg: ModelConfig) -> int:
+    """Parameters touched per token (= param_count for dense; top-k experts
+    only for MoE — the honest N for FLOPs accounting)."""
+    if not cfg.is_moe:
+        return param_count(cfg)
+    L, D, F = cfg.n_layers, cfg.d_model, cfg.d_ff
+    inactive_experts = cfg.n_experts - cfg.top_k
+    return param_count(cfg) - L * 3 * D * F * inactive_experts
+
+
 def train_flops_per_token(cfg: ModelConfig, seq_len: int) -> float:
-    """Approximate training FLOPs/token: 6·N_matmul + attention term
+    """Approximate training FLOPs/token: 6·N_active_matmul + attention term
     (12·L·D·S accounting fwd+bwd of the S×S score/value matmuls)."""
-    n = param_count(cfg) - cfg.vocab_size * cfg.d_model  # embedding lookup is not a matmul
+    n = active_param_count(cfg) - cfg.vocab_size * cfg.d_model  # embedding lookup is not a matmul
     return 6.0 * n + 12.0 * cfg.n_layers * cfg.d_model * seq_len
 
 
@@ -197,8 +251,69 @@ def _attention(q, k, v, impl: str, mesh=None):
     return flash_attention.mha(q, k, v, causal=True, force_xla=(impl != "flash"))
 
 
+def _moe_mlp(h, layer_params, cfg: ModelConfig):
+    """Top-k routed mixture-of-experts MLP (Switch/MTF-style dense dispatch).
+
+    h: [B, S, D] → (out [B, S, D], aux_loss scalar). Static shapes
+    throughout: tokens beyond an expert's capacity are dropped (contribute
+    zero), the standard TPU-friendly formulation — no dynamic gather, all
+    dispatch/combine work is einsum on the MXU. Experts are sharded over the
+    "model" mesh axis via the "expert" logical axis (expert parallelism);
+    XLA inserts the all-to-all from the sharding annotations.
+    """
+    B, S, D = h.shape
+    E, K = cfg.n_experts, cfg.top_k
+    C = cfg.expert_capacity(S)
+
+    router_logits = jnp.einsum(
+        "bsd,de->bse", h, layer_params["router"]["kernel"],
+        preferred_element_type=jnp.float32,
+    )
+    probs = jax.nn.softmax(router_logits, axis=-1)  # [B, S, E] fp32
+
+    # Greedy top-k assignment with per-expert capacity, one k at a time so
+    # first choices claim capacity before second choices.
+    remaining = probs
+    count_so_far = jnp.zeros((B, E), jnp.float32)  # tokens already accepted
+    combine = jnp.zeros((B, S, E, C), h.dtype)
+    for _ in range(K):
+        idx = jnp.argmax(remaining, axis=-1)                      # [B, S]
+        mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)          # [B, S, E]
+        gate_val = jnp.sum(probs * mask, axis=-1)                 # [B, S]
+        # Position each token takes inside its expert's capacity buffer.
+        pos = jnp.cumsum(mask, axis=1) - 1 + count_so_far[:, None, :]
+        pos_tok = jnp.sum(pos * mask, axis=-1)                    # [B, S]
+        keep = (pos_tok < C) & (gate_val > 0)
+        count_so_far = count_so_far + jnp.sum(mask, axis=1)
+        onehot_pos = jax.nn.one_hot(pos_tok.astype(jnp.int32), C, dtype=jnp.float32)  # [B, S, C]
+        contrib = (gate_val * keep)[:, :, None, None] * mask[:, :, :, None] * onehot_pos[:, :, None, :]
+        combine = combine + contrib.astype(h.dtype)
+        remaining = remaining * (1.0 - mask)  # exclude chosen expert for next k
+
+    # Renormalise the kept top-k gates to sum to 1 per token.
+    denom = jnp.sum(combine, axis=(2, 3), keepdims=True)
+    combine = combine / jnp.maximum(denom, 1e-9).astype(h.dtype)
+    dispatch = (combine > 0).astype(h.dtype)                      # [B, S, E, C]
+
+    expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, h)         # [E, B, C, D]
+    gate = jnp.einsum("ebcd,edf->ebcf", expert_in, layer_params["gate"]["kernel"])
+    up = jnp.einsum("ebcd,edf->ebcf", expert_in, layer_params["up"]["kernel"])
+    expert_out = jnp.einsum(
+        "ebcf,efd->ebcd", jax.nn.silu(gate) * up, layer_params["down"]["kernel"]
+    )
+    out = jnp.einsum("bsec,ebcd->bsd", combine, expert_out)
+
+    # Load-balancing auxiliary loss (Switch Transformer eq. 4): fraction of
+    # tokens dispatched to each expert × mean router prob, scaled by E.
+    first_choice = jax.nn.one_hot(jnp.argmax(probs, axis=-1), E, dtype=jnp.float32)
+    f = jnp.mean(first_choice, axis=(0, 1))  # fraction per expert
+    p = jnp.mean(probs, axis=(0, 1))
+    aux = E * jnp.sum(f * p)
+    return out, aux
+
+
 def _block(x, layer_params, cfg: ModelConfig, positions, mesh=None):
-    """One transformer block. x: [B, S, D]."""
+    """One transformer block. x: [B, S, D] → (x, moe_aux_loss)."""
     B, S, D = x.shape
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
@@ -213,10 +328,14 @@ def _block(x, layer_params, cfg: ModelConfig, positions, mesh=None):
     x = x + jnp.einsum("bse,ed->bsd", attn, layer_params["o"]["kernel"])
 
     h = _rms_norm(x, layer_params["mlp_norm"]["scale"], cfg.norm_eps)
+    if cfg.is_moe:
+        mlp_out, aux = _moe_mlp(h, layer_params, cfg)
+        x = x + mlp_out
+        return x, aux
     gate = jnp.einsum("bsd,df->bsf", h, layer_params["gate"]["kernel"])
     up = jnp.einsum("bsd,df->bsf", h, layer_params["up"]["kernel"])
     x = x + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, layer_params["down"]["kernel"])
-    return x
+    return x, jnp.zeros((), jnp.float32)
 
 
 _REMAT_POLICIES = {
@@ -227,7 +346,7 @@ _REMAT_POLICIES = {
 }
 
 
-def forward(
+def forward_and_aux(
     params: dict[str, Any],
     tokens: jax.Array,
     cfg: ModelConfig,
@@ -236,10 +355,13 @@ def forward(
     remat_policy: str = "nothing_saveable",
     positions: Optional[jax.Array] = None,
     mesh=None,
-) -> jax.Array:
-    """Forward pass: tokens [B, S] int32 → logits [B, S, V] float32.
+) -> tuple[jax.Array, jax.Array]:
+    """Forward pass: tokens [B, S] int32 → (logits [B, S, V] float32,
+    aux_loss scalar float32).
 
-    ``mesh`` is only needed for ``attention_impl="ring"`` (sequence
+    ``aux_loss`` is the mean MoE load-balancing loss over layers (0 for
+    dense models) — add ``cfg.router_aux_coef * aux_loss`` to the training
+    loss. ``mesh`` is only needed for ``attention_impl="ring"`` (sequence
     parallelism), where the attention runs as a shard_map over the mesh's
     ``sequence`` axis.
     """
@@ -255,19 +377,37 @@ def forward(
                                params["layers"])
 
     def scan_body(carry, layer_params):
-        y = _block(carry, layer_params, cfg, positions, mesh=mesh)
-        return y, None
+        y, aux = _block(carry, layer_params, cfg, positions, mesh=mesh)
+        return y, aux
 
     body = scan_body
     if remat:
         policy = _REMAT_POLICIES.get(remat_policy, jax.checkpoint_policies.nothing_saveable)
         body = jax.checkpoint(scan_body, policy=policy, prevent_cse=True)
 
-    x, _ = lax.scan(body, x, layer_stack)
+    x, aux_per_layer = lax.scan(body, x, layer_stack)
 
     x = _rms_norm(x, params["final_norm"]["scale"].astype(compute_dtype), cfg.norm_eps)
     logits = jnp.einsum(
         "bsd,dv->bsv", x, params["lm_head"]["kernel"].astype(compute_dtype),
         preferred_element_type=jnp.float32,
+    )
+    return logits, jnp.mean(aux_per_layer)
+
+
+def forward(
+    params: dict[str, Any],
+    tokens: jax.Array,
+    cfg: ModelConfig,
+    compute_dtype=jnp.bfloat16,
+    remat: bool = False,
+    remat_policy: str = "nothing_saveable",
+    positions: Optional[jax.Array] = None,
+    mesh=None,
+) -> jax.Array:
+    """Forward pass: tokens [B, S] int32 → logits [B, S, V] float32."""
+    logits, _ = forward_and_aux(
+        params, tokens, cfg, compute_dtype=compute_dtype, remat=remat,
+        remat_policy=remat_policy, positions=positions, mesh=mesh,
     )
     return logits

@@ -86,11 +86,16 @@ def dtype_of(p: Precision):
 #   "kv_heads" — attention kv-head dimension
 #   "head_dim" — per-head feature dimension
 #   "mlp"      — MLP hidden dimension
+#   "expert"   — MoE expert dimension (expert parallelism)
 #   "layers"   — stacked-layer dimension (scan over layers)
 #   None       — never sharded
 
 # Tensor-parallel placement: which logical axes ride the "model" mesh axis.
-_TP_AXES = {"vocab": "model", "heads": "model", "kv_heads": "model", "mlp": "model"}
+# "expert" is listed FIRST: for MoE tensors ([..., expert, embed, mlp]) the
+# expert dimension claims the model axis (expert parallelism) and the mlp
+# dimension stays local — a PartitionSpec may not reuse a mesh axis.
+_TP_AXES = {"expert": "model", "vocab": "model", "heads": "model",
+            "kv_heads": "model", "mlp": "model"}
 
 # FSDP placement: which logical axes ride the "fsdp" mesh axis (only at
 # stage 3 for params; always for optimizer state at stage >= 1).
@@ -103,15 +108,29 @@ def logical_to_mesh_axes(
     shard_fsdp: bool,
     shard_tp: bool = True,
 ) -> P:
-    """Map a tuple of logical axis names to a PartitionSpec."""
+    """Map a tuple of logical axis names to a PartitionSpec.
+
+    Each mesh axis is assigned at most once per spec; among TP candidates in
+    the same tensor, the axis earlier in ``_TP_AXES``'s priority order wins
+    (e.g. "expert" over "mlp" for MoE expert kernels).
+    """
+    priority = {name: i for i, name in enumerate(_TP_AXES)}
+    tp_winner: Optional[str] = None
+    if shard_tp:
+        candidates = [ax for ax in logical if ax in _TP_AXES]
+        if candidates:
+            tp_winner = min(candidates, key=lambda a: priority[a])
     out: list[Optional[str]] = []
+    used: set[str] = set()
     for ax in logical:
         mesh_ax: Optional[str] = None
         if ax is not None:
-            if shard_tp and ax in _TP_AXES:
+            if ax == tp_winner and _TP_AXES[ax] not in used:
                 mesh_ax = _TP_AXES[ax]
-            elif shard_fsdp and ax in _FSDP_AXES:
+            elif shard_fsdp and ax in _FSDP_AXES and _FSDP_AXES[ax] not in used:
                 mesh_ax = _FSDP_AXES[ax]
+        if mesh_ax is not None:
+            used.add(mesh_ax)
         out.append(mesh_ax)
     # Trim trailing Nones for canonical specs.
     while out and out[-1] is None:
@@ -344,5 +363,15 @@ def presets() -> dict[str, TPUTrainConfig]:
             optimizer_offload=OffloadDevice.HOST,
             param_offload=OffloadDevice.HOST,
             remat_policy="nothing_saveable",
+        ),
+        "8x7b": TPUTrainConfig(  # Mixtral-style MoE: experts over "model" (EP)
+            model_name="moe-8x7b",
+            sharding_stage=ShardingStage.FULL_PARTITIONING,
+            mesh=MeshConfig(data=1, fsdp=4, model=8),
+            micro_batch_size=1,
+            gradient_accumulation_steps=16,
+            seq_len=4096,
+            learning_rate=2e-4,
+            optimizer_offload=OffloadDevice.HOST,
         ),
     }

@@ -204,7 +204,7 @@ def build_train_program(
     batch_sharding = NamedSharding(mesh, P(None, BATCH_AXES, seq_ax))
 
     def loss_fn(params, tokens):
-        logits = tfm.forward(
+        logits, aux = tfm.forward_and_aux(
             params,
             tokens,
             model_cfg,
@@ -213,7 +213,10 @@ def build_train_program(
             remat_policy=cfg.remat_policy,
             mesh=mesh if model_cfg.attention_impl == "ring" else None,
         )
-        return lm_loss(logits, tokens)
+        loss = lm_loss(logits, tokens)
+        if model_cfg.is_moe:
+            loss = loss + model_cfg.router_aux_coef * aux
+        return loss
 
     grad_fn = jax.value_and_grad(loss_fn)
 
