@@ -33,7 +33,8 @@ async def root(request: web.Request) -> web.Response:
             "features": [
                 "TPU fleet telemetry and health-gated device selection",
                 "ZeRO-stage (0-3) sharded training launch on a jax.sharding.Mesh",
-                "tensor-parallel 'model' axis and reservable 'sequence' axis",
+                "tensor ('model'), pipeline ('pipe'), sequence/ring-attention "
+                "('sequence'), and expert parallelism on one mesh",
                 "loss-spike / divergence / plateau / grad-norm / LR monitoring",
                 "Orbax checkpointing with stable-pointer rollback and auto-resume",
                 "preemption watcher with emergency checkpoint",

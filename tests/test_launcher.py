@@ -26,7 +26,7 @@ def tiny_config(**kw):
 
 def test_generate_plan_contents():
     plan = TPULauncher().generate_plan(tiny_config())
-    assert plan["mesh"]["shape"] == {"data": 2, "fsdp": 4, "sequence": 1, "model": 1}
+    assert plan["mesh"]["shape"] == {"data": 2, "fsdp": 4, "pipe": 1, "sequence": 1, "model": 1}
     assert plan["sharding"]["stage"] == 3
     assert plan["sharding"]["semantics"]["params"] == "sharded over fsdp"
     assert plan["batch"]["effective_batch_size"] == 8

@@ -12,18 +12,20 @@ def test_eight_virtual_devices():
 
 def test_default_mesh_absorbs_all_devices():
     mesh = build_mesh()
-    assert mesh.axis_names == ("data", "fsdp", "sequence", "model")
-    assert mesh.devices.shape == (8, 1, 1, 1)
+    assert mesh.axis_names == ("data", "fsdp", "pipe", "sequence", "model")
+    assert mesh.devices.shape == (8, 1, 1, 1, 1)
 
 
 @pytest.mark.parametrize(
     "cfg,expected",
     [
-        (MeshConfig(fsdp=8), (1, 8, 1, 1)),
-        (MeshConfig(fsdp=4), (2, 4, 1, 1)),
-        (MeshConfig(model=2, fsdp=2), (2, 2, 1, 2)),
-        (MeshConfig(sequence=4), (2, 1, 4, 1)),
-        (MeshConfig(data=8), (8, 1, 1, 1)),
+        (MeshConfig(fsdp=8), (1, 8, 1, 1, 1)),
+        (MeshConfig(fsdp=4), (2, 4, 1, 1, 1)),
+        (MeshConfig(model=2, fsdp=2), (2, 2, 1, 1, 2)),
+        (MeshConfig(sequence=4), (2, 1, 1, 4, 1)),
+        (MeshConfig(pipe=4), (2, 1, 4, 1, 1)),
+        (MeshConfig(pipe=2, model=2), (2, 1, 2, 1, 2)),
+        (MeshConfig(data=8), (8, 1, 1, 1, 1)),
     ],
 )
 def test_mesh_shape_resolution(cfg, expected):
@@ -40,7 +42,7 @@ def test_mesh_shape_errors():
 
 def test_runtime_shardings_and_sizes():
     rt = MeshRuntime(MeshConfig(fsdp=4))
-    assert rt.axis_sizes == {"data": 2, "fsdp": 4, "sequence": 1, "model": 1}
+    assert rt.axis_sizes == {"data": 2, "fsdp": 4, "pipe": 1, "sequence": 1, "model": 1}
     assert rt.data_parallel_size() == 8
     assert rt.n_devices == 8
     sh = rt.batch_sharding()
@@ -52,7 +54,7 @@ def test_topology_report_is_real():
     report = rt.topology_report()
     assert report["num_devices"] == 8
     assert len(report["devices"]) == 8
-    assert report["mesh"]["axes"] == {"data": 8, "fsdp": 1, "sequence": 1, "model": 1}
+    assert report["mesh"]["axes"] == {"data": 8, "fsdp": 1, "pipe": 1, "sequence": 1, "model": 1}
     ids = {d["id"] for d in report["devices"]}
     assert len(ids) == 8  # real device ids, not a canned matrix
 

@@ -68,15 +68,15 @@ def test_expert_parallel_sharding_specs():
     """Expert kernels shard expert→model; mlp stays local (no axis reuse)."""
     specs = param_pspecs(tfm.logical_axes(CFG), ShardingStage.FULL_PARTITIONING)
     gate = tuple(specs["layers"]["gate"]["kernel"])
-    # (layers, expert, embed, mlp) → (None, "model", "fsdp") [trailing None trimmed]
-    assert gate == (None, "model", "fsdp")
+    # (layers, expert, embed, mlp) → ("pipe", "model", "fsdp") [trailing None trimmed]
+    assert gate == ("pipe", "model", "fsdp")
     router = tuple(specs["layers"]["router"]["kernel"])
     assert "model" not in router  # router output dim (E) replicated
     # Dense models are unchanged by the priority rule.
     dense_specs = param_pspecs(
         tfm.logical_axes(tfm.MODEL_CONFIGS["gpt-tiny"]), ShardingStage.FULL_PARTITIONING
     )
-    assert tuple(dense_specs["layers"]["gate"]["kernel"]) == (None, "fsdp", "model")
+    assert tuple(dense_specs["layers"]["gate"]["kernel"]) == ("pipe", "fsdp", "model")
 
 
 def test_moe_grads_reach_all_experts():

@@ -50,17 +50,17 @@ def test_param_placement_per_stage():
     prog3, state3, _ = run_steps(cfg3, n=1)
     q_sh = state3["params"]["layers"]["q"]["kernel"].sharding
     # logical (layers, embed, heads) → (None, fsdp, model-axis-for-TP)
-    assert q_sh.spec == jax.sharding.PartitionSpec(None, "fsdp", "model")
+    assert q_sh.spec == jax.sharding.PartitionSpec("pipe", "fsdp", "model")
 
     cfg1 = tiny_config(sharding_stage=ShardingStage.OPTIMIZER_STATE)
     prog1 = build_train_program(cfg1)
     state1 = prog1.init(jax.random.PRNGKey(0))
     # Params NOT fsdp-sharded at stage 1...
     p_sh = state1["params"]["layers"]["q"]["kernel"].sharding
-    assert p_sh.spec == jax.sharding.PartitionSpec(None, None, "model")
+    assert p_sh.spec == jax.sharding.PartitionSpec("pipe", None, "model")
     # ...but adam mu for the same param is fsdp-sharded (ZeRO-1).
     mu = state1["opt_state"][1].mu["layers"]["q"]["kernel"]
-    assert mu.sharding.spec == jax.sharding.PartitionSpec(None, "fsdp", "model")
+    assert mu.sharding.spec == jax.sharding.PartitionSpec("pipe", "fsdp", "model")
 
 
 def test_stage0_and_stage3_agree():
@@ -95,7 +95,7 @@ def test_tensor_parallel_mesh_runs():
     cfg = tiny_config(mesh=MeshConfig(data=2, fsdp=2, model=2))
     _, state, losses = run_steps(cfg, n=3)
     q = state["params"]["layers"]["q"]["kernel"]
-    assert q.sharding.spec == jax.sharding.PartitionSpec(None, "fsdp", "model")
+    assert q.sharding.spec == jax.sharding.PartitionSpec("pipe", "fsdp", "model")
     # Actually split over 2 fsdp × 2 model devices.
     assert q.addressable_shards[0].data.shape[1] == q.shape[1] // 2
     assert q.addressable_shards[0].data.shape[2] == q.shape[2] // 2

@@ -28,7 +28,7 @@ from typing import Any, Callable, Optional
 import jax
 from pydantic import BaseModel, Field
 
-from tpu_engine.mesh_runtime import MeshConfig
+from tpu_engine.mesh_runtime import MESH_AXES, MeshConfig
 from tpu_engine.models import transformer as tfm
 from tpu_engine.sharding import (
     ShardingStage,
@@ -75,9 +75,7 @@ class TPULauncher:
         model_cfg = tfm.MODEL_CONFIGS.get(config.model_name)
         n_avail = jax.device_count()
         try:
-            mesh_shape = dict(
-                zip(("data", "fsdp", "sequence", "model"), config.mesh.resolved_shape(n_avail))
-            )
+            mesh_shape = dict(zip(MESH_AXES, config.mesh.resolved_shape(n_avail)))
             mesh_note = f"resolved on {n_avail} visible device(s)"
         except ValueError:
             mesh_shape = config.mesh.model_dump()
@@ -115,7 +113,7 @@ class TPULauncher:
                 "seq_len": config.seq_len,
             },
             "mesh": {"shape": mesh_shape, "note": mesh_note, "axes_order_note":
-                     "outer→inner = DCN-most→ICI-most: (data, fsdp, sequence, model)"},
+                     "outer→inner = DCN-most→ICI-most: " + str(MESH_AXES)},
             "sharding": {
                 "stage": int(stage),
                 "stage_name": ShardingStage(stage).name,

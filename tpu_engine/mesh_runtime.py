@@ -14,10 +14,12 @@ TPU-native replacement for the reference's rendezvous + topology surface:
 
 Mesh axes (outer → inner, i.e. DCN-most → ICI-most):
 
-``("data", "fsdp", "sequence", "model")``
+``("data", "fsdp", "pipe", "sequence", "model")``
 
 - ``data``      — pure data parallelism (gradients all-reduced),
 - ``fsdp``      — ZeRO-style sharding axis (params/grads/optimizer state),
+- ``pipe``      — pipeline parallelism (layer stack sharded into stages;
+  activations stream stage-to-stage via collective permute),
 - ``sequence``  — context/sequence parallelism (ring attention),
 - ``model``     — tensor parallelism (sharded matmuls).
 
@@ -37,7 +39,7 @@ from jax.experimental import mesh_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from pydantic import BaseModel, Field, model_validator
 
-MESH_AXES = ("data", "fsdp", "sequence", "model")
+MESH_AXES = ("data", "fsdp", "pipe", "sequence", "model")
 
 # Axes over which the batch dimension is sharded (everything that is not
 # tensor- or sequence-parallel).
@@ -54,6 +56,7 @@ class MeshConfig(BaseModel):
 
     data: int = Field(default=-1, ge=-1, description="data-parallel axis size (-1 = infer)")
     fsdp: int = Field(default=1, ge=1, description="ZeRO/FSDP sharding axis size")
+    pipe: int = Field(default=1, ge=1, description="pipeline-parallel axis size (stages)")
     sequence: int = Field(default=1, ge=1, description="sequence/context-parallel axis size")
     model: int = Field(default=1, ge=1, description="tensor-parallel axis size")
 
@@ -63,22 +66,23 @@ class MeshConfig(BaseModel):
             raise ValueError("data axis size must be -1 (infer) or >= 1")
         return self
 
-    def resolved_shape(self, n_devices: int) -> tuple[int, int, int, int]:
+    def resolved_shape(self, n_devices: int) -> tuple[int, int, int, int, int]:
         """Resolve ``-1`` and validate the shape against the device count."""
-        fixed = self.fsdp * self.sequence * self.model
+        fixed = self.fsdp * self.pipe * self.sequence * self.model
         if fixed <= 0 or n_devices % fixed != 0:
             raise ValueError(
-                f"fsdp*sequence*model = {fixed} does not divide device count {n_devices}"
+                f"fsdp*pipe*sequence*model = {fixed} does not divide device count {n_devices}"
             )
         data = self.data
         if data == -1:
             data = n_devices // fixed
         if data * fixed != n_devices:
             raise ValueError(
-                f"mesh shape data={data} fsdp={self.fsdp} sequence={self.sequence} "
-                f"model={self.model} needs {data * fixed} devices, have {n_devices}"
+                f"mesh shape data={data} fsdp={self.fsdp} pipe={self.pipe} "
+                f"sequence={self.sequence} model={self.model} needs "
+                f"{data * fixed} devices, have {n_devices}"
             )
-        return (data, self.fsdp, self.sequence, self.model)
+        return (data, self.fsdp, self.pipe, self.sequence, self.model)
 
 
 def detect_topology(devices: Optional[Sequence[jax.Device]] = None) -> dict[str, Any]:

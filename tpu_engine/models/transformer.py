@@ -346,6 +346,29 @@ _REMAT_POLICIES = {
 }
 
 
+def embed_tokens(params: dict[str, Any], tokens: jax.Array, compute_dtype=jnp.bfloat16) -> jax.Array:
+    """Embedding lookup: tokens [..., S] int32 → activations [..., S, D]."""
+    embed = params["embed"]["embedding"].astype(compute_dtype)
+    return jnp.take(embed, tokens, axis=0)
+
+
+def unembed(params: dict[str, Any], x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Final norm + LM head: activations [..., S, D] → logits [..., S, V] fp32."""
+    x = _rms_norm(x, params["final_norm"]["scale"].astype(x.dtype), cfg.norm_eps)
+    return jnp.einsum(
+        "...sd,dv->...sv", x, params["lm_head"]["kernel"].astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def cast_layer_stack(params: dict[str, Any], compute_dtype=jnp.bfloat16) -> dict[str, Any]:
+    """The stacked per-layer params ([L, ...] leaves) cast to compute dtype."""
+    return jax.tree.map(
+        lambda a: a.astype(compute_dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a,
+        params["layers"],
+    )
+
+
 def forward_and_aux(
     params: dict[str, Any],
     tokens: jax.Array,
@@ -369,12 +392,8 @@ def forward_and_aux(
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
 
-    embed = params["embed"]["embedding"].astype(compute_dtype)
-    x = jnp.take(embed, tokens, axis=0)  # [B, S, D]
-
-    layer_stack = jax.tree.map(lambda a: a.astype(compute_dtype)
-                               if jnp.issubdtype(a.dtype, jnp.floating) else a,
-                               params["layers"])
+    x = embed_tokens(params, tokens, compute_dtype)  # [B, S, D]
+    layer_stack = cast_layer_stack(params, compute_dtype)
 
     def scan_body(carry, layer_params):
         y, aux = _block(carry, layer_params, cfg, positions, mesh=mesh)
@@ -387,11 +406,7 @@ def forward_and_aux(
 
     x, aux_per_layer = lax.scan(body, x, layer_stack)
 
-    x = _rms_norm(x, params["final_norm"]["scale"].astype(compute_dtype), cfg.norm_eps)
-    logits = jnp.einsum(
-        "bsd,dv->bsv", x, params["lm_head"]["kernel"].astype(compute_dtype),
-        preferred_element_type=jnp.float32,
-    )
+    logits = unembed(params, x, cfg)
     return logits, jnp.mean(aux_per_layer)
 
 

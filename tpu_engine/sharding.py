@@ -101,6 +101,13 @@ _TP_AXES = {"expert": "model", "vocab": "model", "heads": "model",
 # stage 3 for params; always for optimizer state at stage >= 1).
 _FSDP_AXES = {"embed": "fsdp"}
 
+# Pipeline placement: the stacked-layer dimension is sharded over the "pipe"
+# mesh axis (contiguous blocks of n_layers/pipe layers per stage). With
+# pipe == 1 this is a no-op; with pipe > 1 the train program switches to the
+# pipelined schedule (tpu_engine/parallel/pipeline.py). Applies at every
+# ZeRO stage — pipeline parallelism is orthogonal to param/grad/opt sharding.
+_PIPE_AXES = {"layers": "pipe"}
+
 
 def logical_to_mesh_axes(
     logical: tuple[Optional[str], ...],
@@ -125,7 +132,9 @@ def logical_to_mesh_axes(
     for ax in logical:
         mesh_ax: Optional[str] = None
         if ax is not None:
-            if ax == tp_winner and _TP_AXES[ax] not in used:
+            if ax in _PIPE_AXES and _PIPE_AXES[ax] not in used:
+                mesh_ax = _PIPE_AXES[ax]
+            elif ax == tp_winner and _TP_AXES[ax] not in used:
                 mesh_ax = _TP_AXES[ax]
             elif shard_fsdp and ax in _FSDP_AXES and _FSDP_AXES[ax] not in used:
                 mesh_ax = _FSDP_AXES[ax]

@@ -147,6 +147,16 @@ def build_train_program(
     compute_dtype = cfg.compute_dtype()
     master_dtype = cfg.master_dtype()
 
+    # Pipeline parallelism: a >1 'pipe' axis switches the step to the GPipe
+    # schedule (tpu_engine/parallel/pipeline.py); the gradient-accumulation
+    # microbatches become the pipeline stream.
+    pipe_size = runtime.axis_sizes["pipe"]
+    if pipe_size > 1 and model_cfg.n_layers % pipe_size != 0:
+        raise ValueError(
+            f"model n_layers={model_cfg.n_layers} must be divisible by the "
+            f"pipe axis size {pipe_size}"
+        )
+
     logical = tfm.logical_axes(model_cfg)
     p_pspecs = param_pspecs(logical, stage)
     g_pspecs = grad_pspecs(logical, stage)
@@ -220,26 +230,82 @@ def build_train_program(
 
     grad_fn = jax.value_and_grad(loss_fn)
 
+    # ---- pipelined loss (pipe axis > 1): one forward over all microbatches,
+    # streamed through the stages; autodiff gives the reverse pipeline. ----
+    if pipe_size > 1:
+        from tpu_engine.parallel.pipeline import pipeline_apply, stage_layer_stack
+
+        def _staged_spec(spec: P) -> P:
+            parts = tuple(spec)
+            return P(parts[0] if parts else None, None, *parts[1:])
+
+        staged_sh = named_shardings(
+            mesh,
+            jax.tree.map(_staged_spec, p_pspecs["layers"], is_leaf=lambda x: isinstance(x, P)),
+        )
+        buf_sh = NamedSharding(mesh, P("pipe", BATCH_AXES, seq_ax))
+
+        def pipe_loss_fn(params, batch):
+            accum = batch.shape[0]
+            B, S = batch.shape[1], batch.shape[2]
+            positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+            x_mb = tfm.embed_tokens(params, batch, compute_dtype)  # [M, B, S, D]
+            staged = stage_layer_stack(
+                tfm.cast_layer_stack(params, compute_dtype), pipe_size, model_cfg.n_layers
+            )
+            staged = jax.lax.with_sharding_constraint(staged, staged_sh)
+            outputs, aux_mean = pipeline_apply(
+                staged,
+                x_mb,
+                model_cfg,
+                positions=positions,
+                mesh=mesh if model_cfg.attention_impl == "ring" else None,
+                remat=cfg.activation_checkpointing,
+                remat_policy=cfg.remat_policy,
+                buf_sharding=buf_sh,
+            )
+
+            def loss_body(acc, xs):
+                out, toks = xs
+                return acc + lm_loss(tfm.unembed(params, out, model_cfg), toks), None
+
+            body = jax.checkpoint(loss_body) if cfg.activation_checkpointing else loss_body
+            loss_sum, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (outputs, batch))
+            loss = loss_sum / accum
+            if model_cfg.is_moe:
+                loss = loss + model_cfg.router_aux_coef * aux_mean
+            return loss
+
+        pipe_grad_fn = jax.value_and_grad(pipe_loss_fn)
+
     def train_step(state, batch):
         params = state["params"]
 
-        def accum_body(carry, tokens):
-            loss_acc, grad_acc = carry
-            loss, grads = grad_fn(params, tokens)
+        if pipe_size > 1:
+            loss, grads = pipe_grad_fn(params, batch)
             grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
-            # Stage >= 2: constrain accumulated grads to fsdp shards so XLA
-            # reduce-scatters instead of all-reducing (ZeRO-2 semantics).
             grads = jax.lax.with_sharding_constraint(grads, grad_sh)
-            grad_acc = jax.tree.map(jnp.add, grad_acc, grads)
-            return (loss_acc + loss, grad_acc), None
+        else:
 
-        zero_grads = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
-        zero_grads = jax.lax.with_sharding_constraint(zero_grads, grad_sh)
-        (loss_sum, grad_sum), _ = jax.lax.scan(accum_body, (jnp.zeros((), jnp.float32), zero_grads), batch)
+            def accum_body(carry, tokens):
+                loss_acc, grad_acc = carry
+                loss, grads = grad_fn(params, tokens)
+                grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+                # Stage >= 2: constrain accumulated grads to fsdp shards so XLA
+                # reduce-scatters instead of all-reducing (ZeRO-2 semantics).
+                grads = jax.lax.with_sharding_constraint(grads, grad_sh)
+                grad_acc = jax.tree.map(jnp.add, grad_acc, grads)
+                return (loss_acc + loss, grad_acc), None
 
-        accum = batch.shape[0]
-        loss = loss_sum / accum
-        grads = jax.tree.map(lambda g: g / accum, grad_sum)
+            zero_grads = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            zero_grads = jax.lax.with_sharding_constraint(zero_grads, grad_sh)
+            (loss_sum, grad_sum), _ = jax.lax.scan(
+                accum_body, (jnp.zeros((), jnp.float32), zero_grads), batch
+            )
+
+            accum = batch.shape[0]
+            loss = loss_sum / accum
+            grads = jax.tree.map(lambda g: g / accum, grad_sum)
         grad_norm = optax.global_norm(grads)
 
         lr = schedule(state["step"]).astype(jnp.float32) * state["lr_scale"]
