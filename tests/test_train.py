@@ -109,3 +109,32 @@ def test_forward_shapes_and_dtype():
     logits = tfm.forward(params, tokens, cfg, compute_dtype=jnp.float32)
     assert logits.shape == (2, 16, cfg.vocab_size)
     assert logits.dtype == jnp.float32
+
+
+def test_chunked_loss_matches_unchunked():
+    """loss_chunk_size computes CE blockwise; must be numerically identical."""
+    ref = run_steps(tiny_config(activation_checkpointing=False), n=2)[2]
+    chunked = run_steps(
+        tiny_config(activation_checkpointing=False, loss_chunk_size=8), n=2
+    )[2]
+    np.testing.assert_allclose(ref, chunked, rtol=1e-6)
+
+
+def test_chunk_size_must_divide_seq_len():
+    with pytest.raises(ValueError, match="divide"):
+        build_train_program(tiny_config(loss_chunk_size=7))  # 32 % 7 != 0
+
+
+@pytest.mark.parametrize("policy", ["save_attn_out", "save_qkv_attn_out"])
+def test_named_remat_policies_match(policy):
+    """Named checkpoint policies change memory, never math."""
+    ref = run_steps(tiny_config(activation_checkpointing=False), n=2)[2]
+    got = run_steps(
+        tiny_config(activation_checkpointing=True, remat_policy=policy), n=2
+    )[2]
+    np.testing.assert_allclose(ref, got, rtol=1e-6)
+
+
+def test_unknown_remat_policy_rejected():
+    with pytest.raises(ValueError, match="remat_policy"):
+        build_train_program(tiny_config(remat_policy="attn_out"))  # typo

@@ -30,6 +30,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 
 @dataclass(frozen=True)
@@ -312,19 +313,27 @@ def _moe_mlp(h, layer_params, cfg: ModelConfig):
     return out, aux
 
 
-def _block(x, layer_params, cfg: ModelConfig, positions, mesh=None):
-    """One transformer block. x: [B, S, D] → (x, moe_aux_loss)."""
+def _block(x, layer_params, cfg: ModelConfig, positions, mesh=None, tag_names=False):
+    """One transformer block. x: [B, S, D] → (x, moe_aux_loss).
+
+    ``tag_names=True`` tags q/k/v/attn_out with ``checkpoint_name`` for the
+    named remat policies (save_attn_out / save_qkv_attn_out). Tagging is
+    opt-in because the names act as optimisation barriers: under a non-named
+    policy they cost ~1.5 GB of pointlessly-saved rope buffers at 1B scale.
+    """
     B, S, D = x.shape
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    tag = checkpoint_name if tag_names else (lambda a, _name: a)
 
     h = _rms_norm(x, layer_params["attn_norm"]["scale"], cfg.norm_eps)
     q = jnp.einsum("bsd,de->bse", h, layer_params["q"]["kernel"]).reshape(B, S, H, HD)
     k = jnp.einsum("bsd,de->bse", h, layer_params["k"]["kernel"]).reshape(B, S, KV, HD)
     v = jnp.einsum("bsd,de->bse", h, layer_params["v"]["kernel"]).reshape(B, S, KV, HD)
-    q = _rope(q, positions, cfg.rope_theta)
-    k = _rope(k, positions, cfg.rope_theta)
+    q = tag(_rope(q, positions, cfg.rope_theta), "q")
+    k = tag(_rope(k, positions, cfg.rope_theta), "k")
+    v = tag(v, "v")
     attn = _attention(q, k, v, cfg.attention_impl, mesh=mesh)
-    attn = attn.reshape(B, S, H * HD)
+    attn = tag(attn.reshape(B, S, H * HD), "attn_out")
     x = x + jnp.einsum("bse,ed->bsd", attn, layer_params["o"]["kernel"])
 
     h = _rms_norm(x, layer_params["mlp_norm"]["scale"], cfg.norm_eps)
@@ -343,7 +352,21 @@ _REMAT_POLICIES = {
     "dots_saveable": jax.checkpoint_policies.dots_saveable,
     "dots_with_no_batch_dims_saveable": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
     "everything_saveable": jax.checkpoint_policies.everything_saveable,
+    # Named-offset policies (activations tagged with checkpoint_name in
+    # _block): skip recomputing attention — and optionally the qkv
+    # projections + rope — in the backward pass, at a small, bounded
+    # activation-memory cost per layer. The TPU analogue of selectively
+    # tuning DeepSpeed's activation-checkpointing granularity
+    # (reference ``deepspeed_launcher.py:215-223``).
+    "save_attn_out": jax.checkpoint_policies.save_only_these_names("attn_out"),
+    "save_qkv_attn_out": jax.checkpoint_policies.save_only_these_names(
+        "q", "k", "v", "attn_out"
+    ),
 }
+
+# Policies that rely on checkpoint_name tags in _block (tagging is opt-in —
+# under other policies the tags would only add optimisation barriers).
+NAMED_REMAT_POLICIES = frozenset({"save_attn_out", "save_qkv_attn_out"})
 
 
 def embed_tokens(params: dict[str, Any], tokens: jax.Array, compute_dtype=jnp.bfloat16) -> jax.Array:
@@ -369,6 +392,45 @@ def cast_layer_stack(params: dict[str, Any], compute_dtype=jnp.bfloat16) -> dict
     )
 
 
+def forward_hidden_and_aux(
+    params: dict[str, Any],
+    tokens: jax.Array,
+    cfg: ModelConfig,
+    compute_dtype=jnp.bfloat16,
+    remat: bool = False,
+    remat_policy: str = "nothing_saveable",
+    positions: Optional[jax.Array] = None,
+    mesh=None,
+) -> tuple[jax.Array, jax.Array]:
+    """Decoder stack only: tokens [B, S] int32 → (hidden [B, S, D] in the
+    compute dtype — final norm / LM head NOT applied, see :func:`unembed` —
+    and the mean MoE aux loss).
+
+    The whole layer stack is cast to the compute dtype up front (casting
+    per-layer inside the scan body reads cheaper but is a pessimisation:
+    XLA saves the *master-dtype* param slices as loop residuals for the
+    backward pass, costing a full fp32 copy instead of a bf16 one)."""
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+
+    x = embed_tokens(params, tokens, compute_dtype)  # [B, S, D]
+    layer_stack = cast_layer_stack(params, compute_dtype)
+    tag_names = remat and remat_policy in NAMED_REMAT_POLICIES
+
+    def scan_body(carry, layer_params):
+        y, aux = _block(carry, layer_params, cfg, positions, mesh=mesh, tag_names=tag_names)
+        return y, aux
+
+    body = scan_body
+    if remat:
+        policy = _REMAT_POLICIES.get(remat_policy, jax.checkpoint_policies.nothing_saveable)
+        body = jax.checkpoint(scan_body, policy=policy, prevent_cse=True)
+
+    x, aux_per_layer = lax.scan(body, x, layer_stack)
+    return x, jnp.mean(aux_per_layer)
+
+
 def forward_and_aux(
     params: dict[str, Any],
     tokens: jax.Array,
@@ -388,26 +450,11 @@ def forward_and_aux(
     parallelism), where the attention runs as a shard_map over the mesh's
     ``sequence`` axis.
     """
-    B, S = tokens.shape
-    if positions is None:
-        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
-
-    x = embed_tokens(params, tokens, compute_dtype)  # [B, S, D]
-    layer_stack = cast_layer_stack(params, compute_dtype)
-
-    def scan_body(carry, layer_params):
-        y, aux = _block(carry, layer_params, cfg, positions, mesh=mesh)
-        return y, aux
-
-    body = scan_body
-    if remat:
-        policy = _REMAT_POLICIES.get(remat_policy, jax.checkpoint_policies.nothing_saveable)
-        body = jax.checkpoint(scan_body, policy=policy, prevent_cse=True)
-
-    x, aux_per_layer = lax.scan(body, x, layer_stack)
-
-    logits = unembed(params, x, cfg)
-    return logits, jnp.mean(aux_per_layer)
+    x, aux = forward_hidden_and_aux(
+        params, tokens, cfg, compute_dtype=compute_dtype, remat=remat,
+        remat_policy=remat_policy, positions=positions, mesh=mesh,
+    )
+    return unembed(params, x, cfg), aux
 
 
 def forward(

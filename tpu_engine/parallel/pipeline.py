@@ -91,8 +91,12 @@ def pipeline_apply(
     ticks = M + n_stages - 1
     stage_ids = jnp.arange(n_stages)
 
+    tag_names = remat and remat_policy in tfm.NAMED_REMAT_POLICIES
+
     def block_body(carry, layer_params):
-        y, aux = tfm._block(carry, layer_params, cfg, positions, mesh=mesh)
+        y, aux = tfm._block(
+            carry, layer_params, cfg, positions, mesh=mesh, tag_names=tag_names
+        )
         return y, aux
 
     body = block_body

@@ -264,8 +264,13 @@ class TPUTrainConfig(BaseModel):
     remat_policy: str = Field(
         default="nothing_saveable",
         description="jax.checkpoint policy name: nothing_saveable | dots_saveable | "
-        "dots_with_no_batch_dims_saveable | everything_saveable",
+        "dots_with_no_batch_dims_saveable | everything_saveable | save_attn_out | "
+        "save_qkv_attn_out",
     )
+    # Cross-entropy computed this many sequence positions at a time, so the
+    # fp32 [B, S, vocab] logits tensor is never fully materialised. None =
+    # single unchunked unembed+softmax. Must divide seq_len.
+    loss_chunk_size: Optional[int] = Field(default=None, ge=1)
 
     # Elasticity (reference :78,226-238): TPU slices are fixed-shape, so
     # elasticity means re-launch at a new mesh shape + resume from checkpoint.

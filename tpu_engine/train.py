@@ -82,6 +82,45 @@ def lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     return -jnp.mean(ll)
 
 
+def chunked_lm_loss(
+    params: Any,
+    hidden: jax.Array,
+    tokens: jax.Array,
+    model_cfg: tfm.ModelConfig,
+    chunk: int,
+) -> jax.Array:
+    """Next-token cross-entropy computed ``chunk`` sequence positions at a
+    time, so the full fp32 [B, S, V] logits tensor is never materialised
+    (at 1B scale that buffer plus its softmax temp is ~4 GB of HBM — often
+    the difference between fitting a config and not). The chunk body is
+    wrapped in ``jax.checkpoint`` so the backward pass recomputes each
+    chunk's logits instead of keeping them alive.
+
+    Numerically identical to ``lm_loss(unembed(params, hidden), tokens)``.
+    """
+    B, S, D = hidden.shape
+    n_chunks = S // chunk
+    h = hidden.reshape(B, n_chunks, chunk, D).swapaxes(0, 1)  # [n, B, chunk, D]
+    # Target for position i is tokens[i+1]; the final position has none.
+    tgt = jnp.concatenate(
+        [tokens[:, 1:], jnp.full((B, 1), -1, tokens.dtype)], axis=1
+    ).reshape(B, n_chunks, chunk).swapaxes(0, 1)
+
+    def body(acc, xs):
+        hc, tc = xs
+        logp = jax.nn.log_softmax(tfm.unembed(params, hc, model_cfg), axis=-1)
+        mask = tc >= 0
+        ll = jnp.take_along_axis(
+            logp, jnp.maximum(tc, 0)[..., None].astype(jnp.int32), axis=-1
+        ).squeeze(-1)
+        return acc + jnp.sum(ll * mask), None
+
+    total, _ = jax.lax.scan(
+        jax.checkpoint(body), jnp.zeros((), jnp.float32), (h, tgt)
+    )
+    return -total / (B * (S - 1))
+
+
 @dataclass
 class TrainProgram:
     """A compiled, sharded training program bound to a mesh.
@@ -156,6 +195,15 @@ def build_train_program(
             f"model n_layers={model_cfg.n_layers} must be divisible by the "
             f"pipe axis size {pipe_size}"
         )
+    if cfg.loss_chunk_size and cfg.seq_len % cfg.loss_chunk_size != 0:
+        raise ValueError(
+            f"loss_chunk_size={cfg.loss_chunk_size} must divide seq_len={cfg.seq_len}"
+        )
+    if cfg.activation_checkpointing and cfg.remat_policy not in tfm._REMAT_POLICIES:
+        raise ValueError(
+            f"unknown remat_policy {cfg.remat_policy!r}; valid: "
+            f"{sorted(tfm._REMAT_POLICIES)}"
+        )
 
     logical = tfm.logical_axes(model_cfg)
     p_pspecs = param_pspecs(logical, stage)
@@ -214,7 +262,7 @@ def build_train_program(
     batch_sharding = NamedSharding(mesh, P(None, BATCH_AXES, seq_ax))
 
     def loss_fn(params, tokens):
-        logits, aux = tfm.forward_and_aux(
+        hidden, aux = tfm.forward_hidden_and_aux(
             params,
             tokens,
             model_cfg,
@@ -223,7 +271,10 @@ def build_train_program(
             remat_policy=cfg.remat_policy,
             mesh=mesh if model_cfg.attention_impl == "ring" else None,
         )
-        loss = lm_loss(logits, tokens)
+        if cfg.loss_chunk_size:
+            loss = chunked_lm_loss(params, hidden, tokens, model_cfg, cfg.loss_chunk_size)
+        else:
+            loss = lm_loss(tfm.unembed(params, hidden, model_cfg), tokens)
         if model_cfg.is_moe:
             loss = loss + model_cfg.router_aux_coef * aux
         return loss
@@ -267,6 +318,10 @@ def build_train_program(
 
             def loss_body(acc, xs):
                 out, toks = xs
+                if cfg.loss_chunk_size:
+                    return acc + chunked_lm_loss(
+                        params, out, toks, model_cfg, cfg.loss_chunk_size
+                    ), None
                 return acc + lm_loss(tfm.unembed(params, out, model_cfg), toks), None
 
             body = jax.checkpoint(loss_body) if cfg.activation_checkpointing else loss_body
