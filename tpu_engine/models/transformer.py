@@ -369,6 +369,37 @@ _REMAT_POLICIES = {
 NAMED_REMAT_POLICIES = frozenset({"save_attn_out", "save_qkv_attn_out"})
 
 
+def resolve_remat_policy(name: str):
+    """Strict policy lookup: (policy, needs_name_tags). Raises on typos —
+    a silent fallback would train with the wrong memory profile."""
+    if name not in _REMAT_POLICIES:
+        raise ValueError(
+            f"unknown remat_policy {name!r}; valid: {sorted(_REMAT_POLICIES)}"
+        )
+    return _REMAT_POLICIES[name], name in NAMED_REMAT_POLICIES
+
+
+def remat_scan_body(
+    cfg: ModelConfig,
+    positions: jax.Array,
+    mesh,
+    remat: bool,
+    remat_policy: str,
+):
+    """The (optionally remat-wrapped) per-layer scan body shared by the
+    plain forward and the pipelined forward."""
+    policy, tag_names = (None, False) if not remat else resolve_remat_policy(remat_policy)
+
+    def scan_body(carry, layer_params):
+        return _block(
+            carry, layer_params, cfg, positions, mesh=mesh, tag_names=tag_names
+        )
+
+    if remat:
+        return jax.checkpoint(scan_body, policy=policy, prevent_cse=True)
+    return scan_body
+
+
 def embed_tokens(params: dict[str, Any], tokens: jax.Array, compute_dtype=jnp.bfloat16) -> jax.Array:
     """Embedding lookup: tokens [..., S] int32 → activations [..., S, D]."""
     embed = params["embed"]["embedding"].astype(compute_dtype)
@@ -416,17 +447,7 @@ def forward_hidden_and_aux(
 
     x = embed_tokens(params, tokens, compute_dtype)  # [B, S, D]
     layer_stack = cast_layer_stack(params, compute_dtype)
-    tag_names = remat and remat_policy in NAMED_REMAT_POLICIES
-
-    def scan_body(carry, layer_params):
-        y, aux = _block(carry, layer_params, cfg, positions, mesh=mesh, tag_names=tag_names)
-        return y, aux
-
-    body = scan_body
-    if remat:
-        policy = _REMAT_POLICIES.get(remat_policy, jax.checkpoint_policies.nothing_saveable)
-        body = jax.checkpoint(scan_body, policy=policy, prevent_cse=True)
-
+    body = remat_scan_body(cfg, positions, mesh, remat, remat_policy)
     x, aux_per_layer = lax.scan(body, x, layer_stack)
     return x, jnp.mean(aux_per_layer)
 

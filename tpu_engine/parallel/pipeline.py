@@ -91,20 +91,7 @@ def pipeline_apply(
     ticks = M + n_stages - 1
     stage_ids = jnp.arange(n_stages)
 
-    tag_names = remat and remat_policy in tfm.NAMED_REMAT_POLICIES
-
-    def block_body(carry, layer_params):
-        y, aux = tfm._block(
-            carry, layer_params, cfg, positions, mesh=mesh, tag_names=tag_names
-        )
-        return y, aux
-
-    body = block_body
-    if remat:
-        policy = tfm._REMAT_POLICIES.get(
-            remat_policy, jax.checkpoint_policies.nothing_saveable
-        )
-        body = jax.checkpoint(block_body, policy=policy, prevent_cse=True)
+    body = tfm.remat_scan_body(cfg, positions, mesh, remat, remat_policy)
 
     def stage_fn(x, stage_layers):
         # One pipeline stage: scan its block of L/P layers.

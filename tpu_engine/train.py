@@ -199,11 +199,7 @@ def build_train_program(
         raise ValueError(
             f"loss_chunk_size={cfg.loss_chunk_size} must divide seq_len={cfg.seq_len}"
         )
-    if cfg.activation_checkpointing and cfg.remat_policy not in tfm._REMAT_POLICIES:
-        raise ValueError(
-            f"unknown remat_policy {cfg.remat_policy!r}; valid: "
-            f"{sorted(tfm._REMAT_POLICIES)}"
-        )
+    tfm.resolve_remat_policy(cfg.remat_policy)  # fail fast on typos
 
     logical = tfm.logical_axes(model_cfg)
     p_pspecs = param_pspecs(logical, stage)
