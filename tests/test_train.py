@@ -138,3 +138,13 @@ def test_named_remat_policies_match(policy):
 def test_unknown_remat_policy_rejected():
     with pytest.raises(ValueError, match="remat_policy"):
         build_train_program(tiny_config(remat_policy="attn_out"))  # typo
+
+
+def test_moment_dtype_halves_mu_buffer():
+    """moment_dtype=BF16 stores Adam mu in bf16; nu stays at master dtype."""
+    _, state, losses = run_steps(tiny_config(moment_dtype=Precision.BF16))
+    adam = state["opt_state"][1]
+    assert adam.mu["layers"]["q"]["kernel"].dtype == jnp.bfloat16
+    assert adam.nu["layers"]["q"]["kernel"].dtype == jnp.float32
+    # Training still converges with reduced-precision first moment.
+    assert losses[-1] < losses[0] * 0.7

@@ -238,6 +238,10 @@ class TPUTrainConfig(BaseModel):
     precision: Precision = Precision.BF16
     param_dtype: Precision = Precision.FP32  # master params
     grad_allreduce_dtype: Optional[Precision] = None  # reference communication_data_type :60
+    # Adam first-moment dtype (None = master dtype). BF16 halves the mu
+    # buffer (~2 GB/1B params) — the TPU analogue of DeepSpeed's reduced-
+    # precision optimizer states; nu always stays at the master dtype.
+    moment_dtype: Optional[Precision] = None
 
     # Optimizer / schedule (reference :145-164 AdamW + WarmupDecayLR).
     learning_rate: float = Field(default=3e-4, gt=0)

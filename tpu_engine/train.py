@@ -35,6 +35,7 @@ from tpu_engine.sharding import (
     OffloadDevice,
     ShardingStage,
     TPUTrainConfig,
+    dtype_of,
     grad_pspecs,
     host_memory_kind_available,
     named_shardings,
@@ -65,9 +66,10 @@ def make_optimizer(cfg: TPUTrainConfig) -> tuple[optax.GradientTransformation, o
     without recompiling the step function.
     """
     schedule = make_schedule(cfg)
+    mu_dtype = dtype_of(cfg.moment_dtype) if cfg.moment_dtype is not None else None
     tx = optax.chain(
         optax.clip_by_global_norm(cfg.grad_clip_norm),
-        optax.scale_by_adam(b1=cfg.beta1, b2=cfg.beta2, eps=1e-8),
+        optax.scale_by_adam(b1=cfg.beta1, b2=cfg.beta2, eps=1e-8, mu_dtype=mu_dtype),
         optax.add_decayed_weights(cfg.weight_decay),
     )
     return tx, schedule
