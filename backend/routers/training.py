@@ -37,7 +37,7 @@ class TrainingLaunchRequest(BaseModel):
     weight_decay: float = Field(default=0.1, ge=0)
     grad_clip_norm: float = Field(default=1.0, gt=0)
     optimizer_offload: str = "none"
-    attention_impl: Literal["auto", "xla", "flash", "ring"] = "auto"
+    attention_impl: Literal["auto", "xla", "flash", "ring", "ulysses"] = "auto"
     activation_checkpointing: bool = True
     dataset_path: Optional[str] = None  # flat binary token file; None = synthetic
     dataset_dtype: Literal["uint16", "int32"] = "uint16"

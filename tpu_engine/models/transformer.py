@@ -238,6 +238,8 @@ def _attention(q, k, v, impl: str, mesh=None):
 
     - ``"ring"`` — sequence-parallel ring attention over the mesh's
       ``sequence`` axis (``tpu_engine/parallel/ring_attention.py``);
+    - ``"ulysses"`` — sequence-parallel all-to-all attention (head↔sequence
+      shard swap, ``tpu_engine/parallel/ulysses_attention.py``);
     - ``"flash"`` — Pallas TPU flash kernel (``tpu_engine/ops``);
     - ``"xla"``  — plain XLA attention (fallback / reference semantics).
     """
@@ -247,6 +249,12 @@ def _attention(q, k, v, impl: str, mesh=None):
         from tpu_engine.parallel.ring_attention import ring_mha
 
         return ring_mha(q, k, v, mesh=mesh, causal=True)
+    if impl == "ulysses":
+        if mesh is None:
+            raise ValueError("attention_impl='ulysses' requires a mesh")
+        from tpu_engine.parallel.ulysses_attention import ulysses_mha
+
+        return ulysses_mha(q, k, v, mesh=mesh, causal=True)
     from tpu_engine.ops import flash_attention  # lazy: avoids import cycles
 
     return flash_attention.mha(q, k, v, causal=True, force_xla=(impl != "flash"))
@@ -467,9 +475,9 @@ def forward_and_aux(
 
     ``aux_loss`` is the mean MoE load-balancing loss over layers (0 for
     dense models) — add ``cfg.router_aux_coef * aux_loss`` to the training
-    loss. ``mesh`` is only needed for ``attention_impl="ring"`` (sequence
-    parallelism), where the attention runs as a shard_map over the mesh's
-    ``sequence`` axis.
+    loss. ``mesh`` is only needed for ``attention_impl="ring"`` or
+    ``"ulysses"`` (sequence parallelism), where the attention runs as a
+    shard_map over the mesh's ``sequence`` axis.
     """
     x, aux = forward_hidden_and_aux(
         params, tokens, cfg, compute_dtype=compute_dtype, remat=remat,

@@ -74,7 +74,7 @@ def pipeline_apply(
         :func:`stage_layer_stack`), stage dim sharded over ``pipe``.
       x_microbatches: embedded activations [M, B, S, D].
       positions: [B, S] int32 positions (same for every microbatch).
-      mesh: needed only when ``cfg.attention_impl == "ring"``.
+      mesh: needed only when ``cfg.attention_impl`` is ``"ring"`` or ``"ulysses"``.
       buf_sharding: optional NamedSharding for the [P, B, S, D] stage buffer
         (P("pipe", batch_axes, seq_axis)); constrained every tick so the
         roll stays a neighbour collective-permute.

@@ -258,9 +258,10 @@ class TPUTrainConfig(BaseModel):
     param_offload: OffloadDevice = OffloadDevice.NONE
 
     # Attention implementation: "auto" = flash kernel on TPU, XLA elsewhere;
-    # a >1 sequence mesh axis always switches to ring attention.
-    attention_impl: Literal["auto", "xla", "flash", "ring"] = Field(
-        default="auto", description="auto | xla | flash | ring"
+    # a >1 sequence mesh axis switches to ring attention unless "ulysses"
+    # (all-to-all sequence parallelism) is requested explicitly.
+    attention_impl: Literal["auto", "xla", "flash", "ring", "ulysses"] = Field(
+        default="auto", description="auto | xla | flash | ring | ulysses"
     )
 
     # Activation checkpointing (reference :64-67,215-223) → jax.remat.

@@ -172,18 +172,32 @@ def build_train_program(
         runtime = MeshRuntime(cfg.mesh)
     mesh = runtime.mesh
     # Attention implementation resolution:
-    # - a >1 'sequence' axis forces ring attention (GSPMD alone would
-    #   all-gather the sequence dim);
+    # - a >1 'sequence' axis forces sequence-parallel attention (GSPMD alone
+    #   would all-gather the sequence dim): ring by default, or the
+    #   all-to-all Ulysses formulation when requested explicitly;
     # - "auto" → the Pallas flash kernel on TPU, XLA elsewhere;
-    # - explicit "xla" / "flash" / "ring" is honoured.
+    # - explicit "xla" / "flash" / "ring" / "ulysses" is honoured.
     if runtime.axis_sizes["sequence"] > 1:
-        impl = "ring"
+        impl = "ulysses" if cfg.attention_impl == "ulysses" else "ring"
     elif cfg.attention_impl == "auto":
         impl = "flash" if mesh.devices.flat[0].platform == "tpu" else "xla"
     else:
         impl = cfg.attention_impl
     if model_cfg.attention_impl != impl:
         model_cfg = model_cfg.with_(attention_impl=impl)
+    # Mesh is threaded into the forward pass only for sequence-parallel
+    # attention (shard_map over the 'sequence' axis).
+    attn_mesh = mesh if impl in ("ring", "ulysses") else None
+    seq_size = runtime.axis_sizes["sequence"]
+    if impl == "ulysses":
+        local_heads = model_cfg.n_heads // runtime.axis_sizes["model"]
+        if local_heads % seq_size != 0:
+            raise ValueError(
+                f"attention_impl='ulysses' needs the per-device head count "
+                f"({model_cfg.n_heads} heads / model axis "
+                f"{runtime.axis_sizes['model']} = {local_heads}) divisible by "
+                f"the sequence axis size {seq_size}"
+            )
     stage = cfg.sharding_stage
     compute_dtype = cfg.compute_dtype()
     master_dtype = cfg.master_dtype()
@@ -267,7 +281,7 @@ def build_train_program(
             compute_dtype=compute_dtype,
             remat=cfg.activation_checkpointing,
             remat_policy=cfg.remat_policy,
-            mesh=mesh if model_cfg.attention_impl == "ring" else None,
+            mesh=attn_mesh,
         )
         if cfg.loss_chunk_size:
             loss = chunked_lm_loss(params, hidden, tokens, model_cfg, cfg.loss_chunk_size)
@@ -308,7 +322,7 @@ def build_train_program(
                 x_mb,
                 model_cfg,
                 positions=positions,
-                mesh=mesh if model_cfg.attention_impl == "ring" else None,
+                mesh=attn_mesh,
                 remat=cfg.activation_checkpointing,
                 remat_policy=cfg.remat_policy,
                 buf_sharding=buf_sh,
