@@ -44,6 +44,12 @@ from tpu_engine.loss_monitor import (
     SpikeAlert,
     TrainingMetrics,
 )
+from tpu_engine.generate import (
+    KVCache,
+    forward_with_cache,
+    generate,
+    init_cache,
+)
 
 __version__ = "0.1.0"
 
@@ -66,4 +72,8 @@ __all__ = [
     "MonitorConfig",
     "SpikeAlert",
     "TrainingMetrics",
+    "KVCache",
+    "forward_with_cache",
+    "generate",
+    "init_cache",
 ]

@@ -1,0 +1,249 @@
+"""Autoregressive generation: KV-cache decode + sampling on the mesh.
+
+The reference is a training control plane with no inference path at all;
+a complete framework needs one for held-out evaluation, sampling during
+training, and serving smoke tests. TPU-first design:
+
+- **Static shapes end to end.** The cache is a fixed-``max_len`` set of
+  ``[L, B, M, KV, HD]`` buffers written with ``dynamic_update_slice``; the
+  decode loop is a ``lax.scan`` over ``max_new_tokens`` — no data-dependent
+  Python control flow, one compile per (batch, max_len) shape.
+- **Same layer scan as training.** Layers are stacked ``[L, ...]`` pytrees
+  (``models/transformer.py``), so decode scans the cache alongside the
+  layer stack instead of unrolling Python loops per layer.
+- **Sharding by propagation.** Under ``jit`` on a mesh, XLA propagates the
+  param shardings (heads/experts over "model", batch over data axes) into
+  the cache and attention ops; no decode-specific partition specs needed.
+
+MoE decode note: the training forward uses capacity-bounded dispatch
+(tokens over an expert's capacity are dropped — the standard static-shape
+formulation, ``_moe_mlp``). Decode processes a handful of positions, so it
+computes exact capacity-free top-k routing instead (every token reaches
+its chosen experts). Dense models produce bit-identical logits between
+:func:`forward` and prefill+decode; MoE models can differ wherever
+training-time dispatch dropped a token.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_engine.models.transformer import (
+    ModelConfig,
+    _dense_mlp,
+    _rms_norm,
+    _rope,
+    cast_layer_stack,
+    embed_tokens,
+    unembed,
+)
+
+_NEG_INF = -1e30
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class KVCache:
+    """Per-layer key/value cache (a pytree — crosses jit/scan boundaries).
+    k/v: [L, B, max_len, KV, HD]; ``length`` is the number of positions
+    already written (scalar int32)."""
+
+    k: jax.Array
+    v: jax.Array
+    length: jax.Array
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+
+def init_cache(
+    cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.bfloat16
+) -> KVCache:
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        length=jnp.zeros((), jnp.int32),
+    )
+
+
+def _moe_mlp_decode(h, layer_params, cfg: ModelConfig):
+    """Exact top-k MoE for decode: every token reaches its chosen experts
+    (no capacity buffer — see module docstring). h: [B, T, D] → [B, T, D].
+
+    Computes all E expert MLPs for the T new positions and combines with
+    the renormalised top-k gates; for decode-sized T this is a handful of
+    [D, F] matmuls and keeps every shape static.
+    """
+    E, K = cfg.n_experts, cfg.top_k
+    router_logits = jnp.einsum(
+        "btd,de->bte", h, layer_params["router"]["kernel"],
+        preferred_element_type=jnp.float32,
+    )
+    probs = jax.nn.softmax(router_logits, axis=-1)  # [B, T, E] fp32
+
+    gate = jnp.einsum("btd,edf->btef", h, layer_params["gate"]["kernel"])
+    up = jnp.einsum("btd,edf->btef", h, layer_params["up"]["kernel"])
+    expert_out = jnp.einsum(
+        "btef,efd->bted", jax.nn.silu(gate) * up, layer_params["down"]["kernel"]
+    )  # [B, T, E, D]
+
+    # Top-k gates, renormalised to sum to 1 (matches training's combine).
+    top_vals, top_idx = lax.top_k(probs, K)  # [B, T, K]
+    top_vals = top_vals / jnp.maximum(jnp.sum(top_vals, -1, keepdims=True), 1e-9)
+    weights = jnp.zeros_like(probs).at[
+        jnp.arange(probs.shape[0])[:, None, None],
+        jnp.arange(probs.shape[1])[None, :, None],
+        top_idx,
+    ].set(top_vals)  # [B, T, E]
+    return jnp.einsum("bte,bted->btd", weights.astype(h.dtype), expert_out)
+
+
+def _decode_block(x, layer_params, k_cache, v_cache, length, positions, cfg: ModelConfig):
+    """One transformer block attending against the cache.
+
+    x: [B, T, D] new activations; k_cache/v_cache: [B, M, KV, HD]; returns
+    (x, k_cache, v_cache) with the T new positions written at ``length``.
+    """
+    B, T, D = x.shape
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    M = k_cache.shape[1]
+
+    h = _rms_norm(x, layer_params["attn_norm"]["scale"], cfg.norm_eps)
+    q = jnp.einsum("btd,de->bte", h, layer_params["q"]["kernel"]).reshape(B, T, H, HD)
+    k = jnp.einsum("btd,de->bte", h, layer_params["k"]["kernel"]).reshape(B, T, KV, HD)
+    v = jnp.einsum("btd,de->bte", h, layer_params["v"]["kernel"]).reshape(B, T, KV, HD)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+
+    k_cache = lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, length, 0, 0))
+    v_cache = lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, length, 0, 0))
+
+    kc, vc = k_cache, v_cache
+    if KV != H:  # GQA
+        kc = jnp.repeat(kc, H // KV, axis=2)
+        vc = jnp.repeat(vc, H // KV, axis=2)
+
+    scale = 1.0 / (HD ** 0.5)
+    scores = jnp.einsum(
+        "bthd,bmhd->bhtm", q, kc, preferred_element_type=jnp.float32
+    ) * scale
+    # Key m is visible to query t iff m ≤ its global position (causal) —
+    # positions beyond length+T hold zeros and are masked the same way.
+    key_pos = jnp.arange(M)
+    mask = key_pos[None, :] <= positions[:, :, None]  # [B, T, M]
+    scores = jnp.where(mask[:, None, :, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
+    attn = jnp.einsum("bhtm,bmhd->bthd", probs, vc).reshape(B, T, H * HD)
+    x = x + jnp.einsum("bte,ed->btd", attn, layer_params["o"]["kernel"])
+
+    h = _rms_norm(x, layer_params["mlp_norm"]["scale"], cfg.norm_eps)
+    if cfg.is_moe:
+        x = x + _moe_mlp_decode(h, layer_params, cfg)
+        return x, k_cache, v_cache
+    return x + _dense_mlp(h, layer_params), k_cache, v_cache
+
+
+def forward_with_cache(
+    params: dict[str, Any],
+    tokens: jax.Array,
+    cache: KVCache,
+    cfg: ModelConfig,
+    compute_dtype=jnp.bfloat16,
+) -> tuple[jax.Array, KVCache]:
+    """Run ``tokens`` [B, T] through the stack against (and into) ``cache``.
+
+    Serves both phases: prefill (T = prompt length) and decode (T = 1).
+    Returns (logits [B, T, V] fp32, updated cache with length += T).
+
+    The caller must keep ``cache.length + T <= cache.max_len`` (size the
+    cache to prompt + max_new_tokens, as :func:`generate` does): there is no
+    wraparound, and past the end ``dynamic_update_slice`` clamps the write
+    offset, silently overwriting the newest entries.
+    """
+    B, T = tokens.shape
+    positions = cache.length + jnp.broadcast_to(
+        jnp.arange(T, dtype=jnp.int32)[None, :], (B, T)
+    )
+    x = embed_tokens(params, tokens, compute_dtype)
+    layer_stack = cast_layer_stack(params, compute_dtype)
+
+    def body(carry, xs):
+        x = carry
+        layer_params, k_c, v_c = xs
+        x, k_c, v_c = _decode_block(
+            x, layer_params, k_c, v_c, cache.length, positions, cfg
+        )
+        return x, (k_c, v_c)
+
+    x, (k_new, v_new) = lax.scan(body, x, (layer_stack, cache.k, cache.v))
+    logits = unembed(params, x, cfg)
+    return logits, KVCache(k=k_new, v=v_new, length=cache.length + T)
+
+
+def sample_token(
+    logits: jax.Array,
+    rng: jax.Array,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+) -> jax.Array:
+    """logits [B, V] fp32 → token ids [B] int32. ``temperature=0`` = greedy."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, _NEG_INF, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "temperature", "top_k", "compute_dtype"),
+)
+def generate(
+    params: dict[str, Any],
+    prompt: jax.Array,
+    cfg: ModelConfig,
+    max_new_tokens: int,
+    rng: Optional[jax.Array] = None,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    compute_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Generate ``max_new_tokens`` continuations of ``prompt`` [B, P] int32.
+
+    Returns [B, P + max_new_tokens] int32. One prefill pass over the prompt,
+    then a ``lax.scan`` of single-token decode steps — the whole loop is one
+    XLA program. Greedy by default; pass ``rng`` + ``temperature`` (and
+    optionally ``top_k``) for sampling.
+    """
+    B, P = prompt.shape
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    keys = jax.random.split(rng, max_new_tokens)  # one fresh key per draw
+    cache = init_cache(cfg, B, P + max_new_tokens, dtype=compute_dtype)
+    logits, cache = forward_with_cache(params, prompt, cache, cfg, compute_dtype)
+    first = sample_token(logits[:, -1, :], keys[0], temperature, top_k)
+
+    def step(carry, step_rng):
+        token, cache = carry
+        logits, cache = forward_with_cache(
+            params, token[:, None], cache, cfg, compute_dtype
+        )
+        nxt = sample_token(logits[:, -1, :], step_rng, temperature, top_k)
+        return (nxt, cache), nxt
+
+    if max_new_tokens > 1:
+        _, rest = lax.scan(step, (first, cache), keys[1:])
+        generated = jnp.concatenate([first[None], rest], axis=0)  # [N, B]
+    else:
+        generated = first[None]
+    return jnp.concatenate([prompt, generated.T.astype(jnp.int32)], axis=1)

@@ -321,6 +321,16 @@ def _moe_mlp(h, layer_params, cfg: ModelConfig):
     return out, aux
 
 
+def _dense_mlp(h, layer_params):
+    """SwiGLU MLP shared by the training block and the decode block.
+    h: [B, S, D] (already normed) → [B, S, D]."""
+    gate = jnp.einsum("bsd,df->bsf", h, layer_params["gate"]["kernel"])
+    up = jnp.einsum("bsd,df->bsf", h, layer_params["up"]["kernel"])
+    return jnp.einsum(
+        "bsf,fd->bsd", jax.nn.silu(gate) * up, layer_params["down"]["kernel"]
+    )
+
+
 def _block(x, layer_params, cfg: ModelConfig, positions, mesh=None, tag_names=False):
     """One transformer block. x: [B, S, D] → (x, moe_aux_loss).
 
@@ -349,10 +359,7 @@ def _block(x, layer_params, cfg: ModelConfig, positions, mesh=None, tag_names=Fa
         mlp_out, aux = _moe_mlp(h, layer_params, cfg)
         x = x + mlp_out
         return x, aux
-    gate = jnp.einsum("bsd,df->bsf", h, layer_params["gate"]["kernel"])
-    up = jnp.einsum("bsd,df->bsf", h, layer_params["up"]["kernel"])
-    x = x + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, layer_params["down"]["kernel"])
-    return x, jnp.zeros((), jnp.float32)
+    return x + _dense_mlp(h, layer_params), jnp.zeros((), jnp.float32)
 
 
 _REMAT_POLICIES = {
