@@ -41,6 +41,9 @@ class TrainingLaunchRequest(BaseModel):
     activation_checkpointing: bool = True
     dataset_path: Optional[str] = None  # flat binary token file; None = synthetic
     dataset_dtype: Literal["uint16", "int32"] = "uint16"
+    eval_interval_steps: Optional[int] = Field(default=None, ge=1)
+    eval_batches: int = Field(default=4, ge=1)
+    eval_dataset_path: Optional[str] = None
     checkpoint_dir: Optional[str] = None
     checkpoint_interval_steps: int = Field(default=500, ge=1)
     max_steps: Optional[int] = Field(default=None, ge=1, description="stop early after N steps")
@@ -77,6 +80,9 @@ def _to_config(req: TrainingLaunchRequest) -> TPUTrainConfig:
             activation_checkpointing=req.activation_checkpointing,
             dataset_path=req.dataset_path,
             dataset_dtype=req.dataset_dtype,
+            eval_interval_steps=req.eval_interval_steps,
+            eval_batches=req.eval_batches,
+            eval_dataset_path=req.eval_dataset_path,
             checkpoint_dir=req.checkpoint_dir,
             checkpoint_interval_steps=req.checkpoint_interval_steps,
         )

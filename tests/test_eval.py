@@ -1,0 +1,118 @@
+"""Held-out evaluation: eval_step semantics + supervised-job integration."""
+
+import jax
+import numpy as np
+
+from tpu_engine import TPULauncher, TPUTrainConfig
+from tpu_engine.mesh_runtime import MeshConfig
+from tpu_engine.sharding import Precision, ShardingStage
+from tpu_engine.train import build_train_program
+
+
+def _cfg(**kw):
+    base = dict(
+        model_name="gpt-tiny",
+        sharding_stage=ShardingStage.FULL_PARTITIONING,
+        mesh=MeshConfig(data=2, fsdp=4),
+        micro_batch_size=1,
+        gradient_accumulation_steps=2,
+        seq_len=32,
+        precision=Precision.FP32,
+        learning_rate=1e-2,
+        warmup_steps=2,
+        total_steps=100,
+        activation_checkpointing=False,
+    )
+    base.update(kw)
+    return TPUTrainConfig(**base)
+
+
+def test_eval_step_matches_train_loss_dense():
+    # Dense model: eval loss on a batch == the loss train_step reports for it.
+    prog = build_train_program(_cfg())
+    state = prog.init(jax.random.PRNGKey(0))
+    batch = prog.synthetic_batch(0)
+    eval_loss = float(jax.device_get(prog.eval_step(state, batch)))
+    _, metrics = prog.step(state, batch)
+    np.testing.assert_allclose(eval_loss, float(metrics["loss"]), rtol=1e-5)
+
+
+def test_eval_step_excludes_moe_aux():
+    # MoE: train loss carries the router aux term, eval loss must not.
+    prog = build_train_program(_cfg(model_name="moe-tiny"))
+    state = prog.init(jax.random.PRNGKey(0))
+    batch = prog.synthetic_batch(0)
+    eval_loss = float(jax.device_get(prog.eval_step(state, batch)))
+    _, metrics = prog.step(state, batch)
+    assert eval_loss < float(metrics["loss"])
+
+
+def test_eval_step_does_not_mutate_state():
+    prog = build_train_program(_cfg())
+    state = prog.init(jax.random.PRNGKey(0))
+    before = jax.device_get(state["params"]["embed"]["embedding"])
+    prog.eval_step(state, prog.synthetic_batch(0))
+    np.testing.assert_array_equal(
+        before, jax.device_get(state["params"]["embed"]["embedding"])
+    )
+    assert int(jax.device_get(state["step"])) == 0
+
+
+def test_supervised_job_records_eval_history():
+    cfg = _cfg(eval_interval_steps=3, eval_batches=2, total_steps=7)
+    launcher = TPULauncher()
+    res = launcher.launch(cfg, dry_run=False, block=True)
+    job = launcher.get_job(res.job_id)
+    d = job.describe()
+    assert d["status"] == "completed", d
+    assert d["eval"] is not None
+    assert d["eval"]["source"] == "synthetic"
+    steps = [h["step"] for h in d["eval"]["history"]]
+    assert steps == [3, 6]
+    assert d["eval"]["latest_step"] == 6
+    assert 0 < d["eval"]["latest_loss"] < 20
+    assert d["eval"]["latest_perplexity"] > 1
+
+
+def test_eval_data_fn_is_deterministic(tmp_path):
+    # Same call index → identical batch, across repeated eval rounds.
+    import numpy as np
+
+    from tpu_engine.data import TokenFileDataset, make_eval_data_fn, write_token_file
+
+    path = str(tmp_path / "eval.bin")
+    rng = np.random.default_rng(0)
+    write_token_file(rng.integers(0, 512, 20_000).astype(np.uint16), path)
+    prog = build_train_program(_cfg())
+    ds = TokenFileDataset(path, seq_len=32)
+    fn = make_eval_data_fn(prog, ds)
+    a0, b0 = jax.device_get(fn(0)), jax.device_get(fn(1))
+    a1, b1 = jax.device_get(fn(0)), jax.device_get(fn(1))
+    np.testing.assert_array_equal(a0, a1)
+    np.testing.assert_array_equal(b0, b1)
+    assert not np.array_equal(a0, b0)  # distinct blocks of the file
+    ds.close()
+
+
+def test_supervised_job_evals_from_file(tmp_path):
+    import numpy as np
+
+    from tpu_engine.data import write_token_file
+
+    train_path = str(tmp_path / "train.bin")
+    eval_path = str(tmp_path / "eval.bin")
+    write_token_file((np.arange(30_000) % 512).astype(np.uint16), train_path)
+    write_token_file(((np.arange(20_000) * 7) % 512).astype(np.uint16), eval_path)
+    cfg = _cfg(
+        dataset_path=train_path,
+        eval_dataset_path=eval_path,
+        eval_interval_steps=2,
+        eval_batches=2,
+        total_steps=4,
+    )
+    launcher = TPULauncher()
+    res = launcher.launch(cfg, dry_run=False, block=True)
+    d = launcher.get_job(res.job_id).describe()
+    assert d["status"] == "completed", d
+    assert d["eval"]["source"] == "file"
+    assert [h["step"] for h in d["eval"]["history"]] == [2, 4]

@@ -195,38 +195,63 @@ class SyntheticDataset:
         pass
 
 
-def make_data_fn(program: Any, dataset: Any, seed: int = 0) -> Callable[[int], jax.Array]:
-    """Adapt a dataset into the supervisor's ``data_fn(step)`` contract.
+def _place_global(batch: np.ndarray, sharding: Any) -> jax.Array:
+    """Place a host [accum, global_micro, seq] batch onto the mesh.
 
-    Pulls ``accum × global_micro`` sequences per step and places them with
-    the program's batch sharding. Multi-process: every process pulls the
-    same global stream (deterministic) and contributes its addressable
-    shards via ``jax.make_array_from_process_local_data``.
+    Multi-process: every process holds the identical global batch and
+    contributes its contiguous row block (mesh devices are ordered by
+    process, so batch-axis shards are process-contiguous; the sequence
+    axis, if sharded, stays process-local on one host's slice under the
+    canonical (data, fsdp, sequence, model) order).
     """
-    accum, global_micro, seq_len = program.global_batch_shape()
+    if jax.process_count() > 1:
+        rows = batch.shape[1] // jax.process_count()
+        r0 = jax.process_index() * rows
+        local = batch[:, r0:r0 + rows]
+        return jax.make_array_from_process_local_data(
+            sharding, local, global_shape=batch.shape
+        )
+    return jax.device_put(batch, sharding)
+
+
+def _check_seq_len(dataset: Any, seq_len: int) -> None:
     if dataset.seq_len != seq_len:
         raise ValueError(
             f"dataset seq_len {dataset.seq_len} != program seq_len {seq_len}"
         )
+
+
+def make_data_fn(program: Any, dataset: Any, seed: int = 0) -> Callable[[int], jax.Array]:
+    """Adapt a dataset into the supervisor's ``data_fn(step)`` contract.
+
+    Pulls ``accum × global_micro`` sequences per step from the (shuffled,
+    prefetching) stream and places them with the program's batch sharding.
+    """
+    accum, global_micro, seq_len = program.global_batch_shape()
+    _check_seq_len(dataset, seq_len)
     dataset.start(accum * global_micro, seed=seed)
     sharding = program.batch_sharding
-    multiprocess = jax.process_count() > 1
 
     def data_fn(step: int) -> jax.Array:
         flat = dataset.next_batch()  # [accum*global_micro, seq_len] int32
-        batch = flat.reshape(accum, global_micro, seq_len)
-        if multiprocess:
-            # Every process pulls the identical deterministic stream and
-            # keeps its contiguous row block (mesh devices are ordered by
-            # process, so batch-axis shards are process-contiguous). The
-            # sequence axis, if sharded, stays process-local on one host's
-            # slice under the canonical (data, fsdp, sequence, model) order.
-            rows = global_micro // jax.process_count()
-            r0 = jax.process_index() * rows
-            local = batch[:, r0:r0 + rows]
-            return jax.make_array_from_process_local_data(
-                sharding, local, global_shape=batch.shape
-            )
-        return jax.device_put(batch, sharding)
+        return _place_global(flat.reshape(accum, global_micro, seq_len), sharding)
 
     return data_fn
+
+
+def make_eval_data_fn(program: Any, dataset: "TokenFileDataset") -> Callable[[int], jax.Array]:
+    """Fixed held-out batches: call index ``i`` always reads the same
+    sequences (the i-th contiguous block of the file, wrapping), so eval
+    losses are comparable across training steps — unlike the consuming
+    shuffled stream :func:`make_data_fn` adapts."""
+    accum, global_micro, seq_len = program.global_batch_shape()
+    _check_seq_len(dataset, seq_len)
+    bs = accum * global_micro
+    sharding = program.batch_sharding
+
+    def eval_fn(i: int) -> jax.Array:
+        idx = (np.arange(bs, dtype=np.int64) + i * bs) % dataset.num_sequences
+        flat = dataset.read_batch(idx)
+        return _place_global(flat.reshape(accum, global_micro, seq_len), sharding)
+
+    return eval_fn

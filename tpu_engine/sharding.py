@@ -292,6 +292,12 @@ class TPUTrainConfig(BaseModel):
         description="flat binary token file (tpu_engine.data); None = synthetic",
     )
     dataset_dtype: Literal["uint16", "int32"] = "uint16"
+    # Held-out evaluation: every eval_interval_steps, average the eval loss
+    # over eval_batches batches from eval_dataset_path (or held-out
+    # synthetic data). None = no evaluation.
+    eval_interval_steps: Optional[int] = Field(default=None, ge=1)
+    eval_batches: int = Field(default=4, ge=1)
+    eval_dataset_path: Optional[str] = None
     seed: int = 0
     log_every_steps: int = Field(default=100, ge=1)  # reference steps_per_print :128
 

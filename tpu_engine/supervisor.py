@@ -23,6 +23,7 @@ the supervisor actually owns:
 from __future__ import annotations
 
 import logging
+import math
 import threading
 import time
 import traceback
@@ -44,6 +45,11 @@ from tpu_engine.sharding import TPUTrainConfig
 from tpu_engine.train import TrainProgram, build_train_program
 
 log = logging.getLogger(__name__)
+
+
+def _perplexity(loss: float) -> float:
+    """exp(loss), clamped so a divergence spike can't overflow to inf."""
+    return math.exp(min(loss, 30.0))
 
 
 class JobStatus(str, Enum):
@@ -98,6 +104,13 @@ class TrainingJob:
         self.current_step: int = 0
         self.profiler: Optional[StepProfiler] = None
         self._dataset: Any = None
+        self._eval_dataset: Any = None
+        self._eval_data_fn: Optional[Callable[[int], jax.Array]] = None
+        self._eval_source: Optional[str] = None  # "file" | "synthetic"
+        # (step, eval_loss) pairs, newest last; bounded (reference's unbounded
+        # metric lists were a leak — SURVEY.md §3.3).
+        self.eval_history: list[tuple[int, float]] = []
+        self._max_eval_history = 1000
 
         self._state: Any = None
         self._state_lock = threading.Lock()
@@ -202,6 +215,35 @@ class TrainingJob:
                     self._dataset.num_sequences, self._dataset.native,
                 )
 
+            # Held-out eval source: dedicated file > held-out synthetic seeds.
+            if self.config.eval_interval_steps:
+                if self.config.eval_dataset_path:
+                    from tpu_engine.data import TokenFileDataset, make_eval_data_fn
+
+                    self._eval_dataset = TokenFileDataset(
+                        self.config.eval_dataset_path,
+                        seq_len=self.config.seq_len,
+                        dtype=self.config.dataset_dtype,
+                    )
+                    # Fixed held-out batches: call index i always reads the
+                    # same sequences, so the eval curve is comparable.
+                    self._eval_data_fn = make_eval_data_fn(prog, self._eval_dataset)
+                    self._eval_source = "file"
+                else:
+                    # Synthetic fallback: a seed space disjoint from training
+                    # steps (which seed by step index < total_steps).
+                    self._eval_data_fn = lambda i: prog.synthetic_batch(
+                        seed=1_000_000_007 + i
+                    )
+                    self._eval_source = "synthetic"
+                    if self.data_fn is not None:
+                        log.warning(
+                            "job %s: eval_interval_steps set with real training "
+                            "data but no eval_dataset_path — eval uses synthetic "
+                            "random tokens (loss ≈ ln(vocab), not a held-out "
+                            "metric)", self.job_id,
+                        )
+
             self.status = JobStatus.RUNNING
             tokens_per_batch = 1
             for d in prog.global_batch_shape():
@@ -257,6 +299,13 @@ class TrainingJob:
                     elif any(a.alert_type == "divergence" for a in critical):
                         raise RuntimeError(f"training diverged at step {step}")
 
+                # Held-out evaluation.
+                if (
+                    self.config.eval_interval_steps
+                    and step % self.config.eval_interval_steps == 0
+                ):
+                    self._run_eval(step)
+
                 # Periodic checkpoint + stable-pointer advancement.
                 if self.ckpt is not None:
                     if step % self.config.checkpoint_interval_steps == 0:
@@ -280,11 +329,12 @@ class TrainingJob:
             self.status = JobStatus.FAILED
         finally:
             self.finished_at = time.time()
-            if self._dataset is not None:
-                try:
-                    self._dataset.close()
-                except Exception:
-                    pass
+            for ds in (self._dataset, self._eval_dataset):
+                if ds is not None:
+                    try:
+                        ds.close()
+                    except Exception:
+                        pass
             if self.watcher is not None:
                 self.watcher.stop()
             if self.ckpt is not None:
@@ -292,6 +342,24 @@ class TrainingJob:
                     self.ckpt.wait_until_finished()
                 except Exception:
                     pass
+
+    def _run_eval(self, step: int) -> None:
+        """Average ``eval_batches`` held-out losses; record in history."""
+        prog = self.program
+        with self._state_lock:
+            # Dispatch all eval steps before the single host sync, so device
+            # execution of batch k overlaps dispatch of batch k+1.
+            device_losses = [
+                prog.eval_step(self._state, self._eval_data_fn(i))
+                for i in range(self.config.eval_batches)
+            ]
+        loss = float(sum(jax.device_get(device_losses))) / self.config.eval_batches
+        self.eval_history.append((step, loss))
+        del self.eval_history[: -self._max_eval_history]
+        log.info(
+            "job %s: eval @ step %d — loss %.4f ppl %.2f",
+            self.job_id, step, loss, _perplexity(loss),
+        )
 
     def _advance_stable(self, current_step: int) -> None:
         """Mark saved steps stable once a healthy margin has passed them."""
@@ -318,6 +386,8 @@ class TrainingJob:
         # into the diverged timeline (latest-step restore would prefer them).
         self.ckpt.delete_after(int(step))
         self._pending_stable = [s for s in self._pending_stable if s <= int(step)]
+        # Evals from the abandoned timeline would collide with re-reached steps.
+        self.eval_history = [(s, l) for s, l in self.eval_history if s <= int(step)]
         # New timeline: the old anomaly step must not veto fresh post-rollback
         # checkpoints from ever being marked stable.
         self._last_critical_step = -1
@@ -356,4 +426,17 @@ class TrainingJob:
             "tokens_per_sec": self.tokens_per_sec,
             "monitor": self.monitor.get_summary(),
             "profile": self.profiler.summary() if self.profiler is not None else None,
+            "eval": self._eval_summary(),
+        }
+
+    def _eval_summary(self) -> Optional[dict[str, Any]]:
+        if not self.eval_history:
+            return None
+        step, loss = self.eval_history[-1]
+        return {
+            "source": self._eval_source,
+            "latest_step": step,
+            "latest_loss": loss,
+            "latest_perplexity": _perplexity(loss),
+            "history": [{"step": s, "loss": l} for s, l in self.eval_history],
         }

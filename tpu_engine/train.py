@@ -139,6 +139,8 @@ class TrainProgram:
     batch_sharding: NamedSharding
     init: Callable[[jax.Array], Any]
     step: Callable[[Any, jax.Array], tuple[Any, dict[str, jax.Array]]]
+    # Held-out loss (no optimizer update, no MoE aux term): (state, batch) → scalar.
+    eval_step: Optional[Callable[[Any, jax.Array], jax.Array]] = None
 
     @property
     def mesh(self) -> Mesh:
@@ -273,7 +275,7 @@ def build_train_program(
     seq_ax = "sequence" if runtime.axis_sizes["sequence"] > 1 else None
     batch_sharding = NamedSharding(mesh, P(None, BATCH_AXES, seq_ax))
 
-    def loss_fn(params, tokens):
+    def loss_fn(params, tokens, include_aux: bool = True):
         hidden, aux = tfm.forward_hidden_and_aux(
             params,
             tokens,
@@ -287,7 +289,7 @@ def build_train_program(
             loss = chunked_lm_loss(params, hidden, tokens, model_cfg, cfg.loss_chunk_size)
         else:
             loss = lm_loss(tfm.unembed(params, hidden, model_cfg), tokens)
-        if model_cfg.is_moe:
+        if model_cfg.is_moe and include_aux:
             loss = loss + model_cfg.router_aux_coef * aux
         return loss
 
@@ -308,7 +310,7 @@ def build_train_program(
         )
         buf_sh = NamedSharding(mesh, P("pipe", BATCH_AXES, seq_ax))
 
-        def pipe_loss_fn(params, batch):
+        def pipe_loss_fn(params, batch, include_aux: bool = True):
             accum = batch.shape[0]
             B, S = batch.shape[1], batch.shape[2]
             positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
@@ -339,7 +341,7 @@ def build_train_program(
             body = jax.checkpoint(loss_body) if cfg.activation_checkpointing else loss_body
             loss_sum, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (outputs, batch))
             loss = loss_sum / accum
-            if model_cfg.is_moe:
+            if model_cfg.is_moe and include_aux:
                 loss = loss + model_cfg.router_aux_coef * aux_mean
             return loss
 
@@ -400,6 +402,23 @@ def build_train_program(
         donate_argnums=(0,),
     )
 
+    def eval_step(state, batch):
+        """Held-out loss over one [accum, B, S] batch — pure cross-entropy
+        (no MoE aux term, so exp(loss) is an honest perplexity), no update."""
+        params = state["params"]
+        if pipe_size > 1:
+            return pipe_loss_fn(params, batch, include_aux=False)
+
+        def body(acc, tokens):
+            return acc + loss_fn(params, tokens, include_aux=False), None
+
+        loss_sum, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), batch)
+        return loss_sum / batch.shape[0]
+
+    jit_eval = jax.jit(
+        eval_step, in_shardings=(state_shardings, batch_sharding), out_shardings=None
+    )
+
     return TrainProgram(
         config=cfg,
         model_config=model_cfg,
@@ -408,6 +427,7 @@ def build_train_program(
         batch_sharding=batch_sharding,
         init=jit_init,
         step=jit_step,
+        eval_step=jit_eval,
     )
 
 
