@@ -9,6 +9,7 @@ reference cannot offer because its launch is fire-and-forget.
 
 from __future__ import annotations
 
+import asyncio
 from typing import Any, Literal, Optional
 
 from aiohttp import web
@@ -172,6 +173,49 @@ async def stop_job(request: web.Request) -> web.Response:
     return json_response({"job_id": job_id, "stopped": True})
 
 
+class GenerateRequest(BaseModel):
+    """Sample continuations from a job's current weights (no reference
+    analogue — the reference has no inference path at all)."""
+
+    prompt_tokens: list[list[int]] = Field(min_length=1)
+    max_new_tokens: int = Field(default=32, ge=1, le=4096)
+    temperature: float = Field(default=0.0, ge=0.0)
+    top_k: Optional[int] = Field(default=None, ge=1)
+    top_p: Optional[float] = Field(default=None, gt=0.0, le=1.0)
+    seed: int = 0
+
+
+async def generate_from_job(request: web.Request) -> web.Response:
+    """Qualitative sampling while (or after) a job trains — runs on a
+    consistent snapshot of the job's weights."""
+    job_id = request.match_info["job_id"]
+    job = state.launcher.get_job(job_id)
+    if job is None:
+        raise ApiError(404, f"job '{job_id}' not found")
+    req = await parse_body(request, GenerateRequest)
+    try:
+        tokens = await asyncio.to_thread(
+            job.generate_sample,
+            req.prompt_tokens,
+            max_new_tokens=req.max_new_tokens,
+            temperature=req.temperature,
+            top_k=req.top_k,
+            top_p=req.top_p,
+            seed=req.seed,
+        )
+    except (RuntimeError, ValueError) as e:
+        raise ApiError(422, str(e))
+    prompt_len = len(req.prompt_tokens[0])
+    return json_response(
+        {
+            "job_id": job_id,
+            "step": job.current_step,
+            "tokens": tokens,
+            "new_tokens": [row[prompt_len:] for row in tokens],
+        }
+    )
+
+
 def setup(app: web.Application, prefix: str = "/api/v1/training") -> None:
     app.router.add_post(f"{prefix}/launch", launch_training)
     app.router.add_post(f"{prefix}/launch/preset", launch_from_preset)
@@ -180,3 +224,4 @@ def setup(app: web.Application, prefix: str = "/api/v1/training") -> None:
     app.router.add_get(f"{prefix}/jobs", list_jobs)
     app.router.add_get(f"{prefix}/jobs/{{job_id}}", get_job)
     app.router.add_post(f"{prefix}/jobs/{{job_id}}/stop", stop_job)
+    app.router.add_post(f"{prefix}/jobs/{{job_id}}/generate", generate_from_job)

@@ -84,19 +84,32 @@ def main() -> None:
     iters = 10 if on_tpu else 3
 
     last_err: str | None = None
+    result = None
     for cfg in _candidates(n_dev, on_tpu):
-        try:
-            dt, tokens_per_step, model_cfg = _run(cfg, iters)
+        # Tunneled runtimes' remote compile service can fail transiently on
+        # large programs; retry each candidate before falling through to a
+        # smaller (lower-MFU) one.
+        for attempt in range(3):
+            try:
+                result = _run(cfg, iters)
+                break
+            except Exception as e:  # OOM / compile failure
+                # Keep only the message: a live traceback would pin this
+                # candidate's device buffers and OOM every later candidate.
+                last_err = f"{type(e).__name__}: {e}"
+                transient = "remote_compile" in last_err or "INTERNAL" in last_err
+                del e
+                gc.collect()
+                jax.clear_caches()
+                if not transient:
+                    break
+                if attempt < 2:  # no backoff after the final attempt
+                    time.sleep(10 * (attempt + 1))
+        if result is not None:
             break
-        except Exception as e:  # OOM / compile failure → next-smaller config
-            # Keep only the message: a live traceback would pin this
-            # candidate's device buffers and OOM every later candidate.
-            last_err = f"{type(e).__name__}: {e}"
-            del e
-            gc.collect()
-            jax.clear_caches()
-    else:
+    if result is None:
         raise SystemExit(f"all bench configs failed; last error: {last_err}")
+    dt, tokens_per_step, model_cfg = result
 
     tokens_per_sec = tokens_per_step / dt
     tokens_per_sec_chip = tokens_per_sec / n_dev

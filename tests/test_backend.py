@@ -295,3 +295,55 @@ def test_profile_job_routes(client):
     assert prof["steps_seen"] == 3
     assert set(prof["phases"]) == {"data", "dispatch", "device", "other"}
     assert d["profile"]["steps_seen"] == 3  # also embedded in job describe()
+
+
+def test_generate_from_job(client):
+    r = client.post(
+        "/api/v1/training/launch",
+        json={
+            "model_name": "gpt-tiny",
+            "mesh": {"data": 2, "fsdp": 4},
+            "micro_batch_size": 1,
+            "seq_len": 32,
+            "precision": "fp32",
+            "total_steps": 2,
+            "activation_checkpointing": False,
+            "warmup_steps": 1,
+            "dry_run": False,
+        },
+    )
+    job_id = r.json()["job_id"]
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        if client.get(f"/api/v1/training/jobs/{job_id}").json()["status"] in (
+            "completed", "failed",
+        ):
+            break
+        time.sleep(1)
+
+    r = client.post(
+        f"/api/v1/training/jobs/{job_id}/generate",
+        json={"prompt_tokens": [[1, 2, 3, 4]], "max_new_tokens": 5},
+    )
+    assert r.status_code == 200, r.text
+    body = r.json()
+    assert body["tokens"][0][:4] == [1, 2, 3, 4]
+    assert len(body["new_tokens"][0]) == 5
+    # Sampling params flow through; same seed → same tokens.
+    j = {"prompt_tokens": [[5, 6, 7]], "max_new_tokens": 4,
+         "temperature": 0.9, "top_k": 20, "top_p": 0.9, "seed": 11}
+    a = client.post(f"/api/v1/training/jobs/{job_id}/generate", json=j).json()
+    b = client.post(f"/api/v1/training/jobs/{job_id}/generate", json=j).json()
+    assert a["tokens"] == b["tokens"]
+
+    # Ragged prompts are a 422, not a crash.
+    r = client.post(
+        f"/api/v1/training/jobs/{job_id}/generate",
+        json={"prompt_tokens": [[1, 2], [3]]},
+    )
+    assert r.status_code == 422
+    # Unknown job is a 404.
+    r = client.post(
+        "/api/v1/training/jobs/nope/generate", json={"prompt_tokens": [[1]]}
+    )
+    assert r.status_code == 404

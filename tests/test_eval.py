@@ -116,3 +116,25 @@ def test_supervised_job_evals_from_file(tmp_path):
     assert d["status"] == "completed", d
     assert d["eval"]["source"] == "file"
     assert [h["step"] for h in d["eval"]["history"]] == [2, 4]
+
+
+def test_generate_sample_from_running_job():
+    # Sampling mid-training must survive the train step's buffer donation
+    # (the dispatch happens under the state lock).
+    cfg = _cfg(total_steps=200)
+    launcher = TPULauncher()
+    res = launcher.launch(cfg, dry_run=False, block=False)
+    job = launcher.get_job(res.job_id)
+    import time
+
+    deadline = time.time() + 120
+    while job.status.value not in ("running", "completed") and time.time() < deadline:
+        time.sleep(0.2)
+    sampled = 0
+    while job.status.value == "running" and sampled < 3:
+        out = job.generate_sample([[1, 2, 3]], max_new_tokens=4, temperature=0.8, seed=sampled)
+        assert len(out[0]) == 7
+        sampled += 1
+    job.join(timeout=120)
+    assert job.status.value == "completed", job.describe()
+    assert sampled >= 1  # at least one sample landed while training ran

@@ -111,3 +111,34 @@ def test_moe_decode_runs_and_is_finite():
     cache = init_cache(cfg, 2, 16, dtype=jnp.float32)
     logits, _ = forward_with_cache(params, tokens, cache, cfg, compute_dtype=jnp.float32)
     assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_top_p_filters_tail():
+    # One dominant token (~97% mass): top_p=0.5 must always pick it.
+    logits = jnp.array([[8.0, 4.0, 3.0, 2.0]])
+    for seed in range(20):
+        t = sample_token(
+            logits, jax.random.PRNGKey(seed), temperature=1.0, top_p=0.5
+        )
+        assert int(t[0]) == 0
+    # top_p=1.0 keeps the full distribution: other tokens appear.
+    seen = {
+        int(sample_token(logits, jax.random.PRNGKey(s), temperature=2.0, top_p=1.0)[0])
+        for s in range(200)
+    }
+    assert len(seen) > 1
+
+
+def test_sampling_param_sweep_does_not_recompile():
+    from tpu_engine.generate import _generate_jit
+
+    cfg, params, tokens = _setup(S=8)
+    base = _generate_jit._cache_size()
+    generate(params, tokens, cfg, max_new_tokens=3, temperature=0.7,
+             top_p=0.9, compute_dtype=jnp.float32)
+    after_first = _generate_jit._cache_size()
+    generate(params, tokens, cfg, max_new_tokens=3, temperature=1.3,
+             top_p=0.5, compute_dtype=jnp.float32)
+    generate(params, tokens, cfg, max_new_tokens=3, temperature=0.2,
+             top_p=0.95, compute_dtype=jnp.float32)
+    assert _generate_jit._cache_size() == after_first > base

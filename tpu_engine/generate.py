@@ -188,26 +188,51 @@ def forward_with_cache(
     return logits, KVCache(k=k_new, v=v_new, length=cache.length + T)
 
 
+def _filtered_sample(
+    logits: jax.Array,
+    rng: jax.Array,
+    temperature,
+    top_k: Optional[int],
+    top_p,
+) -> jax.Array:
+    """Temperature → top-k → nucleus (top-p) → categorical draw.
+
+    ``temperature`` and ``top_p`` may be Python floats *or traced scalars*
+    (the decode loop passes them as operands so sweeping them never triggers
+    a recompile); ``top_k`` must be static (``lax.top_k`` needs a static k).
+    ``top_p=None`` skips the nucleus sort entirely. All static shapes — the
+    top-p cutoff is a mask over the sorted cumulative distribution, not a
+    dynamic truncation.
+    """
+    logits = logits / temperature
+    if top_k is not None:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, _NEG_INF, logits)
+    if top_p is not None:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum_excl = jnp.cumsum(probs, axis=-1) - probs  # mass strictly before
+        keep_sorted = cum_excl < top_p  # always keeps the top token
+        kept_min = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < kept_min, _NEG_INF, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
 def sample_token(
     logits: jax.Array,
     rng: jax.Array,
     temperature: float = 0.0,
     top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
 ) -> jax.Array:
     """logits [B, V] fp32 → token ids [B] int32. ``temperature=0`` = greedy."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / temperature
-    if top_k is not None:
-        kth = lax.top_k(logits, top_k)[0][..., -1:]
-        logits = jnp.where(logits < kth, _NEG_INF, logits)
-    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+    return _filtered_sample(logits, rng, temperature, top_k, top_p)
 
 
-@partial(
-    jax.jit,
-    static_argnames=("cfg", "max_new_tokens", "temperature", "top_k", "compute_dtype"),
-)
 def generate(
     params: dict[str, Any],
     prompt: jax.Array,
@@ -216,6 +241,7 @@ def generate(
     rng: Optional[jax.Array] = None,
     temperature: float = 0.0,
     top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
     compute_dtype=jnp.bfloat16,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt`` [B, P] int32.
@@ -223,22 +249,71 @@ def generate(
     Returns [B, P + max_new_tokens] int32. One prefill pass over the prompt,
     then a ``lax.scan`` of single-token decode steps — the whole loop is one
     XLA program. Greedy by default; pass ``rng`` + ``temperature`` (and
-    optionally ``top_k``) for sampling.
+    optionally ``top_k`` / ``top_p``) for sampling.
+
+    Recompiles only on shape / ``cfg`` / ``top_k`` / greedy-vs-sampled
+    changes: ``temperature`` and ``top_p`` enter the compiled program as
+    traced scalars, so sweeping them (e.g. through the HTTP sampling
+    endpoint) reuses the cached executable.
     """
-    B, P = prompt.shape
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    greedy = temperature == 0.0
+    return _generate_jit(
+        params,
+        prompt,
+        jnp.asarray(1.0 if greedy else temperature, jnp.float32),
+        jnp.asarray(1.0 if top_p is None else top_p, jnp.float32),
+        rng,
+        cfg=cfg,
+        max_new_tokens=max_new_tokens,
+        top_k=top_k,
+        use_top_p=top_p is not None,
+        greedy=greedy,
+        compute_dtype=compute_dtype,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "max_new_tokens", "top_k", "use_top_p", "greedy", "compute_dtype",
+    ),
+)
+def _generate_jit(
+    params: dict[str, Any],
+    prompt: jax.Array,
+    temperature: jax.Array,
+    top_p: jax.Array,
+    rng: jax.Array,
+    *,
+    cfg: ModelConfig,
+    max_new_tokens: int,
+    top_k: Optional[int],
+    use_top_p: bool,
+    greedy: bool,
+    compute_dtype,
+) -> jax.Array:
+    B, P = prompt.shape
+
+    def sample(logits, key):
+        if greedy:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return _filtered_sample(
+            logits, key, temperature, top_k, top_p if use_top_p else None
+        )
+
     keys = jax.random.split(rng, max_new_tokens)  # one fresh key per draw
     cache = init_cache(cfg, B, P + max_new_tokens, dtype=compute_dtype)
     logits, cache = forward_with_cache(params, prompt, cache, cfg, compute_dtype)
-    first = sample_token(logits[:, -1, :], keys[0], temperature, top_k)
+    first = sample(logits[:, -1, :], keys[0])
 
     def step(carry, step_rng):
         token, cache = carry
         logits, cache = forward_with_cache(
             params, token[:, None], cache, cfg, compute_dtype
         )
-        nxt = sample_token(logits[:, -1, :], step_rng, temperature, top_k)
+        nxt = sample(logits[:, -1, :], step_rng)
         return (nxt, cache), nxt
 
     if max_new_tokens > 1:

@@ -406,6 +406,51 @@ class TrainingJob:
         )
         return int(step)
 
+    # -- sampling ------------------------------------------------------------
+
+    def generate_sample(
+        self,
+        prompt_tokens: list[list[int]],
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        seed: int = 0,
+    ) -> list[list[int]]:
+        """Sample continuations from the job's *current* weights.
+
+        Safe while training runs — but only because the lock is held across
+        the generate *dispatch*: the train step is jitted with donated state
+        (``donate_argnums=(0,)``), so a params reference grabbed under the
+        lock would be deleted the moment the training thread dispatches its
+        next step. Once generate is enqueued the runtime holds its own
+        buffer references and the lock can drop; ``device_get`` then waits
+        outside it. Returns prompt + continuation token ids per row.
+        """
+        import jax.numpy as jnp
+
+        from tpu_engine.generate import generate
+
+        if self.program is None or self._state is None:
+            raise RuntimeError("job has no initialized state to sample from")
+        lens = {len(p) for p in prompt_tokens}
+        if len(lens) != 1 or 0 in lens:
+            raise ValueError("prompt rows must be non-empty and equal-length")
+        prompt = jnp.asarray(prompt_tokens, jnp.int32)
+        with self._state_lock:
+            out = generate(
+                self._state["params"],
+                prompt,
+                self.program.model_config,
+                max_new_tokens=max_new_tokens,
+                rng=jax.random.PRNGKey(seed),
+                temperature=temperature,
+                top_k=top_k,
+                top_p=top_p,
+                compute_dtype=self.program.config.compute_dtype(),
+            )
+        return [[int(t) for t in row] for row in jax.device_get(out)]
+
     # -- views ---------------------------------------------------------------
 
     def describe(self) -> dict[str, Any]:
