@@ -18,10 +18,18 @@ from tpu_engine.models.transformer import (
     param_count,
     train_flops_per_token,
 )
+from tpu_engine.models.convert import (
+    config_from_hf,
+    from_hf_llama,
+    to_hf_llama,
+)
 
 __all__ = [
     "ModelConfig",
     "MODEL_CONFIGS",
+    "config_from_hf",
+    "from_hf_llama",
+    "to_hf_llama",
     "active_param_count",
     "init_params",
     "forward",

@@ -1,0 +1,167 @@
+"""HuggingFace Llama checkpoint conversion.
+
+The reference launches external training scripts and has no notion of model
+weights at all; a complete framework must interoperate with the ecosystem's
+checkpoint format. This module converts between HF ``LlamaForCausalLM``
+state dicts and this framework's stacked-pytree parameters:
+
+- HF stores one ``[out, in]`` torch Linear weight per layer per projection;
+  we store one ``[L, in, out]`` stacked array per projection (the layer
+  stack is scanned with ``lax.scan``, so the leading axis is layers).
+- RoPE conventions agree (non-interleaved half rotation — HF
+  ``rotate_half``), head layouts agree (head-major ``H×HD`` projections),
+  norms agree (RMSNorm with learned scale), so conversion is pure
+  stack/transpose — verified logit-for-logit against ``transformers`` in
+  ``tests/test_convert.py``.
+
+Works on plain mappings of name → array-like (torch tensors, numpy arrays);
+torch is only touched through ``numpy`` coercion, keeping the core
+dependency-free.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_engine.models.transformer import ModelConfig
+
+
+def _np(t: Any) -> np.ndarray:
+    """Coerce a torch tensor / numpy array to float32 numpy."""
+    if hasattr(t, "detach"):  # torch tensor without importing torch
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def config_from_hf(hf_config: Any) -> ModelConfig:
+    """Map a ``transformers.LlamaConfig`` (or any object with the same
+    attribute names) onto :class:`ModelConfig`.
+
+    Fails fast on configs this architecture cannot represent rather than
+    converting to silently-wrong weights: RoPE scaling (Llama-3.1+
+    ``rope_scaling``) and a ``head_dim`` decoupled from
+    ``hidden_size // num_attention_heads`` are rejected.
+    """
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling:
+        raise ValueError(
+            f"rope_scaling={scaling!r} is not supported: converted weights "
+            "would compute different RoPE frequencies than transformers"
+        )
+    derived_hd = hf_config.hidden_size // hf_config.num_attention_heads
+    explicit_hd = getattr(hf_config, "head_dim", None)
+    if explicit_hd not in (None, derived_hd):
+        raise ValueError(
+            f"head_dim={explicit_hd} != hidden_size//num_attention_heads "
+            f"({derived_hd}): decoupled head dims are not representable"
+        )
+    return ModelConfig(
+        name=getattr(hf_config, "name_or_path", "") or "hf-llama",
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(hf_config, "num_key_value_heads", None)
+        or hf_config.num_attention_heads,
+        d_ff=hf_config.intermediate_size,
+        max_seq_len=getattr(hf_config, "max_position_embeddings", 2048),
+        rope_theta=getattr(hf_config, "rope_theta", 10_000.0),
+        norm_eps=getattr(hf_config, "rms_norm_eps", 1e-5),
+    )
+
+
+def from_hf_llama(
+    state_dict: Mapping[str, Any], cfg: ModelConfig, dtype=jnp.float32
+) -> dict[str, Any]:
+    """HF ``LlamaForCausalLM.state_dict()`` → this framework's param pytree.
+
+    Raises ``KeyError`` with the missing name if the state dict does not
+    look like a Llama checkpoint, and ``ValueError`` if it contains weight
+    tensors this architecture would silently drop (e.g. attention/MLP
+    biases from ``attention_bias=True`` exports).
+
+    Each leaf is cast to ``dtype`` as it is read, so peak host memory is
+    one fp32 layer at a time over the target-dtype tree — not a second
+    full-precision copy of the checkpoint.
+    """
+    sd = state_dict
+    consumed: set[str] = set()
+
+    def leaf(name: str, transpose: bool = False):
+        consumed.add(name)
+        w = _np(sd[name])
+        return jnp.asarray(w.T if transpose else w, dtype)
+
+    def stacked(fmt: str, transpose: bool = False):
+        return jnp.stack([
+            leaf(fmt.format(i=i), transpose) for i in range(cfg.n_layers)
+        ])
+
+    p = "model.layers.{i}."
+    lm_head_name = (
+        "lm_head.weight" if "lm_head.weight" in sd else "model.embed_tokens.weight"
+    )
+    params = {
+        "embed": {"embedding": leaf("model.embed_tokens.weight")},
+        "layers": {
+            "attn_norm": {"scale": stacked(p + "input_layernorm.weight")},
+            "q": {"kernel": stacked(p + "self_attn.q_proj.weight", True)},
+            "k": {"kernel": stacked(p + "self_attn.k_proj.weight", True)},
+            "v": {"kernel": stacked(p + "self_attn.v_proj.weight", True)},
+            "o": {"kernel": stacked(p + "self_attn.o_proj.weight", True)},
+            "mlp_norm": {"scale": stacked(p + "post_attention_layernorm.weight")},
+            "gate": {"kernel": stacked(p + "mlp.gate_proj.weight", True)},
+            "up": {"kernel": stacked(p + "mlp.up_proj.weight", True)},
+            "down": {"kernel": stacked(p + "mlp.down_proj.weight", True)},
+        },
+        "final_norm": {"scale": leaf("model.norm.weight")},
+        "lm_head": {"kernel": leaf(lm_head_name, transpose=True)},
+    }
+    # Anything unconsumed (other than derived rotary buffers) would change
+    # the model's function — refuse rather than silently drop it.
+    leftover = [
+        k for k in sd
+        if k not in consumed and "rotary" not in k and "inv_freq" not in k
+    ]
+    if leftover:
+        raise ValueError(
+            f"state dict has {len(leftover)} tensors this converter would "
+            f"drop (unsupported architecture variant?): {sorted(leftover)[:8]}"
+        )
+    return params
+
+
+def to_hf_llama(params: dict[str, Any], cfg: ModelConfig) -> dict[str, np.ndarray]:
+    """This framework's param pytree → HF Llama state-dict layout (numpy).
+
+    Feed the result to ``LlamaForCausalLM.load_state_dict`` after wrapping
+    the arrays in torch tensors.
+    """
+    import jax
+
+    host = jax.device_get(params)
+    sd: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(host["embed"]["embedding"], np.float32),
+        "model.norm.weight": np.asarray(host["final_norm"]["scale"], np.float32),
+        "lm_head.weight": np.asarray(host["lm_head"]["kernel"], np.float32).T,
+    }
+    L = cfg.n_layers
+    layer_map = [
+        ("input_layernorm.weight", host["layers"]["attn_norm"]["scale"], False),
+        ("self_attn.q_proj.weight", host["layers"]["q"]["kernel"], True),
+        ("self_attn.k_proj.weight", host["layers"]["k"]["kernel"], True),
+        ("self_attn.v_proj.weight", host["layers"]["v"]["kernel"], True),
+        ("self_attn.o_proj.weight", host["layers"]["o"]["kernel"], True),
+        ("post_attention_layernorm.weight", host["layers"]["mlp_norm"]["scale"], False),
+        ("mlp.gate_proj.weight", host["layers"]["gate"]["kernel"], True),
+        ("mlp.up_proj.weight", host["layers"]["up"]["kernel"], True),
+        ("mlp.down_proj.weight", host["layers"]["down"]["kernel"], True),
+    ]
+    for i in range(L):
+        for suffix, stacked, transpose in layer_map:
+            w = np.asarray(stacked[i], np.float32)
+            sd[f"model.layers.{i}.{suffix}"] = w.T if transpose else w
+    return sd
