@@ -45,6 +45,10 @@ class TrainingLaunchRequest(BaseModel):
     eval_interval_steps: Optional[int] = Field(default=None, ge=1)
     eval_batches: int = Field(default=4, ge=1)
     eval_dataset_path: Optional[str] = None
+    lora_rank: Optional[int] = Field(default=None, ge=1)
+    lora_alpha: float = Field(default=16.0, gt=0)
+    lora_targets: list[str] = ["q", "k", "v", "o"]
+    lora_base_hf_checkpoint: Optional[str] = None
     checkpoint_dir: Optional[str] = None
     checkpoint_interval_steps: int = Field(default=500, ge=1)
     max_steps: Optional[int] = Field(default=None, ge=1, description="stop early after N steps")
@@ -62,6 +66,22 @@ class PresetLaunchRequest(BaseModel):
 
 
 def _to_config(req: TrainingLaunchRequest) -> TPUTrainConfig:
+    # LoRA fields fail at request time, not asynchronously in the job thread.
+    if req.lora_rank is None and (
+        {"lora_alpha", "lora_targets", "lora_base_hf_checkpoint"} & req.model_fields_set
+    ):
+        raise ApiError(
+            422, "lora_alpha/lora_targets/lora_base_hf_checkpoint require lora_rank"
+        )
+    if req.lora_rank is not None:
+        from tpu_engine.lora import validate_targets
+        from tpu_engine.models.transformer import MODEL_CONFIGS
+
+        if req.model_name in MODEL_CONFIGS:
+            try:
+                validate_targets(MODEL_CONFIGS[req.model_name], tuple(req.lora_targets))
+            except ValueError as e:
+                raise ApiError(422, str(e))
     try:
         return TPUTrainConfig(
             model_name=req.model_name,
@@ -84,6 +104,10 @@ def _to_config(req: TrainingLaunchRequest) -> TPUTrainConfig:
             eval_interval_steps=req.eval_interval_steps,
             eval_batches=req.eval_batches,
             eval_dataset_path=req.eval_dataset_path,
+            lora_rank=req.lora_rank,
+            lora_alpha=req.lora_alpha,
+            lora_targets=tuple(req.lora_targets),
+            lora_base_hf_checkpoint=req.lora_base_hf_checkpoint,
             checkpoint_dir=req.checkpoint_dir,
             checkpoint_interval_steps=req.checkpoint_interval_steps,
         )

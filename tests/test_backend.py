@@ -347,3 +347,30 @@ def test_generate_from_job(client):
         "/api/v1/training/jobs/nope/generate", json={"prompt_tokens": [[1]]}
     )
     assert r.status_code == 404
+
+
+def test_lora_request_validation(client):
+    # lora knobs without lora_rank → 422 at request time.
+    r = client.post(
+        "/api/v1/training/launch",
+        json={"model_name": "gpt-tiny", "lora_targets": ["q"]},
+    )
+    assert r.status_code == 422
+    # Bad target name → 422 at request time, not an async job failure.
+    r = client.post(
+        "/api/v1/training/launch",
+        json={"model_name": "gpt-tiny", "lora_rank": 4, "lora_targets": ["query"]},
+    )
+    assert r.status_code == 422
+    # MoE expert MLPs cannot take adapters.
+    r = client.post(
+        "/api/v1/training/launch",
+        json={"model_name": "moe-tiny", "lora_rank": 4, "lora_targets": ["gate"]},
+    )
+    assert r.status_code == 422
+    # Valid LoRA dry-run sails through.
+    r = client.post(
+        "/api/v1/training/launch",
+        json={"model_name": "gpt-tiny", "lora_rank": 4},
+    )
+    assert r.status_code == 200

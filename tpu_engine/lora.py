@@ -1,0 +1,121 @@
+"""LoRA: low-rank adaptation for parameter-efficient fine-tuning.
+
+Absent from the reference (which delegates models entirely to external
+scripts); first-class here because it is the standard fine-tuning mode a
+complete training framework must offer. TPU-first formulation:
+
+- Adapters ride the same stacked ``[L, ...]`` layout as the base kernels,
+  so the training scan, sharding machinery, and checkpointing all apply
+  unchanged: ``A`` is ``[L, in, r]``, ``B`` is ``[L, r, out]``, and the
+  merge ``W + (alpha/r)·A@B`` is one einsum per target — negligible next
+  to the forward matmuls, and XLA fuses it into the surrounding program.
+- The *trainable* state is the adapter tree only: gradients, optimizer
+  moments, and checkpoints are all rank-sized (a 7B base with r=16
+  adapters checkpoints ~40 MB instead of ~28 GB). The frozen base params
+  enter the compiled step as captured constants, sharded like any stage-3
+  parameter tree.
+- Sharding: ``A`` inherits the target kernel's (layers, in) axes, ``B``
+  its (layers, out) axes — the rank dimension is never sharded.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from tpu_engine.models.transformer import ModelConfig
+
+# Kernels that can take adapters; MoE expert MLPs are 4-D ([L, E, in, out])
+# and are deliberately not adaptable — restrict MoE models to attention.
+DENSE_TARGETS = ("q", "k", "v", "o", "gate", "up", "down")
+ATTN_TARGETS = ("q", "k", "v", "o")
+
+
+def target_shapes(cfg: ModelConfig) -> dict[str, tuple[int, int, int]]:
+    """[L, in, out] shape of each adaptable kernel."""
+    L, D, F = cfg.n_layers, cfg.d_model, cfg.d_ff
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    shapes = {
+        "q": (L, D, H * HD),
+        "k": (L, D, KV * HD),
+        "v": (L, D, KV * HD),
+        "o": (L, H * HD, D),
+    }
+    if not cfg.is_moe:
+        shapes.update({"gate": (L, D, F), "up": (L, D, F), "down": (L, F, D)})
+    return shapes
+
+
+def validate_targets(cfg: ModelConfig, targets: Sequence[str]) -> tuple[str, ...]:
+    allowed = target_shapes(cfg)
+    bad = [t for t in targets if t not in allowed]
+    if bad:
+        raise ValueError(
+            f"invalid lora_targets {bad} for model {cfg.name!r}; "
+            f"valid: {sorted(allowed)}"
+            + (" (MoE expert MLPs cannot take adapters)" if cfg.is_moe else "")
+        )
+    if not targets:
+        raise ValueError("lora_targets must not be empty")
+    return tuple(targets)
+
+
+def init_lora_params(
+    rng: jax.Array,
+    cfg: ModelConfig,
+    rank: int,
+    targets: Sequence[str],
+    dtype=jnp.float32,
+) -> dict[str, Any]:
+    """A ~ N(0, 1/r) (per the LoRA paper), B = 0 — the adapted model starts
+    exactly equal to the base model."""
+    shapes = target_shapes(cfg)
+    keys = jax.random.split(rng, len(targets))
+    layers: dict[str, Any] = {}
+    for key, t in zip(keys, targets):
+        L, i, o = shapes[t]
+        layers[t] = {
+            "A": (jax.random.normal(key, (L, i, rank), dtype) / (rank ** 0.5)),
+            "B": jnp.zeros((L, rank, o), dtype),
+        }
+    return {"layers": layers}
+
+
+def lora_logical_axes(
+    model_logical: dict[str, Any], targets: Sequence[str]
+) -> dict[str, Any]:
+    """Adapter logical-axis tree: A takes the target's (layers, in) axes,
+    B its (layers, out) axes; the rank axis is never sharded."""
+    layers: dict[str, Any] = {}
+    for t in targets:
+        lyr, in_ax, out_ax = model_logical["layers"][t]["kernel"]
+        layers[t] = {"A": (lyr, in_ax, None), "B": (lyr, None, out_ax)}
+    return {"layers": layers}
+
+
+def merge_lora(
+    base_params: dict[str, Any],
+    lora_params: dict[str, Any],
+    alpha: float,
+    rank: int,
+) -> dict[str, Any]:
+    """Base params with ``W_t + (alpha/r)·A_t@B_t`` for each adapted target.
+
+    Non-destructive: returns a new tree sharing every unadapted leaf.
+    """
+    scale = alpha / rank
+    layers = dict(base_params["layers"])
+    for t, ab in lora_params["layers"].items():
+        w = layers[t]["kernel"]
+        delta = jnp.einsum(
+            "lir,lro->lio", ab["A"].astype(w.dtype), ab["B"].astype(w.dtype)
+        )
+        layers[t] = {"kernel": w + scale * delta}
+    return {**base_params, "layers": layers}
+
+
+def lora_param_count(cfg: ModelConfig, rank: int, targets: Sequence[str]) -> int:
+    shapes = target_shapes(cfg)
+    return sum(shapes[t][0] * rank * (shapes[t][1] + shapes[t][2]) for t in targets)

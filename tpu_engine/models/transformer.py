@@ -321,45 +321,64 @@ def _moe_mlp(h, layer_params, cfg: ModelConfig):
     return out, aux
 
 
-def _dense_mlp(h, layer_params):
+def _proj(h, kernel, lora_ab=None, lora_scale=1.0):
+    """Last-dim projection ``h @ W``, with an optional rank-sized LoRA term
+    ``scale·(h@A)@B`` — the activation-side formulation: only [.., r]
+    intermediates and rank-sized cotangents, never a full ΔW.
+    h: [B, S, in], kernel: [in, out] → [B, S, out]."""
+    out = jnp.einsum("bsi,io->bso", h, kernel)
+    if lora_ab is not None:
+        hA = jnp.einsum("bsi,ir->bsr", h, lora_ab["A"].astype(h.dtype))
+        out = out + lora_scale * jnp.einsum("bsr,ro->bso", hA, lora_ab["B"].astype(h.dtype))
+    return out
+
+
+def _dense_mlp(h, layer_params, lora=None, lora_scale=1.0):
     """SwiGLU MLP shared by the training block and the decode block.
     h: [B, S, D] (already normed) → [B, S, D]."""
-    gate = jnp.einsum("bsd,df->bsf", h, layer_params["gate"]["kernel"])
-    up = jnp.einsum("bsd,df->bsf", h, layer_params["up"]["kernel"])
-    return jnp.einsum(
-        "bsf,fd->bsd", jax.nn.silu(gate) * up, layer_params["down"]["kernel"]
-    )
+    lora = lora or {}
+    gate = _proj(h, layer_params["gate"]["kernel"], lora.get("gate"), lora_scale)
+    up = _proj(h, layer_params["up"]["kernel"], lora.get("up"), lora_scale)
+    return _proj(jax.nn.silu(gate) * up, layer_params["down"]["kernel"],
+                 lora.get("down"), lora_scale)
 
 
-def _block(x, layer_params, cfg: ModelConfig, positions, mesh=None, tag_names=False):
+def _block(
+    x, layer_params, cfg: ModelConfig, positions, mesh=None, tag_names=False,
+    lora=None, lora_scale=1.0,
+):
     """One transformer block. x: [B, S, D] → (x, moe_aux_loss).
 
     ``tag_names=True`` tags q/k/v/attn_out with ``checkpoint_name`` for the
     named remat policies (save_attn_out / save_qkv_attn_out). Tagging is
     opt-in because the names act as optimisation barriers: under a non-named
     policy they cost ~1.5 GB of pointlessly-saved rope buffers at 1B scale.
+
+    ``lora``: optional per-layer adapter dict (target → {A, B}) applied
+    inside each projection (``tpu_engine/lora.py``).
     """
     B, S, D = x.shape
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     tag = checkpoint_name if tag_names else (lambda a, _name: a)
+    lora = lora or {}
 
     h = _rms_norm(x, layer_params["attn_norm"]["scale"], cfg.norm_eps)
-    q = jnp.einsum("bsd,de->bse", h, layer_params["q"]["kernel"]).reshape(B, S, H, HD)
-    k = jnp.einsum("bsd,de->bse", h, layer_params["k"]["kernel"]).reshape(B, S, KV, HD)
-    v = jnp.einsum("bsd,de->bse", h, layer_params["v"]["kernel"]).reshape(B, S, KV, HD)
+    q = _proj(h, layer_params["q"]["kernel"], lora.get("q"), lora_scale).reshape(B, S, H, HD)
+    k = _proj(h, layer_params["k"]["kernel"], lora.get("k"), lora_scale).reshape(B, S, KV, HD)
+    v = _proj(h, layer_params["v"]["kernel"], lora.get("v"), lora_scale).reshape(B, S, KV, HD)
     q = tag(_rope(q, positions, cfg.rope_theta), "q")
     k = tag(_rope(k, positions, cfg.rope_theta), "k")
     v = tag(v, "v")
     attn = _attention(q, k, v, cfg.attention_impl, mesh=mesh)
     attn = tag(attn.reshape(B, S, H * HD), "attn_out")
-    x = x + jnp.einsum("bse,ed->bsd", attn, layer_params["o"]["kernel"])
+    x = x + _proj(attn, layer_params["o"]["kernel"], lora.get("o"), lora_scale)
 
     h = _rms_norm(x, layer_params["mlp_norm"]["scale"], cfg.norm_eps)
     if cfg.is_moe:
         mlp_out, aux = _moe_mlp(h, layer_params, cfg)
         x = x + mlp_out
         return x, aux
-    return x + _dense_mlp(h, layer_params), jnp.zeros((), jnp.float32)
+    return x + _dense_mlp(h, layer_params, lora, lora_scale), jnp.zeros((), jnp.float32)
 
 
 _REMAT_POLICIES = {
@@ -400,14 +419,20 @@ def remat_scan_body(
     mesh,
     remat: bool,
     remat_policy: str,
+    lora_scale: float = 1.0,
 ):
     """The (optionally remat-wrapped) per-layer scan body shared by the
-    plain forward and the pipelined forward."""
+    plain forward and the pipelined forward.
+
+    The scan ``xs`` may be either the layer-params dict alone or a
+    ``(layer_params, lora_layer)`` pair when adapters train alongside."""
     policy, tag_names = (None, False) if not remat else resolve_remat_policy(remat_policy)
 
-    def scan_body(carry, layer_params):
+    def scan_body(carry, xs):
+        layer_params, lora_layer = xs if isinstance(xs, tuple) else (xs, None)
         return _block(
-            carry, layer_params, cfg, positions, mesh=mesh, tag_names=tag_names
+            carry, layer_params, cfg, positions, mesh=mesh, tag_names=tag_names,
+            lora=lora_layer, lora_scale=lora_scale,
         )
 
     if remat:
@@ -447,10 +472,15 @@ def forward_hidden_and_aux(
     remat_policy: str = "nothing_saveable",
     positions: Optional[jax.Array] = None,
     mesh=None,
+    lora: Optional[dict[str, Any]] = None,
+    lora_scale: float = 1.0,
 ) -> tuple[jax.Array, jax.Array]:
     """Decoder stack only: tokens [B, S] int32 → (hidden [B, S, D] in the
     compute dtype — final norm / LM head NOT applied, see :func:`unembed` —
     and the mean MoE aux loss).
+
+    ``lora``: optional stacked adapter tree (``tpu_engine/lora.py``) scanned
+    alongside the layer stack; applied inside each target projection.
 
     The whole layer stack is cast to the compute dtype up front (casting
     per-layer inside the scan body reads cheaper but is a pessimisation:
@@ -462,8 +492,9 @@ def forward_hidden_and_aux(
 
     x = embed_tokens(params, tokens, compute_dtype)  # [B, S, D]
     layer_stack = cast_layer_stack(params, compute_dtype)
-    body = remat_scan_body(cfg, positions, mesh, remat, remat_policy)
-    x, aux_per_layer = lax.scan(body, x, layer_stack)
+    body = remat_scan_body(cfg, positions, mesh, remat, remat_policy, lora_scale)
+    xs = (layer_stack, lora["layers"]) if lora is not None else layer_stack
+    x, aux_per_layer = lax.scan(body, x, xs)
     return x, jnp.mean(aux_per_layer)
 
 

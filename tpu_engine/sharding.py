@@ -264,6 +264,17 @@ class TPUTrainConfig(BaseModel):
         default="auto", description="auto | xla | flash | ring | ulysses"
     )
 
+    # LoRA fine-tuning: when lora_rank is set, only rank-sized adapters on
+    # lora_targets train (tpu_engine/lora.py); the base model is frozen —
+    # gradients, optimizer state, and checkpoints are adapter-sized.
+    lora_rank: Optional[int] = Field(default=None, ge=1)
+    lora_alpha: float = Field(default=16.0, gt=0)
+    lora_targets: tuple[str, ...] = ("q", "k", "v", "o")
+    # Frozen base weights to adapt: a local HF checkpoint directory
+    # (LlamaForCausalLM format). None = deterministic random init from seed
+    # (tests/benchmarks only — the supervisor warns).
+    lora_base_hf_checkpoint: Optional[str] = None
+
     # Activation checkpointing (reference :64-67,215-223) → jax.remat.
     activation_checkpointing: bool = True
     remat_policy: str = Field(

@@ -111,6 +111,9 @@ class TrainingJob:
         # metric lists were a leak — SURVEY.md §3.3).
         self.eval_history: list[tuple[int, float]] = []
         self._max_eval_history = 1000
+        # LoRA sampling: (step, merged params) — repeated /generate calls at
+        # the same step reuse the merge instead of re-materialising it.
+        self._merged_cache: Optional[tuple[int, Any]] = None
 
         self._state: Any = None
         self._state_lock = threading.Lock()
@@ -171,6 +174,32 @@ class TrainingJob:
 
     # -- training loop -------------------------------------------------------
 
+    def _build_program(self):
+        """Build the train program; for LoRA, load the frozen base weights
+        from the configured HF checkpoint directory."""
+        cfg = self.config
+        if cfg.lora_rank and cfg.lora_base_hf_checkpoint:
+            from transformers import AutoModelForCausalLM
+
+            from tpu_engine.models.convert import config_from_hf, from_hf_llama
+
+            hf_model = AutoModelForCausalLM.from_pretrained(cfg.lora_base_hf_checkpoint)
+            model_cfg = config_from_hf(hf_model.config)
+            base = from_hf_llama(hf_model.state_dict(), model_cfg)
+            del hf_model
+            log.info(
+                "job %s: LoRA base loaded from %s (%s)",
+                self.job_id, cfg.lora_base_hf_checkpoint, model_cfg.name,
+            )
+            return build_train_program(cfg, model_cfg=model_cfg, base_params=base)
+        if cfg.lora_rank:
+            log.warning(
+                "job %s: lora_rank set without lora_base_hf_checkpoint — "
+                "adapting a randomly initialised base model (only meaningful "
+                "for tests and benchmarks)", self.job_id,
+            )
+        return build_train_program(cfg)
+
     def _abstract_state(self):
         prog = self.program
         state_shape = jax.eval_shape(lambda: prog.init(jax.random.PRNGKey(self.config.seed)))
@@ -181,7 +210,7 @@ class TrainingJob:
         try:
             self.status = JobStatus.COMPILING
             if self.program is None:
-                self.program = build_train_program(self.config)
+                self.program = self._build_program()
             prog = self.program
 
             # Resume if checkpoints exist (auto-resume; MTTR path).
@@ -438,8 +467,15 @@ class TrainingJob:
             raise ValueError("prompt rows must be non-empty and equal-length")
         prompt = jnp.asarray(prompt_tokens, jnp.int32)
         with self._state_lock:
+            params = self._state["params"]
+            if self.program.merged_params is not None:  # LoRA: adapters → full
+                if self._merged_cache is not None and self._merged_cache[0] == self.current_step:
+                    params = self._merged_cache[1]
+                else:
+                    params = self.program.merged_params(params)
+                    self._merged_cache = (self.current_step, params)
             out = generate(
-                self._state["params"],
+                params,
                 prompt,
                 self.program.model_config,
                 max_new_tokens=max_new_tokens,

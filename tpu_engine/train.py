@@ -141,6 +141,10 @@ class TrainProgram:
     step: Callable[[Any, jax.Array], tuple[Any, dict[str, jax.Array]]]
     # Held-out loss (no optimizer update, no MoE aux term): (state, batch) → scalar.
     eval_step: Optional[Callable[[Any, jax.Array], jax.Array]] = None
+    # LoRA only: the frozen base weights and a jitted adapter→full-params
+    # merge (for generation/export). None for full-parameter training.
+    base_params: Any = None
+    merged_params: Optional[Callable[[Any], Any]] = None
 
     @property
     def mesh(self) -> Mesh:
@@ -166,8 +170,15 @@ def build_train_program(
     cfg: TPUTrainConfig,
     model_cfg: Optional[tfm.ModelConfig] = None,
     runtime: Optional[MeshRuntime] = None,
+    base_params: Optional[Any] = None,
 ) -> TrainProgram:
-    """Assemble the sharded train program for ``cfg`` on ``runtime``'s mesh."""
+    """Assemble the sharded train program for ``cfg`` on ``runtime``'s mesh.
+
+    ``base_params`` only applies to LoRA runs (``cfg.lora_rank`` set): the
+    frozen base model weights to adapt — e.g. an imported HF checkpoint
+    (``tpu_engine.models.convert.from_hf_llama``). Default: deterministic
+    init from ``cfg.seed``.
+    """
     if model_cfg is None:
         model_cfg = tfm.MODEL_CONFIGS[cfg.model_name]
     if runtime is None:
@@ -219,12 +230,38 @@ def build_train_program(
         )
     tfm.resolve_remat_policy(cfg.remat_policy)  # fail fast on typos
 
+    use_lora = cfg.lora_rank is not None
+    if use_lora:
+        from tpu_engine import lora as lora_mod
+
+        lora_targets = lora_mod.validate_targets(model_cfg, cfg.lora_targets)
+        if pipe_size > 1:
+            raise ValueError("LoRA is not supported with pipeline parallelism")
+
     logical = tfm.logical_axes(model_cfg)
-    p_pspecs = param_pspecs(logical, stage)
-    g_pspecs = grad_pspecs(logical, stage)
-    o_pspecs = opt_state_pspecs(logical, stage)
+
+    # The *trainable* parameter space: the full model, or (LoRA) only the
+    # rank-sized adapter tree — grads/optimizer state/checkpoints follow it.
+    train_logical = lora_mod.lora_logical_axes(logical, lora_targets) if use_lora else logical
+    p_pspecs = param_pspecs(train_logical, stage)
+    g_pspecs = grad_pspecs(train_logical, stage)
+    o_pspecs = opt_state_pspecs(train_logical, stage)
 
     param_sh = named_shardings(mesh, p_pspecs)
+    # Full-model sharding: for LoRA this differs from the trainable tree's
+    # (frozen base + merged exports); otherwise it IS the trainable one.
+    full_param_sh = (
+        named_shardings(mesh, param_pspecs(logical, stage)) if use_lora else param_sh
+    )
+
+    if use_lora:
+        if base_params is None:
+            base_params = jax.jit(
+                lambda rng: tfm.init_params(rng, model_cfg, dtype=master_dtype),
+                out_shardings=full_param_sh,
+            )(jax.random.PRNGKey(cfg.seed))
+        else:
+            base_params = jax.device_put(base_params, full_param_sh)
 
     # Optimizer-state offload: pinned host memory when the backend supports it
     # (reference CPU offload, ``deepspeed_launcher.py:197-203``).
@@ -238,7 +275,12 @@ def build_train_program(
     tx, schedule = make_optimizer(cfg)
 
     def init_fn(rng: jax.Array) -> dict[str, Any]:
-        params = tfm.init_params(rng, model_cfg, dtype=master_dtype)
+        if use_lora:
+            params = lora_mod.init_lora_params(
+                rng, model_cfg, cfg.lora_rank, lora_targets, dtype=master_dtype
+            )
+        else:
+            params = tfm.init_params(rng, model_cfg, dtype=master_dtype)
         opt_state = tx.init(params)
         return {
             "params": params,
@@ -275,7 +317,7 @@ def build_train_program(
     seq_ax = "sequence" if runtime.axis_sizes["sequence"] > 1 else None
     batch_sharding = NamedSharding(mesh, P(None, BATCH_AXES, seq_ax))
 
-    def loss_fn(params, tokens, include_aux: bool = True):
+    def loss_fn(params, tokens, include_aux: bool = True, lora_params=None):
         hidden, aux = tfm.forward_hidden_and_aux(
             params,
             tokens,
@@ -284,6 +326,8 @@ def build_train_program(
             remat=cfg.activation_checkpointing,
             remat_policy=cfg.remat_policy,
             mesh=attn_mesh,
+            lora=lora_params,
+            lora_scale=(cfg.lora_alpha / cfg.lora_rank) if use_lora else 1.0,
         )
         if cfg.loss_chunk_size:
             loss = chunked_lm_loss(params, hidden, tokens, model_cfg, cfg.loss_chunk_size)
@@ -293,7 +337,17 @@ def build_train_program(
             loss = loss + model_cfg.router_aux_coef * aux
         return loss
 
-    grad_fn = jax.value_and_grad(loss_fn)
+    if use_lora:
+        # Trainable space = adapters, applied activation-side inside each
+        # projection (h@A@B — never a full ΔW, so cotangents stay
+        # rank-sized). The frozen base enters the compiled step as captured
+        # constants.
+        def train_loss_fn(adapter_params, tokens, include_aux: bool = True):
+            return loss_fn(base_params, tokens, include_aux, lora_params=adapter_params)
+    else:
+        train_loss_fn = loss_fn
+
+    grad_fn = jax.value_and_grad(train_loss_fn)
 
     # ---- pipelined loss (pipe axis > 1): one forward over all microbatches,
     # streamed through the stages; autodiff gives the reverse pipeline. ----
@@ -410,7 +464,7 @@ def build_train_program(
             return pipe_loss_fn(params, batch, include_aux=False)
 
         def body(acc, tokens):
-            return acc + loss_fn(params, tokens, include_aux=False), None
+            return acc + train_loss_fn(params, tokens, include_aux=False), None
 
         loss_sum, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), batch)
         return loss_sum / batch.shape[0]
@@ -418,6 +472,20 @@ def build_train_program(
     jit_eval = jax.jit(
         eval_step, in_shardings=(state_shardings, batch_sharding), out_shardings=None
     )
+
+    merged_fn = None
+    if use_lora:
+        # Merged tree in the compute dtype: generation casts to it anyway,
+        # and at bf16 the one-off merged copy is half the master-dtype size.
+        merged_fn = jax.jit(
+            lambda adapters: jax.tree.map(
+                lambda a: a.astype(compute_dtype),
+                lora_mod.merge_lora(
+                    base_params, adapters, cfg.lora_alpha, cfg.lora_rank
+                ),
+            ),
+            out_shardings=full_param_sh,
+        )
 
     return TrainProgram(
         config=cfg,
@@ -428,6 +496,8 @@ def build_train_program(
         init=jit_init,
         step=jit_step,
         eval_step=jit_eval,
+        base_params=base_params if use_lora else None,
+        merged_params=merged_fn,
     )
 
 
