@@ -49,6 +49,7 @@ class TrainingLaunchRequest(BaseModel):
     lora_alpha: float = Field(default=16.0, gt=0)
     lora_targets: list[str] = ["q", "k", "v", "o"]
     lora_base_hf_checkpoint: Optional[str] = None
+    metrics_log_path: Optional[str] = None
     checkpoint_dir: Optional[str] = None
     checkpoint_interval_steps: int = Field(default=500, ge=1)
     max_steps: Optional[int] = Field(default=None, ge=1, description="stop early after N steps")
@@ -108,6 +109,7 @@ def _to_config(req: TrainingLaunchRequest) -> TPUTrainConfig:
             lora_alpha=req.lora_alpha,
             lora_targets=tuple(req.lora_targets),
             lora_base_hf_checkpoint=req.lora_base_hf_checkpoint,
+            metrics_log_path=req.metrics_log_path,
             checkpoint_dir=req.checkpoint_dir,
             checkpoint_interval_steps=req.checkpoint_interval_steps,
         )

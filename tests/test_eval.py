@@ -138,3 +138,29 @@ def test_generate_sample_from_running_job():
     job.join(timeout=120)
     assert job.status.value == "completed", job.describe()
     assert sampled >= 1  # at least one sample landed while training ran
+
+
+def test_metrics_jsonl_log(tmp_path):
+    import json
+
+    path = str(tmp_path / "metrics.jsonl")
+    cfg = _cfg(total_steps=4, log_every_steps=2, eval_interval_steps=2,
+               eval_batches=1, metrics_log_path=path)
+    launcher = TPULauncher()
+    res = launcher.launch(cfg, dry_run=False, block=True)
+    assert launcher.get_job(res.job_id).describe()["status"] == "completed"
+    lines = [json.loads(l) for l in open(path)]
+    train = [l for l in lines if l["kind"] == "train"]
+    evals = [l for l in lines if l["kind"] == "eval"]
+    assert [l["step"] for l in train] == [2, 4]
+    assert [l["step"] for l in evals] == [2, 4]
+    assert all("loss" in l and "ts" in l and l["job_id"] == res.job_id for l in lines)
+    assert all("perplexity" in l for l in evals)
+    assert all("tokens_per_sec" in l and "grad_norm" in l for l in train)
+
+
+def test_metrics_log_bad_path_does_not_fail_job(tmp_path):
+    cfg = _cfg(total_steps=2, metrics_log_path=str(tmp_path / "no" / "such" / "dir" / "m.jsonl"))
+    launcher = TPULauncher()
+    res = launcher.launch(cfg, dry_run=False, block=True)
+    assert launcher.get_job(res.job_id).describe()["status"] == "completed"

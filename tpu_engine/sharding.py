@@ -311,6 +311,10 @@ class TPUTrainConfig(BaseModel):
     eval_dataset_path: Optional[str] = None
     seed: int = 0
     log_every_steps: int = Field(default=100, ge=1)  # reference steps_per_print :128
+    # Structured metrics log: one JSON line per logged train step / eval run
+    # (the reference's only logging is bare print()s in a stub —
+    # ``spot_resiliency.py:22,35``; SURVEY.md §5 "no structured logging").
+    metrics_log_path: Optional[str] = None
 
     @property
     def effective_batch_size(self) -> int:

@@ -114,6 +114,7 @@ class TrainingJob:
         # LoRA sampling: (step, merged params) — repeated /generate calls at
         # the same step reuse the merge instead of re-materialising it.
         self._merged_cache: Optional[tuple[int, Any]] = None
+        self._metrics_file = None  # JSONL sink (config.metrics_log_path)
 
         self._state: Any = None
         self._state_lock = threading.Lock()
@@ -273,6 +274,17 @@ class TrainingJob:
                             "metric)", self.job_id,
                         )
 
+            if self.config.metrics_log_path:
+                try:
+                    self._metrics_file = open(self.config.metrics_log_path, "a")
+                except OSError:  # metrics are best-effort; never fail the job
+                    log.exception(
+                        "job %s: cannot open metrics log %s — continuing without",
+                        self.job_id, self.config.metrics_log_path,
+                    )
+                if self.resumed_from_step is not None:
+                    self._log_metrics(kind="resume", step=start_step)
+
             self.status = JobStatus.RUNNING
             tokens_per_batch = 1
             for d in prog.global_batch_shape():
@@ -312,6 +324,14 @@ class TrainingJob:
                         throughput_tokens_per_sec=self.tokens_per_sec,
                     )
                 )
+
+                if step % self.config.log_every_steps == 0:
+                    self._log_metrics(
+                        kind="train", step=step, loss=host["loss"],
+                        learning_rate=host["learning_rate"],
+                        grad_norm=host["grad_norm"],
+                        tokens_per_sec=self.tokens_per_sec,
+                    )
 
                 critical = [a for a in alerts if a.severity == AlertSeverity.CRITICAL]
                 if critical:
@@ -364,6 +384,11 @@ class TrainingJob:
                         ds.close()
                     except Exception:
                         pass
+            if self._metrics_file is not None:
+                try:
+                    self._metrics_file.close()
+                except Exception:
+                    pass
             if self.watcher is not None:
                 self.watcher.stop()
             if self.ckpt is not None:
@@ -385,10 +410,29 @@ class TrainingJob:
         loss = float(sum(jax.device_get(device_losses))) / self.config.eval_batches
         self.eval_history.append((step, loss))
         del self.eval_history[: -self._max_eval_history]
+        self._log_metrics(kind="eval", step=step, loss=loss, perplexity=_perplexity(loss))
         log.info(
             "job %s: eval @ step %d — loss %.4f ppl %.2f",
             self.job_id, step, loss, _perplexity(loss),
         )
+
+    def _log_metrics(self, **fields) -> None:
+        """One JSON line to the job's metrics log (no-op when unconfigured)."""
+        if self._metrics_file is None:
+            return
+        import json
+
+        try:
+            fields["job_id"] = self.job_id
+            # Timeline disambiguation: after a divergence rollback the same
+            # step numbers are re-logged; group by (step, rollback) to pick
+            # the live timeline.
+            fields["rollback"] = self.rollback_count
+            fields["ts"] = time.time()
+            self._metrics_file.write(json.dumps(fields) + "\n")
+            self._metrics_file.flush()
+        except Exception:  # a full disk must not kill training
+            log.exception("job %s: metrics log write failed", self.job_id)
 
     def _advance_stable(self, current_step: int) -> None:
         """Mark saved steps stable once a healthy margin has passed them."""
@@ -429,6 +473,7 @@ class TrainingJob:
             self._state = state
         self.rollback_count += 1
         self.monitor.reset()
+        self._log_metrics(kind="rollback", step=int(step), anomaly_step=before_step)
         log.warning(
             "job %s: rolled back to stable step %d (rollback #%d, lr_scale=%.4f)",
             self.job_id, step, self.rollback_count, float(new_scale),
