@@ -107,8 +107,16 @@ async def get_monitor_summary(request: web.Request) -> web.Response:
 
 
 async def get_loss_curve(request: web.Request) -> web.Response:
-    """Visualization feed (reference ``monitoring.py:112-117``)."""
-    return json_response(_require_monitor(request.match_info["job_id"]).get_loss_curve())
+    """Visualization feed (reference ``monitoring.py:112-117``), extended
+    with the supervised job's held-out eval curve when one exists."""
+    job_id = request.match_info["job_id"]
+    curve = _require_monitor(job_id).get_loss_curve()
+    job = state.launcher.get_job(job_id)
+    if job is not None and job.eval_history:
+        hist = list(job.eval_history)  # snapshot: the job thread mutates it
+        curve["eval_steps"] = [s for s, _ in hist]
+        curve["eval_losses"] = [l for _, l in hist]
+    return json_response(curve)
 
 
 async def get_alerts(request: web.Request) -> web.Response:

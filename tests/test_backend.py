@@ -374,3 +374,33 @@ def test_lora_request_validation(client):
         json={"model_name": "gpt-tiny", "lora_rank": 4},
     )
     assert r.status_code == 200
+
+
+def test_loss_curve_includes_eval(client):
+    r = client.post(
+        "/api/v1/training/launch",
+        json={
+            "model_name": "gpt-tiny",
+            "mesh": {"data": 2, "fsdp": 4},
+            "micro_batch_size": 1,
+            "seq_len": 32,
+            "precision": "fp32",
+            "total_steps": 4,
+            "activation_checkpointing": False,
+            "warmup_steps": 1,
+            "eval_interval_steps": 2,
+            "eval_batches": 1,
+            "dry_run": False,
+        },
+    )
+    job_id = r.json()["job_id"]
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        if client.get(f"/api/v1/training/jobs/{job_id}").json()["status"] in (
+            "completed", "failed",
+        ):
+            break
+        time.sleep(1)
+    curve = client.get(f"/api/v1/monitoring/loss-curve/{job_id}").json()
+    assert curve["eval_steps"] == [2, 4]
+    assert len(curve["eval_losses"]) == 2

@@ -1,0 +1,76 @@
+"""Comm-tuning surface: flag construction and safe application."""
+
+import os
+
+from tpu_engine.comm import apply_comm_flags, xla_flags_for
+from tpu_engine.sharding import TPUTrainConfig
+
+
+def test_default_flags_enable_overlap():
+    flags = xla_flags_for(TPUTrainConfig())
+    assert "--xla_tpu_enable_async_collective_fusion=true" in flags
+    assert "--xla_tpu_enable_latency_hiding_scheduler=true" in flags
+
+
+def test_flags_toggle_off():
+    cfg = TPUTrainConfig(async_collectives=False, latency_hiding_scheduler=False)
+    assert xla_flags_for(cfg) == ""
+    cfg2 = TPUTrainConfig(
+        async_collectives=False, latency_hiding_scheduler=False,
+        xla_extra_flags="--xla_foo=1",
+    )
+    assert xla_flags_for(cfg2) == "--xla_foo=1"
+
+
+def test_apply_skips_without_tpu_runtime():
+    # Off-TPU, XLA aborts the process on unknown xla_tpu_* flags — apply
+    # must leave the environment untouched in this CPU test process.
+    before = os.environ.get("XLA_FLAGS", "")
+    cfg = TPUTrainConfig(xla_extra_flags="--xla_never_applied=1")
+    applied = apply_comm_flags(cfg)
+    assert "--xla_never_applied=1" in applied
+    assert os.environ.get("XLA_FLAGS", "") == before
+
+
+def test_apply_warns_with_live_backend(monkeypatch, caplog):
+    import logging
+
+    import tpu_engine.comm as comm
+
+    monkeypatch.setattr(comm, "_tpu_runtime_available", lambda: True)
+    import jax
+
+    jax.devices()  # ensure initialised
+    before = os.environ.get("XLA_FLAGS", "")
+    with caplog.at_level(logging.WARNING, logger="tpu_engine.comm"):
+        comm.apply_comm_flags(TPUTrainConfig(xla_extra_flags="--xla_never_applied=1"))
+    assert os.environ.get("XLA_FLAGS", "") == before
+    assert any("already initialised" in r.message for r in caplog.records)
+
+
+def test_apply_idempotent_when_present(monkeypatch):
+    cfg = TPUTrainConfig(
+        async_collectives=False, latency_hiding_scheduler=False,
+        xla_extra_flags="--xla_already_there=1",
+    )
+    monkeypatch.setenv("XLA_FLAGS", "--xla_already_there=1")
+    applied = apply_comm_flags(cfg)
+    assert applied == "--xla_already_there=1"
+    assert os.environ["XLA_FLAGS"] == "--xla_already_there=1"
+
+
+def test_apply_respects_operator_value(monkeypatch):
+    # Operator's explicit --flag=false must not be overridden by our =true.
+    import tpu_engine.comm as comm
+
+    monkeypatch.setattr(comm, "_tpu_runtime_available", lambda: True)
+    monkeypatch.setattr(comm, "_backend_initialized", lambda: False)
+    monkeypatch.setenv(
+        "XLA_FLAGS", "--xla_tpu_enable_latency_hiding_scheduler=false"
+    )
+    comm.apply_comm_flags(TPUTrainConfig(async_collectives=False))
+    flags = os.environ["XLA_FLAGS"]
+    assert flags.count("--xla_tpu_enable_latency_hiding_scheduler") == 1
+    assert "--xla_tpu_enable_latency_hiding_scheduler=false" in flags
+    # But genuinely-new flags were appended.
+    assert "--xla_latency_hiding_scheduler_rerun=1" in flags

@@ -1,0 +1,111 @@
+"""Collective-communication tuning surface.
+
+The reference's comm tuning is DeepSpeed JSON knobs — ``overlap_comm``,
+``allgather_bucket_size``, ``reduce_bucket_size``, ``reduce_scatter``
+(``ai_engine/deepspeed_launcher.py:133-142``) — that shape how NCCL
+overlaps and buckets collectives. On TPU the collectives are emitted by
+XLA from sharding annotations, so the equivalent surface is XLA *compiler
+flags*: async collectives let communication overlap compute, and the
+latency-hiding scheduler reorders the program to hide it (SURVEY.md §2.4:
+"bucket-size analogs → XLA latency-hiding/async-collective flags").
+
+Flags only take effect if set before the XLA backend initialises — the
+worker CLI applies them first thing; library users call
+:func:`apply_comm_flags` before touching jax, or export the string from
+:func:`xla_flags_for` themselves.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from tpu_engine.sharding import TPUTrainConfig
+
+log = logging.getLogger(__name__)
+
+# Flag spellings current as of jaxlib 0.8 / openxla 2026-xx; all are
+# long-stable openxla options.
+_ASYNC_COLLECTIVE_FLAGS = (
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+)
+_LATENCY_HIDING_FLAGS = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_latency_hiding_scheduler_rerun=1",
+)
+
+
+def xla_flags_for(cfg: TPUTrainConfig) -> str:
+    """The XLA flag string for ``cfg``'s comm-tuning knobs (may be empty)."""
+    parts: list[str] = []
+    if cfg.async_collectives:
+        parts.extend(_ASYNC_COLLECTIVE_FLAGS)
+    if cfg.latency_hiding_scheduler:
+        parts.extend(_LATENCY_HIDING_FLAGS)
+    if cfg.xla_extra_flags:
+        parts.append(cfg.xla_extra_flags)
+    return " ".join(parts)
+
+
+def _backend_initialized() -> bool:
+    import jax
+
+    try:
+        return jax._src.xla_bridge._backends != {}  # type: ignore[attr-defined]
+    except Exception:
+        return False
+
+
+def _tpu_runtime_available() -> bool:
+    """True only on a real TPU VM (whose plugin registers the ``xla_tpu_*``
+    flags): the TPU runtime's env vars, or an explicit JAX_PLATFORMS=tpu.
+    Anywhere else XLA's flag parser hard-ABORTS the process on unknown
+    flags — a merely *installed* libtpu wheel is not sufficient evidence
+    (tunneled/virtual runtimes ship one without registering TPU flags), so
+    never apply speculatively."""
+    jp = os.environ.get("JAX_PLATFORMS", "")
+    if jp:  # explicit platform choice wins — "axon"/"cpu" etc. must skip
+        return "tpu" in jp.lower().split(",")
+    # Unset (normal on TPU VMs, where jax autodetects): trust the TPU
+    # runtime's own env vars.
+    return any(
+        v in os.environ
+        for v in ("TPU_ACCELERATOR_TYPE", "TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES")
+    )
+
+
+def apply_comm_flags(cfg: TPUTrainConfig) -> str:
+    """Append ``cfg``'s comm flags to ``XLA_FLAGS`` (idempotent).
+
+    Returns the flag string that *would* apply. TPU-only flags are applied
+    only when a TPU runtime is present (see :func:`_tpu_runtime_available`)
+    and the backend has not initialised yet; otherwise it logs and leaves
+    the environment alone.
+    """
+    flags = xla_flags_for(cfg)
+    if not flags:
+        return ""
+    current = os.environ.get("XLA_FLAGS", "")
+    # Compare by flag *name*: an operator's explicit --foo=false must not be
+    # overridden by appending our --foo=true (the later value would win).
+    present = {t.split("=", 1)[0] for t in current.split()}
+    missing = [f for f in flags.split() if f.split("=", 1)[0] not in present]
+    if not missing:
+        return flags
+    if not _tpu_runtime_available():
+        log.info(
+            "no TPU runtime in this process — not applying TPU comm flags %s "
+            "(off-TPU XLA aborts on unknown flags)", missing,
+        )
+        return flags
+    if _backend_initialized():
+        log.warning(
+            "XLA backend already initialised — comm flags %s will not take "
+            "effect this process; set XLA_FLAGS before importing jax or use "
+            "the worker CLI", missing,
+        )
+        return flags
+    os.environ["XLA_FLAGS"] = (current + " " + " ".join(missing)).strip()
+    return flags

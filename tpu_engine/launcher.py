@@ -302,6 +302,12 @@ def main(argv: Optional[list[str]] = None) -> int:
             target[leaf] = value
     config = TPUTrainConfig(**cfg_dict)
 
+    # Comm-tuning XLA flags must land before the backend initialises
+    # (tpu_engine/comm.py — the reference's overlap_comm/bucket analogue).
+    from tpu_engine.comm import apply_comm_flags
+
+    apply_comm_flags(config)
+
     # Multi-host rendezvous (no-op single-process; GKE env autodetected).
     from tpu_engine.mesh_runtime import initialize_distributed
 

@@ -257,6 +257,14 @@ class TPUTrainConfig(BaseModel):
     optimizer_offload: OffloadDevice = OffloadDevice.NONE
     param_offload: OffloadDevice = OffloadDevice.NONE
 
+    # Collective-communication tuning (reference overlap_comm /
+    # bucket-size knobs, ``deepspeed_launcher.py:133-142`` → XLA flags;
+    # see tpu_engine/comm.py). Applied by the worker CLI before the XLA
+    # backend initialises.
+    async_collectives: bool = True
+    latency_hiding_scheduler: bool = True
+    xla_extra_flags: str = ""
+
     # Attention implementation: "auto" = flash kernel on TPU, XLA elsewhere;
     # a >1 sequence mesh axis switches to ring attention unless "ulysses"
     # (all-to-all sequence parallelism) is requested explicitly.

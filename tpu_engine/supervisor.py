@@ -179,6 +179,12 @@ class TrainingJob:
         """Build the train program; for LoRA, load the frozen base weights
         from the configured HF checkpoint directory."""
         cfg = self.config
+        # Comm-tuning flags: in the worker CLI these were applied before the
+        # backend initialised; in a long-lived server this warns that the
+        # per-job knobs cannot take effect (never a silent no-op).
+        from tpu_engine.comm import apply_comm_flags
+
+        apply_comm_flags(cfg)
         if cfg.lora_rank and cfg.lora_base_hf_checkpoint:
             from transformers import AutoModelForCausalLM
 
