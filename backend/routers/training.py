@@ -211,6 +211,25 @@ class GenerateRequest(BaseModel):
     seed: int = 0
 
 
+class ExportRequest(BaseModel):
+    out_dir: str
+
+
+async def export_job_checkpoint(request: web.Request) -> web.Response:
+    """Export the job's current weights as an HF LlamaForCausalLM
+    checkpoint directory (LoRA jobs export base+adapters merged)."""
+    job_id = request.match_info["job_id"]
+    job = state.launcher.get_job(job_id)
+    if job is None:
+        raise ApiError(404, f"job '{job_id}' not found")
+    req = await parse_body(request, ExportRequest)
+    try:
+        path, step = await asyncio.to_thread(job.export_hf_checkpoint, req.out_dir)
+    except (RuntimeError, ValueError) as e:
+        raise ApiError(422, str(e))
+    return json_response({"job_id": job_id, "step": step, "path": path})
+
+
 async def generate_from_job(request: web.Request) -> web.Response:
     """Qualitative sampling while (or after) a job trains — runs on a
     consistent snapshot of the job's weights."""
@@ -251,3 +270,4 @@ def setup(app: web.Application, prefix: str = "/api/v1/training") -> None:
     app.router.add_get(f"{prefix}/jobs/{{job_id}}", get_job)
     app.router.add_post(f"{prefix}/jobs/{{job_id}}/stop", stop_job)
     app.router.add_post(f"{prefix}/jobs/{{job_id}}/generate", generate_from_job)
+    app.router.add_post(f"{prefix}/jobs/{{job_id}}/export", export_job_checkpoint)

@@ -126,3 +126,26 @@ def test_decoupled_head_dim_rejected():
     hf_cfg.head_dim = 32  # != 32 // 2
     with pytest.raises(ValueError, match="head_dim"):
         config_from_hf(hf_cfg)
+
+
+def test_save_hf_checkpoint_roundtrip(tmp_path):
+    from tpu_engine.models.convert import save_hf_checkpoint
+
+    cfg = tfm.MODEL_CONFIGS["gpt-tiny"]
+    params = tfm.init_params(jax.random.PRNGKey(5), cfg)
+    out = save_hf_checkpoint(params, cfg, str(tmp_path / "export"))
+    reloaded = LlamaForCausalLM.from_pretrained(out).eval()
+    tokens = np.random.default_rng(2).integers(0, cfg.vocab_size, (1, 12))
+    with torch.no_grad():
+        hf_logits = reloaded(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(
+        tfm.forward(params, jnp.asarray(tokens, jnp.int32), cfg, compute_dtype=jnp.float32)
+    )
+    np.testing.assert_allclose(ours, hf_logits, atol=2e-3, rtol=2e-3)
+
+
+def test_moe_export_rejected():
+    from tpu_engine.models.convert import hf_config_from
+
+    with pytest.raises(ValueError, match="MoE"):
+        hf_config_from(tfm.MODEL_CONFIGS["moe-tiny"])

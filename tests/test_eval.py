@@ -164,3 +164,26 @@ def test_metrics_log_bad_path_does_not_fail_job(tmp_path):
     launcher = TPULauncher()
     res = launcher.launch(cfg, dry_run=False, block=True)
     assert launcher.get_job(res.job_id).describe()["status"] == "completed"
+
+
+def test_dense_export_while_running_survives_donation():
+    # Exporting a RUNNING full-parameter job must not race the train step's
+    # buffer donation (params are host-copied under the state lock).
+    import tempfile
+    import time
+
+    cfg = _cfg(total_steps=150)
+    launcher = TPULauncher()
+    res = launcher.launch(cfg, dry_run=False, block=False)
+    job = launcher.get_job(res.job_id)
+    deadline = time.time() + 120
+    while job.status.value not in ("running", "completed") and time.time() < deadline:
+        time.sleep(0.2)
+    exported = 0
+    while job.status.value == "running" and exported < 2:
+        path, step = job.export_hf_checkpoint(tempfile.mkdtemp() + "/e")
+        assert 0 <= step <= 150
+        exported += 1
+    job.join(timeout=120)
+    assert job.status.value == "completed", job.describe()
+    assert exported >= 1

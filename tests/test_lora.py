@@ -179,3 +179,27 @@ def test_supervised_lora_job_from_hf_base(tmp_path):
     assert job.program.model_config.d_model == 64
     out = job.generate_sample([[1, 2, 3]], max_new_tokens=3)
     assert len(out[0]) == 6
+
+
+def test_lora_job_exports_merged_hf_checkpoint(tmp_path):
+    transformers = pytest.importorskip("transformers")
+    import torch
+
+    cfg = _cfg(total_steps=3)
+    launcher = TPULauncher()
+    res = launcher.launch(cfg, dry_run=False, block=True)
+    job = launcher.get_job(res.job_id)
+    assert job.describe()["status"] == "completed"
+    out, step = job.export_hf_checkpoint(str(tmp_path / "export"))
+    assert step == 3
+    reloaded = transformers.LlamaForCausalLM.from_pretrained(out).eval()
+    # Reloaded HF logits must match our merged (base+adapter) forward.
+    tokens = np.asarray([[1, 2, 3, 4, 5, 6]])
+    with torch.no_grad():
+        hf_logits = reloaded(torch.tensor(tokens)).logits.numpy()
+    merged = job.program.merged_params(job._state["params"])
+    ours = np.asarray(tfm.forward(
+        merged, jnp.asarray(tokens, jnp.int32), job.program.model_config,
+        compute_dtype=jnp.float32,
+    ))
+    np.testing.assert_allclose(ours, hf_logits, atol=2e-3, rtol=2e-3)

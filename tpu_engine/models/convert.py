@@ -134,6 +134,47 @@ def from_hf_llama(
     return params
 
 
+def hf_config_from(cfg: ModelConfig) -> Any:
+    """Inverse of :func:`config_from_hf`: a ``transformers.LlamaConfig``
+    describing this model (dense Llama-style models only)."""
+    if cfg.is_moe:
+        raise ValueError("MoE models have no LlamaForCausalLM representation")
+    from transformers import LlamaConfig
+
+    return LlamaConfig(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.d_model,
+        intermediate_size=cfg.d_ff,
+        num_hidden_layers=cfg.n_layers,
+        num_attention_heads=cfg.n_heads,
+        num_key_value_heads=cfg.n_kv_heads,
+        max_position_embeddings=cfg.max_seq_len,
+        rope_theta=cfg.rope_theta,
+        rms_norm_eps=cfg.norm_eps,
+        attention_bias=False,
+        tie_word_embeddings=False,
+    )
+
+
+def save_hf_checkpoint(params: dict[str, Any], cfg: ModelConfig, out_dir: str) -> str:
+    """Write ``params`` as a loadable HF ``LlamaForCausalLM`` checkpoint
+    directory (config.json + safetensors). Returns ``out_dir``."""
+    import torch
+    from transformers import LlamaForCausalLM
+
+    hf_cfg = hf_config_from(cfg)
+    sd = {k: torch.tensor(v) for k, v in to_hf_llama(params, cfg).items()}
+    # meta device: never allocate (or randomly initialise) a second full
+    # weight copy just to overwrite it — assign=True adopts our tensors.
+    with torch.device("meta"):
+        model = LlamaForCausalLM(hf_cfg)
+    missing, unexpected = model.load_state_dict(sd, strict=False, assign=True)
+    if unexpected or any("rotary" not in m and "inv_freq" not in m for m in missing):
+        raise ValueError(f"export mismatch: missing={missing} unexpected={unexpected}")
+    model.save_pretrained(out_dir)
+    return out_dir
+
+
 def to_hf_llama(params: dict[str, Any], cfg: ModelConfig) -> dict[str, np.ndarray]:
     """This framework's param pytree → HF Llama state-dict layout (numpy).
 

@@ -518,13 +518,7 @@ class TrainingJob:
             raise ValueError("prompt rows must be non-empty and equal-length")
         prompt = jnp.asarray(prompt_tokens, jnp.int32)
         with self._state_lock:
-            params = self._state["params"]
-            if self.program.merged_params is not None:  # LoRA: adapters → full
-                if self._merged_cache is not None and self._merged_cache[0] == self.current_step:
-                    params = self._merged_cache[1]
-                else:
-                    params = self.program.merged_params(params)
-                    self._merged_cache = (self.current_step, params)
+            params = self._full_params_locked()
             out = generate(
                 params,
                 prompt,
@@ -537,6 +531,41 @@ class TrainingJob:
                 compute_dtype=self.program.config.compute_dtype(),
             )
         return [[int(t) for t in row] for row in jax.device_get(out)]
+
+    def _full_params_locked(self):
+        """Full model params for the current step (caller holds _state_lock):
+        the trainable tree itself, or (LoRA) base+adapters merged — cached
+        per step so repeated sampling/export reuses the merge."""
+        params = self._state["params"]
+        if self.program.merged_params is None:
+            return params
+        if self._merged_cache is not None and self._merged_cache[0] == self.current_step:
+            return self._merged_cache[1]
+        params = self.program.merged_params(params)
+        self._merged_cache = (self.current_step, params)
+        return params
+
+    def export_hf_checkpoint(self, out_dir: str) -> tuple[str, int]:
+        """Write the job's current weights (LoRA: base+adapters merged) as a
+        loadable HF LlamaForCausalLM checkpoint directory.
+
+        Returns ``(out_dir, step)`` where ``step`` is the training step the
+        exported weights belong to (captured under the state lock — the job
+        may advance while the conversion writes).
+        """
+        from tpu_engine.models.convert import save_hf_checkpoint
+
+        if self.program is None or self._state is None:
+            raise RuntimeError("job has no initialized state to export")
+        with self._state_lock:
+            step = self.current_step
+            params = self._full_params_locked()
+            if self.program.merged_params is None:
+                # Dense path: no dispatched merge holds buffer references,
+                # and the next train step DONATES these exact buffers —
+                # host-copy before releasing the lock.
+                params = jax.device_get(params)
+        return save_hf_checkpoint(params, self.program.model_config, out_dir), step
 
     # -- views ---------------------------------------------------------------
 
