@@ -36,6 +36,44 @@ def write_token_file(tokens: np.ndarray, path: str, dtype: str = "uint16") -> st
     return path
 
 
+def tokenize_text_file(
+    text_path: str,
+    out_path: str,
+    tokenizer: Any,
+    dtype: str = "uint16",
+    append_eos: bool = True,
+) -> int:
+    """Tokenize a text file (one document per line) into the flat binary
+    token format, streaming — the whole corpus is never held in memory.
+
+    ``tokenizer`` is anything with an ``encode`` method: a HF
+    ``PreTrainedTokenizer(Fast)`` loaded from a local directory, or a raw
+    ``tokenizers.Tokenizer``. Returns the number of tokens written.
+    ``dtype="uint16"`` requires every id < 65536 (checked).
+    """
+    np_dtype = _NP_DTYPES[dtype]
+    limit = np.iinfo(np_dtype).max
+    eos_id = getattr(tokenizer, "eos_token_id", None)
+    total = 0
+    with open(text_path, "r", encoding="utf-8") as fin, open(out_path, "wb") as fout:
+        for line in fin:
+            line = line.rstrip("\r\n")  # CRLF corpora must not leak \r tokens
+            if not line:
+                continue
+            enc = tokenizer.encode(line)
+            ids = enc if isinstance(enc, list) else enc.ids  # HF vs raw tokenizers
+            if append_eos and eos_id is not None:
+                ids = list(ids) + [eos_id]
+            arr = np.asarray(ids, dtype=np.int64)
+            if arr.size and int(arr.max()) > limit:
+                raise ValueError(
+                    f"token id {int(arr.max())} exceeds {dtype} range; use dtype='int32'"
+                )
+            fout.write(arr.astype(np_dtype).tobytes())
+            total += int(arr.size)
+    return total
+
+
 def _splitmix64(state: np.uint64) -> tuple[np.uint64, np.uint64]:
     """One splitmix64 step — must match the native RNG bit-for-bit so the
     Python fallback yields the identical shuffle order."""
