@@ -59,11 +59,22 @@ class MeshConfig(BaseModel):
     pipe: int = Field(default=1, ge=1, description="pipeline-parallel axis size (stages)")
     sequence: int = Field(default=1, ge=1, description="sequence/context-parallel axis size")
     model: int = Field(default=1, ge=1, description="tensor-parallel axis size")
+    # Multislice: number of data-parallel replica groups spanning slices.
+    # The outer dcn_data blocks of the "data" axis land on distinct slices,
+    # so only data-parallel gradient all-reduces cross DCN while the
+    # bandwidth-hungry fsdp/model/sequence collectives stay on ICI within a
+    # slice (the scaling-book recipe; the reference's analogue is
+    # ``num_nodes`` with NCCL over the node interconnect).
+    dcn_data: int = Field(default=1, ge=1, description="data-parallel replica groups across slices (DCN)")
 
     @model_validator(mode="after")
     def _no_zero(self) -> "MeshConfig":
         if self.data == 0:
             raise ValueError("data axis size must be -1 (infer) or >= 1")
+        if self.data != -1 and self.data % self.dcn_data != 0:
+            raise ValueError(
+                f"data={self.data} must be divisible by dcn_data={self.dcn_data}"
+            )
         return self
 
     def resolved_shape(self, n_devices: int) -> tuple[int, int, int, int, int]:
@@ -120,6 +131,9 @@ def detect_topology(devices: Optional[Sequence[jax.Device]] = None) -> dict[str,
     return {
         "num_devices": len(devices),
         "num_processes": len(per_process) if per_process else 1,
+        "num_slices": (
+            len({getattr(d, "slice_index", 0) or 0 for d in devices}) if devices else 0
+        ),
         "devices_per_process": per_process,
         "platform": devices[0].platform if devices else "none",
         "ici_physical_shape": ici_shape,
@@ -159,24 +173,84 @@ def initialize_distributed(
     return True
 
 
+def _device_array(shape: tuple[int, ...], devs: Sequence[jax.Device]) -> np.ndarray:
+    """ICI-aware device layout, with a plain reshape fallback for host
+    counts/topologies ``create_device_mesh`` can't map."""
+    try:
+        return mesh_utils.create_device_mesh(shape, devices=list(devs))
+    except (ValueError, AssertionError):
+        return np.asarray(devs).reshape(shape)
+
+
 def build_mesh(
     config: Optional[MeshConfig] = None,
     devices: Optional[Sequence[jax.Device]] = None,
+    slice_assignments: Optional[Sequence[int]] = None,
 ) -> Mesh:
     """Build a :class:`jax.sharding.Mesh` with the canonical axis names.
 
     Uses ``mesh_utils.create_device_mesh`` so the logical mesh is laid out
     along physical ICI neighbours where possible.
+
+    ``dcn_data > 1`` builds a hybrid DCN/ICI mesh — the outer blocks of the
+    "data" axis are whole slices, so only data-parallel collectives cross
+    DCN. On real multislice hardware (devices expose ``slice_index``) this
+    delegates to ``mesh_utils.create_hybrid_device_mesh``;
+    ``slice_assignments`` substitutes an explicit device→slice map for
+    tests/virtual devices.
     """
     config = config or MeshConfig()
     devices = list(devices if devices is not None else jax.devices())
     shape = config.resolved_shape(len(devices))
-    try:
-        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
-    except (ValueError, AssertionError):
-        # Fallback for host counts/topologies create_device_mesh can't map.
-        dev_array = np.asarray(devices).reshape(shape)
-    return Mesh(dev_array, MESH_AXES)
+    if slice_assignments is not None and len(slice_assignments) != len(devices):
+        raise ValueError("slice_assignments must cover every device")
+    if config.dcn_data == 1:
+        if slice_assignments is not None:
+            raise ValueError(
+                "slice_assignments given but dcn_data=1 — the slice layout "
+                "would be silently ignored; set mesh.dcn_data"
+            )
+        return Mesh(_device_array(shape, devices), MESH_AXES)
+
+    if shape[0] % config.dcn_data != 0:
+        raise ValueError(
+            f"resolved data axis {shape[0]} not divisible by dcn_data={config.dcn_data}"
+        )
+    inner_shape = (shape[0] // config.dcn_data, *shape[1:])
+
+    if slice_assignments is None:
+        # Real multislice: require the runtime's own slice ids — guessing
+        # from process_index breaks on multi-process-per-node platforms.
+        if any(getattr(d, "slice_index", None) is None for d in devices):
+            raise ValueError(
+                "dcn_data > 1 but this platform exposes no device.slice_index; "
+                "pass slice_assignments explicitly"
+            )
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            inner_shape,
+            dcn_mesh_shape=(config.dcn_data, 1, 1, 1, 1),
+            devices=devices,
+        )
+        return Mesh(dev_array, MESH_AXES)
+
+    groups: dict[int, list[jax.Device]] = {}
+    for sid, d in zip(slice_assignments, devices):
+        groups.setdefault(int(sid), []).append(d)
+    if len(groups) != config.dcn_data:
+        raise ValueError(
+            f"dcn_data={config.dcn_data} but found {len(groups)} device "
+            f"slices ({sorted(groups)}); one replica group per slice required"
+        )
+    per_slice = len(devices) // config.dcn_data
+    blocks = []
+    for sid in sorted(groups):
+        grp = groups[sid]
+        if len(grp) != per_slice:
+            raise ValueError(
+                f"slice {sid} has {len(grp)} devices; expected {per_slice}"
+            )
+        blocks.append(_device_array(inner_shape, grp))
+    return Mesh(np.concatenate(blocks, axis=0), MESH_AXES)
 
 
 class MeshRuntime:
@@ -186,10 +260,11 @@ class MeshRuntime:
         self,
         config: Optional[MeshConfig] = None,
         devices: Optional[Sequence[jax.Device]] = None,
+        slice_assignments: Optional[Sequence[int]] = None,
     ):
         self.config = config or MeshConfig()
         self.devices = list(devices if devices is not None else jax.devices())
-        self.mesh = build_mesh(self.config, self.devices)
+        self.mesh = build_mesh(self.config, self.devices, slice_assignments)
 
     # -- axis facts ---------------------------------------------------------
 
