@@ -32,6 +32,10 @@ class TrainingLaunchRequest(BaseModel):
     gradient_accumulation_steps: int = Field(default=1, ge=1)
     seq_len: int = Field(default=2048, ge=1)
     precision: str = "bf16"
+    optimizer: Literal["adamw", "adafactor", "lion"] = "adamw"
+    lr_schedule: Literal["cosine", "linear", "constant", "rsqrt"] = "cosine"
+    decay_all_params: bool = False
+    moment_dtype: Optional[str] = None
     learning_rate: float = Field(default=3e-4, gt=0)
     warmup_steps: int = Field(default=100, ge=0)
     total_steps: int = Field(default=10_000, ge=1)
@@ -92,6 +96,10 @@ def _to_config(req: TrainingLaunchRequest) -> TPUTrainConfig:
             gradient_accumulation_steps=req.gradient_accumulation_steps,
             seq_len=req.seq_len,
             precision=Precision(req.precision),
+            optimizer=req.optimizer,
+            lr_schedule=req.lr_schedule,
+            decay_all_params=req.decay_all_params,
+            moment_dtype=Precision(req.moment_dtype) if req.moment_dtype else None,
             learning_rate=req.learning_rate,
             warmup_steps=req.warmup_steps,
             total_steps=req.total_steps,

@@ -244,6 +244,16 @@ class TPUTrainConfig(BaseModel):
     moment_dtype: Optional[Precision] = None
 
     # Optimizer / schedule (reference :145-164 AdamW + WarmupDecayLR).
+    # "adamw" matches the reference; "adafactor" stores factored second
+    # moments (O(in+out) per kernel instead of O(in·out) — the classic
+    # TPU-era memory saver); "lion" keeps a single bf16-friendly momentum.
+    optimizer: Literal["adamw", "adafactor", "lion"] = "adamw"
+    # LR schedule shape; all warm up over warmup_steps first.
+    lr_schedule: Literal["cosine", "linear", "constant", "rsqrt"] = "cosine"
+    # Decay norm scales / embeddings too? Standard LLM practice is to decay
+    # only the ≥2-D matmul kernels (the default); True matches the
+    # reference's blanket AdamW weight_decay.
+    decay_all_params: bool = False
     learning_rate: float = Field(default=3e-4, gt=0)
     min_lr: float = Field(default=3e-5, ge=0)
     warmup_steps: int = Field(default=100, ge=0)

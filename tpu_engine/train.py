@@ -45,18 +45,32 @@ from tpu_engine.sharding import (
 
 
 def make_schedule(cfg: TPUTrainConfig) -> optax.Schedule:
-    """Warmup + cosine decay to ``min_lr`` (reference WarmupDecayLR, ``:145-153``)."""
-    return optax.warmup_cosine_decay_schedule(
-        init_value=0.0,
-        peak_value=cfg.learning_rate,
-        warmup_steps=max(cfg.warmup_steps, 1),
-        decay_steps=max(cfg.total_steps, cfg.warmup_steps + 1),
-        end_value=cfg.min_lr,
-    )
+    """Warmup + the configured decay shape (reference WarmupDecayLR,
+    ``:145-153``, generalised: cosine | linear | constant | rsqrt)."""
+    warmup = max(cfg.warmup_steps, 1)
+    decay_steps = max(cfg.total_steps, cfg.warmup_steps + 1)
+    if cfg.lr_schedule == "cosine":
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=cfg.learning_rate, warmup_steps=warmup,
+            decay_steps=decay_steps, end_value=cfg.min_lr,
+        )
+    warm = optax.linear_schedule(0.0, cfg.learning_rate, warmup)
+    if cfg.lr_schedule == "linear":
+        tail = optax.linear_schedule(
+            cfg.learning_rate, cfg.min_lr, max(decay_steps - warmup, 1)
+        )
+    elif cfg.lr_schedule == "constant":
+        tail = optax.constant_schedule(cfg.learning_rate)
+    else:  # rsqrt: lr · sqrt(warmup / step) past warmup, floored at min_lr
+        def tail(step):
+            lr = cfg.learning_rate * jnp.sqrt(warmup / jnp.maximum(step + warmup, 1))
+            return jnp.maximum(lr, cfg.min_lr)
+    return optax.join_schedules([warm, tail], boundaries=[warmup])
 
 
 def make_optimizer(cfg: TPUTrainConfig) -> tuple[optax.GradientTransformation, optax.Schedule]:
-    """AdamW matching the reference's optimizer block (``:156-164``).
+    """The configured optimizer (AdamW matches the reference's block,
+    ``:156-164``; Adafactor/Lion are the TPU-era memory-efficient options).
 
     The learning rate is deliberately NOT baked into the transformation: the
     train step applies ``-lr`` itself, where ``lr = schedule(step) × lr_scale``
@@ -64,13 +78,45 @@ def make_optimizer(cfg: TPUTrainConfig) -> tuple[optax.GradientTransformation, o
     the LR after a divergence rollback (mechanising the reference's
     "reduce learning rate" remediation strings, ``loss_monitor.py:131-136``)
     without recompiling the step function.
+
+    Weight decay applies only to ≥2-D kernels unless ``decay_all_params``
+    (norm scales and embeddings are conventionally undecayed).
     """
     schedule = make_schedule(cfg)
     mu_dtype = dtype_of(cfg.moment_dtype) if cfg.moment_dtype is not None else None
+    if cfg.optimizer == "adafactor":
+        if cfg.moment_dtype is not None:
+            raise ValueError(
+                "moment_dtype is not supported with optimizer='adafactor' "
+                "(factored statistics have no dtype knob)"
+            )
+        # Honor an explicitly-set beta2 as the factored-RMS decay rate;
+        # otherwise keep Adafactor's conventional 0.8 (Adam's 0.95 default
+        # is not a sensible factored decay).
+        decay_rate = cfg.beta2 if "beta2" in cfg.model_fields_set else 0.8
+        scaler = optax.scale_by_factored_rms(decay_rate=decay_rate)
+    elif cfg.optimizer == "lion":
+        scaler = optax.scale_by_lion(
+            b1=cfg.beta1, b2=cfg.beta2, mu_dtype=mu_dtype
+        )
+    else:
+        scaler = optax.scale_by_adam(
+            b1=cfg.beta1, b2=cfg.beta2, eps=1e-8, mu_dtype=mu_dtype
+        )
+    # Path-based decay mask: matmul kernels and LoRA adapter factors decay;
+    # norm scales and embeddings do not. ndim alone cannot distinguish them
+    # — the stacked layout makes per-layer norm scales [L, D].
+    def _kernels_only(params):
+        return jax.tree_util.tree_map_with_path(
+            lambda path, _: getattr(path[-1], "key", None) in ("kernel", "A", "B"),
+            params,
+        )
+
+    decay = optax.add_decayed_weights(
+        cfg.weight_decay, mask=None if cfg.decay_all_params else _kernels_only
+    )
     tx = optax.chain(
-        optax.clip_by_global_norm(cfg.grad_clip_norm),
-        optax.scale_by_adam(b1=cfg.beta1, b2=cfg.beta2, eps=1e-8, mu_dtype=mu_dtype),
-        optax.add_decayed_weights(cfg.weight_decay),
+        optax.clip_by_global_norm(cfg.grad_clip_norm), scaler, decay
     )
     return tx, schedule
 
@@ -290,16 +336,24 @@ def build_train_program(
         }
 
     # Optimizer-state sharding tree: leaves shaped like params take the
-    # opt pspecs; scalar leaves (counts, schedule state) replicate.
-    def _opt_state_shardings(opt_state_shape) -> Any:
+    # opt pspecs; everything else (counts, schedule state, Adafactor's
+    # factored row/col statistics — param-pathed but differently shaped)
+    # replicates.
+    def _opt_state_shardings(opt_state_shape, param_shapes) -> Any:
         flat_param_sh = {id_path: sh for id_path, sh in _path_leaves(opt_leaf_sh)}
+        flat_param_shape = {
+            id_path: leaf.shape for id_path, leaf in _path_leaves(param_shapes)
+        }
 
         def assign(path, leaf):
             # Leaves inside the opt state that mirror a param (mu/nu) carry
-            # the param's path as a suffix; match on that.
+            # the param's path as a suffix; match on path AND shape (a
+            # factored statistic shares the path but not the shape).
             for p_path, sh in flat_param_sh.items():
                 if _path_endswith(path, p_path):
-                    return sh
+                    if getattr(leaf, "shape", None) == flat_param_shape.get(p_path):
+                        return sh
+                    return replicated
             return replicated
 
         return _tree_map_with_path(assign, opt_state_shape)
@@ -307,7 +361,7 @@ def build_train_program(
     state_shape = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
     state_shardings = {
         "params": param_sh,
-        "opt_state": _opt_state_shardings(state_shape["opt_state"]),
+        "opt_state": _opt_state_shardings(state_shape["opt_state"], state_shape["params"]),
         "step": replicated,
         "lr_scale": replicated,
     }
