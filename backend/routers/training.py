@@ -36,6 +36,7 @@ class TrainingLaunchRequest(BaseModel):
     lr_schedule: Literal["cosine", "linear", "constant", "rsqrt"] = "cosine"
     decay_all_params: bool = False
     moment_dtype: Optional[str] = None
+    z_loss_coef: float = Field(default=0.0, ge=0)
     learning_rate: float = Field(default=3e-4, gt=0)
     warmup_steps: int = Field(default=100, ge=0)
     total_steps: int = Field(default=10_000, ge=1)
@@ -100,6 +101,7 @@ def _to_config(req: TrainingLaunchRequest) -> TPUTrainConfig:
             lr_schedule=req.lr_schedule,
             decay_all_params=req.decay_all_params,
             moment_dtype=Precision(req.moment_dtype) if req.moment_dtype else None,
+            z_loss_coef=req.z_loss_coef,
             learning_rate=req.learning_rate,
             warmup_steps=req.warmup_steps,
             total_steps=req.total_steps,
@@ -219,6 +221,38 @@ class GenerateRequest(BaseModel):
     seed: int = 0
 
 
+async def list_job_checkpoints(request: web.Request) -> web.Response:
+    """Saved checkpoint steps, the latest, and the stable pointer — the
+    introspection the reference's promised rollback machinery would need
+    (it has none; SURVEY §5 checkpoint/resume)."""
+    job_id = request.match_info["job_id"]
+    job = state.launcher.get_job(job_id)
+    if job is None:
+        raise ApiError(404, f"job '{job_id}' not found")
+    if job.ckpt is None:
+        return json_response(
+            {
+                "job_id": job_id, "checkpoint_dir": None, "steps": [],
+                "latest": None, "stable": None,
+            }
+        )
+
+    def snapshot():
+        # One directory scan; latest/stable derive from it. Runs off the
+        # event loop — checkpoint dirs can live on slow/remote storage.
+        steps = job.ckpt.all_steps()
+        stable = job.ckpt.last_stable_step()
+        return {
+            "job_id": job_id,
+            "checkpoint_dir": job.config.checkpoint_dir,
+            "steps": steps,
+            "latest": steps[-1] if steps else None,
+            "stable": stable,
+        }
+
+    return json_response(await asyncio.to_thread(snapshot))
+
+
 class ExportRequest(BaseModel):
     out_dir: str
 
@@ -279,3 +313,4 @@ def setup(app: web.Application, prefix: str = "/api/v1/training") -> None:
     app.router.add_post(f"{prefix}/jobs/{{job_id}}/stop", stop_job)
     app.router.add_post(f"{prefix}/jobs/{{job_id}}/generate", generate_from_job)
     app.router.add_post(f"{prefix}/jobs/{{job_id}}/export", export_job_checkpoint)
+    app.router.add_get(f"{prefix}/jobs/{{job_id}}/checkpoints", list_job_checkpoints)

@@ -404,3 +404,59 @@ def test_loss_curve_includes_eval(client):
     curve = client.get(f"/api/v1/monitoring/loss-curve/{job_id}").json()
     assert curve["eval_steps"] == [2, 4]
     assert len(curve["eval_losses"]) == 2
+
+
+def test_job_checkpoints_listing(client, tmp_path_factory):
+    ckpt_dir = str(tmp_path_factory.mktemp("api_ckpt"))
+    r = client.post(
+        "/api/v1/training/launch",
+        json={
+            "model_name": "gpt-tiny",
+            "mesh": {"data": 2, "fsdp": 4},
+            "micro_batch_size": 1,
+            "seq_len": 32,
+            "precision": "fp32",
+            "total_steps": 4,
+            "activation_checkpointing": False,
+            "warmup_steps": 1,
+            "checkpoint_dir": ckpt_dir,
+            "checkpoint_interval_steps": 2,
+            "dry_run": False,
+        },
+    )
+    job_id = r.json()["job_id"]
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        if client.get(f"/api/v1/training/jobs/{job_id}").json()["status"] in (
+            "completed", "failed",
+        ):
+            break
+        time.sleep(1)
+    ck = client.get(f"/api/v1/training/jobs/{job_id}/checkpoints").json()
+    assert ck["checkpoint_dir"] == ckpt_dir
+    assert ck["latest"] == 4
+    assert set(ck["steps"]) >= {2, 4}
+    assert ck["stable"] == 4  # final save is marked stable at completion
+    # Unknown job → 404.
+    assert client.get("/api/v1/training/jobs/nope/checkpoints").status_code == 404
+    # Job without checkpointing → uniform empty schema.
+    r2 = client.post(
+        "/api/v1/training/launch",
+        json={
+            "model_name": "gpt-tiny", "mesh": {"data": 2, "fsdp": 4},
+            "micro_batch_size": 1, "seq_len": 32, "precision": "fp32",
+            "total_steps": 1, "activation_checkpointing": False,
+            "warmup_steps": 1, "dry_run": False,
+        },
+    )
+    jid2 = r2.json()["job_id"]
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if client.get(f"/api/v1/training/jobs/{jid2}").json()["status"] in (
+            "completed", "failed",
+        ):
+            break
+        time.sleep(1)
+    empty = client.get(f"/api/v1/training/jobs/{jid2}/checkpoints").json()
+    assert empty == {"job_id": jid2, "checkpoint_dir": None, "steps": [],
+                     "latest": None, "stable": None}

@@ -148,3 +148,29 @@ def test_moment_dtype_halves_mu_buffer():
     assert adam.nu["layers"]["q"]["kernel"].dtype == jnp.float32
     # Training still converges with reduced-precision first moment.
     assert losses[-1] < losses[0] * 0.7
+
+
+def test_z_loss_stabilizer():
+    """z_loss_coef adds the logit-normaliser penalty to the train loss,
+    identically for chunked and unchunked CE; eval stays pure CE."""
+    ref = run_steps(tiny_config(activation_checkpointing=False), n=2)[2]
+    with_z = run_steps(
+        tiny_config(activation_checkpointing=False, z_loss_coef=1e-3), n=2
+    )[2]
+    assert with_z[0] > ref[0]  # penalty is positive
+    chunked_z = run_steps(
+        tiny_config(activation_checkpointing=False, z_loss_coef=1e-3,
+                    loss_chunk_size=8), n=2
+    )[2]
+    np.testing.assert_allclose(with_z, chunked_z, rtol=1e-6)
+    # Eval excludes the regulariser: pure CE equals the no-z run's eval.
+    prog_z = build_train_program(
+        tiny_config(activation_checkpointing=False, z_loss_coef=1e-3)
+    )
+    prog_ref = build_train_program(tiny_config(activation_checkpointing=False))
+    s_z = prog_z.init(jax.random.PRNGKey(0))
+    s_ref = prog_ref.init(jax.random.PRNGKey(0))
+    b = prog_z.synthetic_batch(0)
+    np.testing.assert_allclose(
+        float(prog_z.eval_step(s_z, b)), float(prog_ref.eval_step(s_ref, b)), rtol=1e-6
+    )

@@ -305,6 +305,9 @@ class TPUTrainConfig(BaseModel):
     # fp32 [B, S, vocab] logits tensor is never fully materialised. None =
     # single unchunked unembed+softmax. Must divide seq_len.
     loss_chunk_size: Optional[int] = Field(default=None, ge=1)
+    # PaLM-style logit-normaliser penalty coef·mean(log Z²) — the standard
+    # bf16 stabiliser; 0 disables. Training loss only (eval stays pure CE).
+    z_loss_coef: float = Field(default=0.0, ge=0)
 
     # Elasticity (reference :78,226-238): TPU slices are fixed-shape, so
     # elasticity means re-launch at a new mesh shape + resume from checkpoint.

@@ -121,13 +121,24 @@ def make_optimizer(cfg: TPUTrainConfig) -> tuple[optax.GradientTransformation, o
     return tx, schedule
 
 
-def lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
-    """Next-token cross-entropy in fp32. logits [B,S,V], tokens [B,S]."""
+def lm_loss(
+    logits: jax.Array, tokens: jax.Array, z_loss_coef: float = 0.0
+) -> jax.Array:
+    """Next-token cross-entropy in fp32. logits [B,S,V], tokens [B,S].
+
+    ``z_loss_coef > 0`` adds the PaLM-style logit-normaliser penalty
+    ``coef·mean(log Z²)``, keeping softmax logits from drifting — the
+    standard bf16-training stabiliser.
+    """
     targets = tokens[:, 1:]
     logits = logits[:, :-1, :].astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
+    logz = jax.nn.logsumexp(logits, axis=-1)  # [B, S-1]
+    logp = logits - logz[..., None]
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
-    return -jnp.mean(ll)
+    loss = -jnp.mean(ll)
+    if z_loss_coef:
+        loss = loss + z_loss_coef * jnp.mean(jnp.square(logz))
+    return loss
 
 
 def chunked_lm_loss(
@@ -136,6 +147,7 @@ def chunked_lm_loss(
     tokens: jax.Array,
     model_cfg: tfm.ModelConfig,
     chunk: int,
+    z_loss_coef: float = 0.0,
 ) -> jax.Array:
     """Next-token cross-entropy computed ``chunk`` sequence positions at a
     time, so the full fp32 [B, S, V] logits tensor is never materialised
@@ -156,17 +168,29 @@ def chunked_lm_loss(
 
     def body(acc, xs):
         hc, tc = xs
-        logp = jax.nn.log_softmax(tfm.unembed(params, hc, model_cfg), axis=-1)
+        logits = tfm.unembed(params, hc, model_cfg)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        logp = logits - logz[..., None]
         mask = tc >= 0
         ll = jnp.take_along_axis(
             logp, jnp.maximum(tc, 0)[..., None].astype(jnp.int32), axis=-1
         ).squeeze(-1)
-        return acc + jnp.sum(ll * mask), None
+        ll_sum, z_sum = acc
+        return (
+            ll_sum + jnp.sum(ll * mask),
+            z_sum + jnp.sum(jnp.square(logz) * mask),
+        ), None
 
-    total, _ = jax.lax.scan(
-        jax.checkpoint(body), jnp.zeros((), jnp.float32), (h, tgt)
+    (ll_total, z_total), _ = jax.lax.scan(
+        jax.checkpoint(body),
+        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (h, tgt),
     )
-    return -total / (B * (S - 1))
+    denom = B * (S - 1)
+    loss = -ll_total / denom
+    if z_loss_coef:
+        loss = loss + z_loss_coef * z_total / denom
+    return loss
 
 
 @dataclass
@@ -383,10 +407,15 @@ def build_train_program(
             lora=lora_params,
             lora_scale=(cfg.lora_alpha / cfg.lora_rank) if use_lora else 1.0,
         )
+        # include_aux gates the training-only regularisers (MoE aux, z-loss)
+        # so eval_step reports pure cross-entropy.
+        z_coef = cfg.z_loss_coef if include_aux else 0.0
         if cfg.loss_chunk_size:
-            loss = chunked_lm_loss(params, hidden, tokens, model_cfg, cfg.loss_chunk_size)
+            loss = chunked_lm_loss(
+                params, hidden, tokens, model_cfg, cfg.loss_chunk_size, z_coef
+            )
         else:
-            loss = lm_loss(tfm.unembed(params, hidden, model_cfg), tokens)
+            loss = lm_loss(tfm.unembed(params, hidden, model_cfg), tokens, z_coef)
         if model_cfg.is_moe and include_aux:
             loss = loss + model_cfg.router_aux_coef * aux
         return loss
@@ -438,13 +467,17 @@ def build_train_program(
                 buf_sharding=buf_sh,
             )
 
+            z_coef = cfg.z_loss_coef if include_aux else 0.0
+
             def loss_body(acc, xs):
                 out, toks = xs
                 if cfg.loss_chunk_size:
                     return acc + chunked_lm_loss(
-                        params, out, toks, model_cfg, cfg.loss_chunk_size
+                        params, out, toks, model_cfg, cfg.loss_chunk_size, z_coef
                     ), None
-                return acc + lm_loss(tfm.unembed(params, out, model_cfg), toks), None
+                return acc + lm_loss(
+                    tfm.unembed(params, out, model_cfg), toks, z_coef
+                ), None
 
             body = jax.checkpoint(loss_body) if cfg.activation_checkpointing else loss_body
             loss_sum, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (outputs, batch))
