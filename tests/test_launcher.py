@@ -73,3 +73,38 @@ def test_real_launch_runs_to_completion():
 def test_presets_exposed():
     p = TPULauncher.presets()
     assert {"125m", "7b", "13b", "70b"} <= set(p)
+
+
+def test_concurrent_job_cap():
+    import time
+
+    from tpu_engine import TPULauncher, TPUTrainConfig
+    from tpu_engine.mesh_runtime import MeshConfig
+
+    cfg = TPUTrainConfig(
+        model_name="gpt-tiny", mesh=MeshConfig(data=2, fsdp=4),
+        micro_batch_size=1, seq_len=32, precision="fp32", total_steps=200,
+        activation_checkpointing=False, warmup_steps=1,
+    )
+    launcher = TPULauncher()  # default cap: 1
+    first = launcher.launch(cfg, dry_run=False, block=False)
+    assert first.status == "launched"
+    job = launcher.get_job(first.job_id)
+    deadline = time.time() + 120
+    while (
+        job.status.value not in ("running", "completed", "failed")
+        and time.time() < deadline
+    ):
+        time.sleep(0.2)
+    assert job.status.value == "running", job.describe()
+    second = launcher.launch(cfg, dry_run=False, block=False)
+    assert second.status == "failed"
+    assert "already running" in second.error
+    # Dry runs are never blocked by the cap.
+    assert launcher.launch(cfg, dry_run=True).status == "dry_run"
+    job.stop()
+    job.join(timeout=120)
+    # Capacity freed → a new launch succeeds.
+    third = launcher.launch(cfg, dry_run=False, max_steps=1, block=True)
+    assert third.status == "launched"
+    assert launcher.get_job(third.job_id).status.value == "completed"

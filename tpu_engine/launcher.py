@@ -57,9 +57,14 @@ class LaunchResult(BaseModel):
 class TPULauncher:
     """In-process launch + job registry (replaces subprocess orchestration)."""
 
-    def __init__(self):
+    def __init__(self, max_concurrent_jobs: int = 1):
+        """``max_concurrent_jobs``: running-job cap for this process's
+        devices (default 1 — concurrent sharded train loops would fight
+        for the same HBM and silently thrash; raise it deliberately for
+        tiny-model multi-tenancy)."""
         self._jobs: dict[str, TrainingJob] = {}
         self._lock = threading.Lock()
+        self.max_concurrent_jobs = max_concurrent_jobs
 
     # -- plan generation (generate_config parity) ----------------------------
 
@@ -130,10 +135,10 @@ class TPULauncher:
                 "effective_batch_size": config.effective_batch_size,
             },
             "optimizer": {
-                "name": "adamw",
+                "name": config.optimizer,
                 "learning_rate": config.learning_rate,
                 "min_lr": config.min_lr,
-                "schedule": "warmup_cosine_decay",
+                "schedule": f"warmup_{config.lr_schedule}",
                 "warmup_steps": config.warmup_steps,
                 "total_steps": config.total_steps,
                 "weight_decay": config.weight_decay,
@@ -202,15 +207,34 @@ class TPULauncher:
                 **base,
             )
         try:
-            job = TrainingJob(
-                job_id=job_id,
-                config=config,
-                data_fn=data_fn,
-                max_steps=max_steps,
-                watch_preemption=watch_preemption,
-                install_signal_handlers=install_signal_handlers,
-            )
             with self._lock:
+                # Admission is atomic with registration: a registered job
+                # counts (status PENDING) even before its thread starts, so
+                # two threaded launches cannot both pass the cap — and a
+                # rejected launch never pays TrainingJob's constructor side
+                # effects (checkpoint dir, Orbax manager).
+                non_terminal = (JobStatus.PENDING, JobStatus.COMPILING, JobStatus.RUNNING)
+                active = sum(
+                    1 for j in self._jobs.values() if j.status in non_terminal
+                )
+                if active >= self.max_concurrent_jobs:
+                    return LaunchResult(
+                        status="failed",
+                        error=(
+                            f"{active} job(s) already running (limit "
+                            f"{self.max_concurrent_jobs}); stop one or raise "
+                            "max_concurrent_jobs"
+                        ),
+                        **base,
+                    )
+                job = TrainingJob(
+                    job_id=job_id,
+                    config=config,
+                    data_fn=data_fn,
+                    max_steps=max_steps,
+                    watch_preemption=watch_preemption,
+                    install_signal_handlers=install_signal_handlers,
+                )
                 self._jobs[job_id] = job
             job.start()
             if block:
