@@ -209,16 +209,56 @@ async def stop_job(request: web.Request) -> web.Response:
     return json_response({"job_id": job_id, "stopped": True})
 
 
+async def delete_job(request: web.Request) -> web.Response:
+    """Drop a terminal job from the registry (disk checkpoints untouched)."""
+    job_id = request.match_info["job_id"]
+    try:
+        found = state.launcher.delete_job(job_id)
+    except ValueError as e:
+        raise ApiError(409, str(e))
+    if not found:
+        raise ApiError(404, f"job '{job_id}' not found")
+    return json_response({"job_id": job_id, "deleted": True})
+
+
 class GenerateRequest(BaseModel):
     """Sample continuations from a job's current weights (no reference
-    analogue — the reference has no inference path at all)."""
+    analogue — the reference has no inference path at all).
 
-    prompt_tokens: list[list[int]] = Field(min_length=1)
+    Provide either ``prompt_tokens`` (raw ids) or ``prompt_text`` +
+    ``tokenizer_json`` (a ``tokenizers`` JSON file on the server; text in,
+    text out)."""
+
+    prompt_tokens: Optional[list[list[int]]] = Field(default=None, min_length=1)
+    prompt_text: Optional[list[str]] = Field(default=None, min_length=1)
+    tokenizer_json: Optional[str] = None
     max_new_tokens: int = Field(default=32, ge=1, le=4096)
     temperature: float = Field(default=0.0, ge=0.0)
     top_k: Optional[int] = Field(default=None, ge=1)
     top_p: Optional[float] = Field(default=None, gt=0.0, le=1.0)
     seed: int = 0
+
+
+_tokenizer_cache: dict[tuple[str, int], Any] = {}
+_TOKENIZER_CACHE_MAX = 8
+
+
+def _load_tokenizer(path: str):
+    """tokenizers.Tokenizer from a JSON file; cached by (path, mtime) so an
+    overwritten file never serves stale encodes, bounded to the last few."""
+    import os
+
+    import tokenizers
+
+    try:
+        key = (path, os.stat(path).st_mtime_ns)
+        if key not in _tokenizer_cache:
+            while len(_tokenizer_cache) >= _TOKENIZER_CACHE_MAX:
+                _tokenizer_cache.pop(next(iter(_tokenizer_cache)))
+            _tokenizer_cache[key] = tokenizers.Tokenizer.from_file(path)
+    except Exception as e:  # stat failure or malformed tokenizer file
+        raise ApiError(422, f"cannot load tokenizer {path!r}: {e}")
+    return _tokenizer_cache[key]
 
 
 async def list_job_checkpoints(request: web.Request) -> web.Response:
@@ -280,16 +320,51 @@ async def generate_from_job(request: web.Request) -> web.Response:
     if job is None:
         raise ApiError(404, f"job '{job_id}' not found")
     req = await parse_body(request, GenerateRequest)
-    try:
-        tokens = await asyncio.to_thread(
-            job.generate_sample,
-            req.prompt_tokens,
+    if (req.prompt_tokens is None) == (req.prompt_text is None):
+        raise ApiError(422, "provide exactly one of prompt_tokens | prompt_text")
+    if req.prompt_text is not None and not req.tokenizer_json:
+        raise ApiError(422, "prompt_text requires tokenizer_json")
+
+    def sample(rows: list[list[int]]) -> list[list[int]]:
+        return job.generate_sample(
+            rows,
             max_new_tokens=req.max_new_tokens,
             temperature=req.temperature,
             top_k=req.top_k,
             top_p=req.top_p,
             seed=req.seed,
         )
+
+    def text_work():
+        # Tokenizer I/O, encode, the single-snapshot ragged sampling, and
+        # decode all run off the event loop.
+        tok = _load_tokenizer(req.tokenizer_json)
+        prompts = [tok.encode(t).ids for t in req.prompt_text]
+        if any(not p for p in prompts):
+            raise ApiError(422, "a prompt tokenised to zero tokens")
+        rows = job.generate_samples_ragged(
+            prompts,
+            max_new_tokens=req.max_new_tokens,
+            temperature=req.temperature,
+            top_k=req.top_k,
+            top_p=req.top_p,
+            seed=req.seed,
+        )
+        texts = [tok.decode(row[len(ids):]) for ids, row in zip(prompts, rows)]
+        return rows, texts
+
+    try:
+        if req.prompt_text is not None:
+            tokens, texts = await asyncio.to_thread(text_work)
+            return json_response(
+                {
+                    "job_id": job_id,
+                    "step": job.current_step,
+                    "tokens": tokens,
+                    "new_text": texts,
+                }
+            )
+        tokens = await asyncio.to_thread(sample, req.prompt_tokens)
     except (RuntimeError, ValueError) as e:
         raise ApiError(422, str(e))
     prompt_len = len(req.prompt_tokens[0])
@@ -314,3 +389,4 @@ def setup(app: web.Application, prefix: str = "/api/v1/training") -> None:
     app.router.add_post(f"{prefix}/jobs/{{job_id}}/generate", generate_from_job)
     app.router.add_post(f"{prefix}/jobs/{{job_id}}/export", export_job_checkpoint)
     app.router.add_get(f"{prefix}/jobs/{{job_id}}/checkpoints", list_job_checkpoints)
+    app.router.add_delete(f"{prefix}/jobs/{{job_id}}", delete_job)

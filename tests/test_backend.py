@@ -460,3 +460,69 @@ def test_job_checkpoints_listing(client, tmp_path_factory):
     empty = client.get(f"/api/v1/training/jobs/{jid2}/checkpoints").json()
     assert empty == {"job_id": jid2, "checkpoint_dir": None, "steps": [],
                      "latest": None, "stable": None}
+
+
+def test_text_generation_and_job_delete(client, tmp_path_factory):
+    tokenizers = __import__("tokenizers")
+    d = tmp_path_factory.mktemp("toktxt")
+    corpus = d / "c.txt"
+    corpus.write_text("\n".join(["the quick brown fox jumps over the lazy dog"] * 100))
+    tok = tokenizers.Tokenizer(tokenizers.models.BPE(unk_token="[UNK]"))
+    tok.pre_tokenizer = tokenizers.pre_tokenizers.Whitespace()
+    tok.train([str(corpus)], tokenizers.trainers.BpeTrainer(
+        vocab_size=120, special_tokens=["[UNK]"]))
+    tok_path = str(d / "tok.json")
+    tok.save(tok_path)
+
+    r = client.post(
+        "/api/v1/training/launch",
+        json={
+            "model_name": "gpt-tiny",
+            "mesh": {"data": 2, "fsdp": 4},
+            "micro_batch_size": 1,
+            "seq_len": 32,
+            "precision": "fp32",
+            "total_steps": 2,
+            "activation_checkpointing": False,
+            "warmup_steps": 1,
+            "dry_run": False,
+        },
+    )
+    job_id = r.json()["job_id"]
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        if client.get(f"/api/v1/training/jobs/{job_id}").json()["status"] in (
+            "completed", "failed",
+        ):
+            break
+        time.sleep(1)
+
+    # Text in → text out (unequal prompt lengths are fine: row-wise decode).
+    g = client.post(
+        f"/api/v1/training/jobs/{job_id}/generate",
+        json={"prompt_text": ["the quick brown", "lazy dog"],
+              "tokenizer_json": tok_path, "max_new_tokens": 4},
+    )
+    assert g.status_code == 200, g.text
+    body = g.json()
+    assert len(body["new_text"]) == 2
+    assert all(isinstance(t, str) for t in body["new_text"])
+    # Exactly one prompt form is required.
+    assert client.post(
+        f"/api/v1/training/jobs/{job_id}/generate",
+        json={"prompt_text": ["x"], "prompt_tokens": [[1]],
+              "tokenizer_json": tok_path},
+    ).status_code == 422
+    assert client.post(
+        f"/api/v1/training/jobs/{job_id}/generate", json={"prompt_text": ["x"]}
+    ).status_code == 422
+    # Out-of-vocab token ids are a 422, not a silent clip.
+    assert client.post(
+        f"/api/v1/training/jobs/{job_id}/generate",
+        json={"prompt_tokens": [[100000]]},
+    ).status_code == 422
+
+    # Terminal job can be deleted; then it is gone.
+    assert client.delete(f"/api/v1/training/jobs/{job_id}").status_code == 200
+    assert client.get(f"/api/v1/training/jobs/{job_id}").status_code == 404
+    assert client.delete(f"/api/v1/training/jobs/{job_id}").status_code == 404

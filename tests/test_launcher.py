@@ -102,6 +102,11 @@ def test_concurrent_job_cap():
     assert "already running" in second.error
     # Dry runs are never blocked by the cap.
     assert launcher.launch(cfg, dry_run=True).status == "dry_run"
+    # A running job cannot be deleted from the registry.
+    import pytest
+
+    with pytest.raises(ValueError, match="stop it"):
+        launcher.delete_job(first.job_id)
     job.stop()
     job.join(timeout=120)
     # Capacity freed → a new launch succeeds.

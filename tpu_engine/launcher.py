@@ -264,6 +264,21 @@ class TPULauncher:
         job.stop()
         return True
 
+    def delete_job(self, job_id: str) -> bool:
+        """Drop a *terminal* job from the registry (bounds registry growth;
+        checkpoints on disk are untouched). Raises ValueError for a job
+        that is still pending/compiling/running — stop it first."""
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is None:
+                return False
+            if job.status in (JobStatus.PENDING, JobStatus.COMPILING, JobStatus.RUNNING):
+                raise ValueError(
+                    f"job '{job_id}' is {job.status.value}; stop it before deleting"
+                )
+            del self._jobs[job_id]
+        return True
+
 
 # ---------------------------------------------------------------------------
 # CLI — `python -m tpu_engine.launcher` (the worker entrypoint used by

@@ -516,6 +516,9 @@ class TrainingJob:
         lens = {len(p) for p in prompt_tokens}
         if len(lens) != 1 or 0 in lens:
             raise ValueError("prompt rows must be non-empty and equal-length")
+        vocab = self.program.model_config.vocab_size
+        if any(t < 0 or t >= vocab for row in prompt_tokens for t in row):
+            raise ValueError(f"prompt token id out of range [0, {vocab})")
         prompt = jnp.asarray(prompt_tokens, jnp.int32)
         with self._state_lock:
             params = self._full_params_locked()
@@ -531,6 +534,50 @@ class TrainingJob:
                 compute_dtype=self.program.config.compute_dtype(),
             )
         return [[int(t) for t in row] for row in jax.device_get(out)]
+
+    def generate_samples_ragged(
+        self,
+        prompt_rows: list[list[int]],
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        seed: int = 0,
+    ) -> list[list[int]]:
+        """Sample continuations for rows of *different* lengths — each row
+        decodes separately (no padding mask exists), but every dispatch
+        happens under one state-lock hold, so all rows sample one
+        consistent weight snapshot even while training runs."""
+        import jax.numpy as jnp
+
+        from tpu_engine.generate import generate
+
+        if self.program is None or self._state is None:
+            raise RuntimeError("job has no initialized state to sample from")
+        vocab = self.program.model_config.vocab_size
+        for row in prompt_rows:
+            if not row:
+                raise ValueError("prompt rows must be non-empty")
+            if any(t < 0 or t >= vocab for t in row):
+                raise ValueError(f"prompt token id out of range [0, {vocab})")
+        outs = []
+        with self._state_lock:
+            params = self._full_params_locked()
+            for i, ids in enumerate(prompt_rows):
+                outs.append(
+                    generate(
+                        params,
+                        jnp.asarray([ids], jnp.int32),
+                        self.program.model_config,
+                        max_new_tokens=max_new_tokens,
+                        rng=jax.random.PRNGKey(seed + i),
+                        temperature=temperature,
+                        top_k=top_k,
+                        top_p=top_p,
+                        compute_dtype=self.program.config.compute_dtype(),
+                    )
+                )
+        return [[int(t) for t in jax.device_get(o)[0]] for o in outs]
 
     def _full_params_locked(self):
         """Full model params for the current step (caller holds _state_lock):
