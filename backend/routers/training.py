@@ -209,6 +209,19 @@ async def stop_job(request: web.Request) -> web.Response:
     return json_response({"job_id": job_id, "stopped": True})
 
 
+async def eval_job_now(request: web.Request) -> web.Response:
+    """Run a held-out evaluation immediately (vs waiting for the interval)."""
+    job_id = request.match_info["job_id"]
+    job = state.launcher.get_job(job_id)
+    if job is None:
+        raise ApiError(404, f"job '{job_id}' not found")
+    try:
+        result = await asyncio.to_thread(job.run_eval_now)
+    except RuntimeError as e:
+        raise ApiError(409, str(e))
+    return json_response({"job_id": job_id, **result})
+
+
 async def delete_job(request: web.Request) -> web.Response:
     """Drop a terminal job from the registry (disk checkpoints untouched)."""
     job_id = request.match_info["job_id"]
@@ -390,3 +403,4 @@ def setup(app: web.Application, prefix: str = "/api/v1/training") -> None:
     app.router.add_post(f"{prefix}/jobs/{{job_id}}/export", export_job_checkpoint)
     app.router.add_get(f"{prefix}/jobs/{{job_id}}/checkpoints", list_job_checkpoints)
     app.router.add_delete(f"{prefix}/jobs/{{job_id}}", delete_job)
+    app.router.add_post(f"{prefix}/jobs/{{job_id}}/eval", eval_job_now)

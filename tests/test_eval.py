@@ -187,3 +187,33 @@ def test_dense_export_while_running_survives_donation():
     job.join(timeout=120)
     assert job.status.value == "completed", job.describe()
     assert exported >= 1
+
+
+def test_run_eval_now():
+    cfg = _cfg(total_steps=200, eval_interval_steps=1000, eval_batches=2)
+    launcher = TPULauncher()
+    res = launcher.launch(cfg, dry_run=False, block=False)
+    job = launcher.get_job(res.job_id)
+    import time
+
+    deadline = time.time() + 120
+    while job.status.value not in ("running", "completed") and time.time() < deadline:
+        time.sleep(0.2)
+    out = job.run_eval_now()  # on demand, far before the interval fires
+    assert 0 < out["loss"] < 20 and out["perplexity"] > 1
+    assert job.eval_history and job.eval_history[-1][1] == out["loss"]
+    job.stop()
+    job.join(timeout=120)
+    # Without an eval source, on-demand eval is a clear error.
+    cfg2 = _cfg(total_steps=2)
+    res2 = launcher.launch(cfg2, dry_run=False, block=True)
+    import pytest
+
+    with pytest.raises(RuntimeError, match="eval data source"):
+        launcher.get_job(res2.job_id).run_eval_now()
+    # Before the train loop starts, the error says retry — not a config nag.
+    from tpu_engine.supervisor import TrainingJob
+
+    unstarted = TrainingJob(job_id="x", config=_cfg(eval_interval_steps=5))
+    with pytest.raises(RuntimeError, match="retry once it is running"):
+        unstarted.run_eval_now()

@@ -403,10 +403,37 @@ class TrainingJob:
                 except Exception:
                     pass
 
-    def _run_eval(self, step: int) -> None:
-        """Average ``eval_batches`` held-out losses; record in history."""
+    def run_eval_now(self) -> dict[str, float]:
+        """On-demand held-out evaluation at the current step (requires
+        ``eval_interval_steps`` so an eval data source exists). Returns
+        {step, loss, perplexity} and records it in the history."""
+        if self.program is None or self._state is None:
+            raise RuntimeError(
+                "job has not started its train loop yet (or failed during "
+                "compile) — retry once it is running"
+            )
+        if self._eval_data_fn is None:
+            raise RuntimeError(
+                "job has no eval data source (set eval_interval_steps)"
+            )
+        try:
+            step, loss = self._run_eval()
+        except Exception as e:  # e.g. file-backed source closed after finish
+            raise RuntimeError(f"eval failed: {type(e).__name__}: {e}")
+        return {"step": step, "loss": loss, "perplexity": _perplexity(loss)}
+
+    def _run_eval(self, step: Optional[int] = None) -> tuple[int, float]:
+        """Average ``eval_batches`` held-out losses; record in history.
+
+        ``step=None`` (the on-demand path) reads the current step under the
+        state lock, so the recorded step matches the state evaluated even
+        while training advances. Returns ``(step, loss)`` — callers must
+        not re-read shared history, which concurrent evals/rollbacks mutate.
+        """
         prog = self.program
         with self._state_lock:
+            if step is None:
+                step = self.current_step
             # Dispatch all eval steps before the single host sync, so device
             # execution of batch k overlaps dispatch of batch k+1.
             device_losses = [
@@ -421,6 +448,7 @@ class TrainingJob:
             "job %s: eval @ step %d — loss %.4f ppl %.2f",
             self.job_id, step, loss, _perplexity(loss),
         )
+        return step, loss
 
     def _log_metrics(self, **fields) -> None:
         """One JSON line to the job's metrics log (no-op when unconfigured)."""
