@@ -33,13 +33,20 @@ async def root(request: web.Request) -> web.Response:
             "features": [
                 "TPU fleet telemetry and health-gated device selection",
                 "ZeRO-stage (0-3) sharded training launch on a jax.sharding.Mesh",
-                "tensor ('model'), pipeline ('pipe'), sequence/ring-attention "
-                "('sequence'), and expert parallelism on one mesh",
+                "tensor ('model'), pipeline ('pipe'), sequence (ring or "
+                "all-to-all 'ulysses'), and expert parallelism on one mesh; "
+                "multislice DCN data parallelism (dcn_data)",
+                "LoRA fine-tuning over frozen HF base checkpoints; "
+                "bidirectional HF Llama checkpoint conversion and export",
+                "KV-cache generation (token or text in/out) from live jobs",
+                "held-out evaluation (interval and on-demand) with perplexity",
                 "loss-spike / divergence / plateau / grad-norm / LR monitoring",
-                "Orbax checkpointing with stable-pointer rollback and auto-resume",
+                "Orbax checkpointing with stable-pointer rollback, auto-resume, "
+                "and elastic cross-mesh restore",
                 "preemption watcher with emergency checkpoint",
                 "real ICI topology introspection",
-                "jax.profiler trace capture and per-step wall-clock breakdown",
+                "jax.profiler trace capture, per-step wall-clock breakdown, "
+                "and structured JSONL metrics logs",
             ],
             "endpoints": {
                 "tpu": "/api/v1/tpu",
