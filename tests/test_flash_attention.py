@@ -43,8 +43,11 @@ def test_flash_gqa_forward():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
-def test_flash_backward_matches_xla():
-    q, k, v = _rand_qkv(jax.random.PRNGKey(2), S=128)
+# S=2048 exercises the backward's bb=min(block, 512) re-tiling (block=1024)
+# and the >2-block DMA-clamp index maps; smaller B/H keep interpret mode fast.
+@pytest.mark.parametrize("S,B,H", [(128, 2, 4), (512, 2, 4), (2048, 1, 2)])
+def test_flash_backward_matches_xla(S, B, H):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(2), S=S, B=B, H=H, KV=H)
 
     def loss_flash(q, k, v):
         return jnp.sum(flash_mha(q, k, v, interpret=True) ** 2)
@@ -56,6 +59,26 @@ def test_flash_backward_matches_xla():
     gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-4)
+
+
+def test_flash_backward_bf16():
+    """bf16 is the training dtype: gradients must come back bf16 and agree
+    with the XLA path at bf16 tolerances."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(3), S=128, dtype=jnp.bfloat16)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_mha(q, k, v, interpret=True).astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha(q, k, v, force_xla=True).astype(jnp.float32) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        assert a.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(a, dtype=np.float32), np.asarray(b, dtype=np.float32),
+            atol=0.15, rtol=0.1)
 
 
 def test_unsupported_shapes_raise_and_dispatcher_falls_back():

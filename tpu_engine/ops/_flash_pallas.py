@@ -9,10 +9,13 @@ O(BLOCK_Q · D + BLOCK_K · D + BLOCK_Q · BLOCK_K) regardless of sequence
 length — the S×S score matrix is never materialised, and neither is a full
 [S, D] K/V copy (the ``_xla_mha`` fallback materialises S×S).
 
-Backward: custom_vjp. The forward saves the log-sum-exp rows; the backward
-reconstructs attention probabilities block-by-block in plain JAX
-(``lax.scan`` over K/V blocks) — memory O(S · BLOCK_K), XLA-fused, and it
-avoids a second Pallas kernel while keeping the flash memory property.
+Backward: custom_vjp over two Pallas kernels. The forward saves the
+log-sum-exp rows; the backward reconstructs attention probabilities
+block-by-block from (q, k, lse) and never materialises anything larger
+than a [BLOCK, BLOCK] tile. A dQ kernel iterates K-blocks innermost
+(accumulating dq in VMEM scratch) and a dK/dV kernel iterates Q-blocks
+innermost — both skip the causally-masked block pairs entirely (compute
+*and* DMA), so the backward does half the work of a dense S×S pass.
 
 Layout: q/k/v [B, S, H, D] (GQA expanded by the caller, ``flash_attention.mha``).
 """
@@ -132,43 +135,162 @@ def _flash_fwd(q, k, v, block: int, interpret: bool):
 
 
 # ---------------------------------------------------------------------------
-# Backward (blockwise JAX, flash memory profile)
+# Backward kernels
 # ---------------------------------------------------------------------------
 
 
-def _flash_bwd(block: int, res, do):
+def _recompute_p(q, k, lse_row, q_idx, k_idx, block_q, block_k, scale):
+    """Rebuild one [BQ, BK] tile of attention probabilities from saved lse."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    q_pos = q_idx * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    k_pos = k_idx * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    mask = q_pos >= k_pos
+    return jnp.where(mask, jnp.exp(s - lse_row[:, None]), 0.0)
+
+
+def _p_ds_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               q_idx, k_idx, block_q, block_k, scale):
+    """Shared gradient-tile math for both backward kernels: load the four
+    blocks and return (p, ds, q, k, do) — ds = p ∘ (dO·Vᵀ − Δ) · scale."""
+    q = q_ref[0].astype(jnp.float32)            # [BQ, D]
+    k_blk = k_ref[0].astype(jnp.float32)        # [BK, D]
+    v_blk = v_ref[0].astype(jnp.float32)        # [BK, D]
+    do = do_ref[0].astype(jnp.float32)          # [BQ, D]
+    p = _recompute_p(q, k_blk, lse_ref[0, 0], q_idx, k_idx,
+                     block_q, block_k, scale)
+    dp = jax.lax.dot_general(
+        do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )                                           # [BQ, BK]
+    ds = p * (dp - delta_ref[0, 0][:, None]) * scale
+    return p, ds, q, k_blk, do
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, block_q: int, block_k: int, scale: float):
+    q_idx = pl.program_id(1)
+    k_idx = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(k_idx == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    @pl.when(k_idx <= q_idx)
+    def _compute():
+        _, ds, _, k_blk, _ = _p_ds_tile(q_ref, k_ref, v_ref, do_ref,
+                                        lse_ref, delta_ref, q_idx, k_idx,
+                                        block_q, block_k, scale)
+        dq_scr[...] += jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(k_idx == n_k - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *,
+                    block_q: int, block_k: int, scale: float):
+    k_idx = pl.program_id(1)
+    q_idx = pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    @pl.when(q_idx >= k_idx)
+    def _compute():
+        p, ds, q, _, do = _p_ds_tile(q_ref, k_ref, v_ref, do_ref,
+                                     lse_ref, delta_ref, q_idx, k_idx,
+                                     block_q, block_k, scale)
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )                                           # [BK, D]
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(q_idx == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd(block: int, interpret: bool, res, do):
     q, k, v, o, lse = res  # q/k/v/o: [BH, S, D]; lse: [BH, S]
     BH, S, D = q.shape
     scale = 1.0 / (D ** 0.5)
+    # The backward holds ~4 [BQ, BK] f32 tiles live at once (s/p, dp, ds)
+    # plus four input blocks and two accumulators — cap the tile so the
+    # whole working set stays comfortably inside VMEM.
+    bb = min(block, 512)
+    n_blk = S // bb
 
-    q32 = q.astype(jnp.float32)
     do32 = do.astype(jnp.float32)
     # D_i = rowsum(dO ∘ O) — the softmax-jacobian diagonal term.
     delta = jnp.sum(do32 * o.astype(jnp.float32), axis=-1)  # [BH, S]
-    q_pos = jnp.arange(S)
+    lse3 = lse.reshape(BH, 1, S)
+    delta3 = delta.reshape(BH, 1, S)
 
-    def kv_block(carry, j):
-        dq_acc = carry
-        k_blk = lax.dynamic_slice_in_dim(k, j * block, block, axis=1).astype(jnp.float32)
-        v_blk = lax.dynamic_slice_in_dim(v, j * block, block, axis=1).astype(jnp.float32)
-        s = jnp.einsum("zqd,zkd->zqk", q32, k_blk) * scale  # [BH, S, BK]
-        k_pos = j * block + jnp.arange(block)
-        mask = q_pos[:, None] >= k_pos[None, :]
-        s = jnp.where(mask[None], s, _NEG_INF)
-        p = jnp.exp(s - lse[..., None])  # [BH, S, BK]
-        p = jnp.where(mask[None], p, 0.0)
-        dv = jnp.einsum("zqk,zqd->zkd", p, do32)
-        dp = jnp.einsum("zqd,zkd->zqk", do32, v_blk)
-        ds = p * (dp - delta[..., None]) * scale
-        dk = jnp.einsum("zqk,zqd->zkd", ds, q32)
-        dq_acc = dq_acc + jnp.einsum("zqk,zkd->zqd", ds, k_blk)
-        return dq_acc, (dk, dv)
+    qkv_spec = pl.BlockSpec((1, bb, D), lambda bh, i, j: (bh, i, 0))
+    row_spec = pl.BlockSpec((1, 1, bb), lambda bh, i, j: (bh, 0, i))
 
-    dq0 = jnp.zeros((BH, S, D), jnp.float32)
-    dq, (dk_blocks, dv_blocks) = lax.scan(kv_block, dq0, jnp.arange(S // block))
-    dk = jnp.moveaxis(dk_blocks, 0, 1).reshape(BH, S, D)
-    dv = jnp.moveaxis(dv_blocks, 0, 1).reshape(BH, S, D)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    # The jnp.minimum / jnp.maximum index maps below clamp the moving
+    # operand's index on causally-skipped iterations to the last block
+    # actually read, so the pipeline elides the DMA.
+    dq = pl.pallas_call(
+        partial(_bwd_dq_kernel, block_q=bb, block_k=bb, scale=scale),
+        grid=(BH, n_blk, n_blk),  # (bh, q-block, k-block innermost)
+        in_specs=[
+            qkv_spec,  # q
+            pl.BlockSpec((1, bb, D), lambda bh, i, j: (bh, jnp.minimum(i, j), 0)),  # k
+            pl.BlockSpec((1, bb, D), lambda bh, i, j: (bh, jnp.minimum(i, j), 0)),  # v
+            qkv_spec,  # do
+            row_spec,  # lse
+            row_spec,  # delta
+        ],
+        out_specs=qkv_spec,
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bb, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse3, delta3)
+
+    moving = pl.BlockSpec((1, bb, D), lambda bh, i, j: (bh, jnp.maximum(i, j), 0))
+    moving_row = pl.BlockSpec((1, 1, bb), lambda bh, i, j: (bh, 0, jnp.maximum(i, j)))
+    dk, dv = pl.pallas_call(
+        partial(_bwd_dkv_kernel, block_q=bb, block_k=bb, scale=scale),
+        grid=(BH, n_blk, n_blk),  # (bh, k-block, q-block innermost)
+        in_specs=[
+            qkv_spec,    # k
+            qkv_spec,    # v
+            moving,      # q
+            moving,      # do
+            moving_row,  # lse
+            moving_row,  # delta
+        ],
+        out_specs=[qkv_spec, qkv_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bb, D), jnp.float32),
+            pltpu.VMEM((bb, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(k, v, q, do, lse3, delta3)
+    return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------
@@ -188,7 +310,7 @@ def _flash_bhsd_fwd(q, k, v, block, interpret):
 
 
 def _flash_bhsd_bwd(block, interpret, res, do):
-    return _flash_bwd(block, res, do)
+    return _flash_bwd(block, interpret, res, do)
 
 
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
