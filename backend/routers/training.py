@@ -44,6 +44,10 @@ class TrainingLaunchRequest(BaseModel):
     grad_clip_norm: float = Field(default=1.0, gt=0)
     optimizer_offload: str = "none"
     attention_impl: Literal["auto", "xla", "flash", "ring", "ulysses"] = "auto"
+    sliding_window: Optional[int] = Field(
+        default=None, ge=0,
+        description="sliding-window attention: None = model preset's window, "
+        "0 = full causal, N = window of N keys")
     activation_checkpointing: bool = True
     dataset_path: Optional[str] = None  # flat binary token file; None = synthetic
     dataset_dtype: Literal["uint16", "int32"] = "uint16"
@@ -109,6 +113,7 @@ def _to_config(req: TrainingLaunchRequest) -> TPUTrainConfig:
             grad_clip_norm=req.grad_clip_norm,
             optimizer_offload=OffloadDevice(req.optimizer_offload),
             attention_impl=req.attention_impl,
+            sliding_window=req.sliding_window,
             activation_checkpointing=req.activation_checkpointing,
             dataset_path=req.dataset_path,
             dataset_dtype=req.dataset_dtype,

@@ -149,3 +149,59 @@ def test_moe_export_rejected():
 
     with pytest.raises(ValueError, match="MoE"):
         hf_config_from(tfm.MODEL_CONFIGS["moe-tiny"])
+
+
+# ---------------------------------------------------------------------------
+# Mistral (sliding-window) family
+# ---------------------------------------------------------------------------
+
+
+def _tiny_mistral(window=8, seed=0):
+    from transformers import MistralConfig, MistralForCausalLM
+
+    torch.manual_seed(seed)
+    hf_cfg = MistralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5, rope_theta=10_000.0,
+        sliding_window=window, tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    return hf_cfg, MistralForCausalLM(hf_cfg).eval()
+
+
+def test_mistral_to_ours_logit_parity():
+    """Sliding-window parity: seq 32 > window 8, so the window mask must
+    actually engage for logits to agree."""
+    hf_cfg, model = _tiny_mistral(window=8)
+    cfg = config_from_hf(hf_cfg)
+    assert cfg.sliding_window == 8 and cfg.n_kv_heads == 2
+    params = from_hf_llama(model.state_dict(), cfg)
+
+    tokens = np.random.default_rng(3).integers(0, 256, (2, 32))
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(
+        tfm.forward(params, jnp.asarray(tokens, jnp.int32), cfg, compute_dtype=jnp.float32)
+    )
+    np.testing.assert_allclose(ours, hf_logits, atol=2e-3, rtol=2e-3)
+
+
+def test_mistral_export_roundtrip(tmp_path):
+    from transformers import MistralForCausalLM
+
+    from tpu_engine.models.convert import save_hf_checkpoint
+
+    cfg = tfm.MODEL_CONFIGS["gpt-tiny"].with_(sliding_window=16, n_kv_heads=2)
+    params = tfm.init_params(jax.random.PRNGKey(7), cfg)
+    out = save_hf_checkpoint(params, cfg, str(tmp_path / "mistral-export"))
+    reloaded = MistralForCausalLM.from_pretrained(
+        out, attn_implementation="eager").eval()
+    assert reloaded.config.sliding_window == 16
+    tokens = np.random.default_rng(4).integers(0, cfg.vocab_size, (1, 48))
+    with torch.no_grad():
+        hf_logits = reloaded(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(
+        tfm.forward(params, jnp.asarray(tokens, jnp.int32), cfg, compute_dtype=jnp.float32)
+    )
+    np.testing.assert_allclose(ours, hf_logits, atol=2e-3, rtol=2e-3)

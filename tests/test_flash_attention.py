@@ -99,3 +99,79 @@ def test_flash_under_jit_bf16():
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2, rtol=3e-2
     )
+
+
+# ---------------------------------------------------------------------------
+# Sliding-window attention
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("S,W", [(128, 32), (128, 64), (256, 100), (256, 65)])
+def test_flash_window_forward_matches_xla(S, W):
+    """Windowed flash vs the XLA mask, incl. non-block-aligned windows."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(4), S=S)
+    ref = mha(q, k, v, force_xla=True, window=W)
+    out = flash_mha(q, k, v, interpret=True, window=W)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_window_ge_seq_is_plain_causal():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(5), S=128)
+    full = flash_mha(q, k, v, interpret=True)
+    windowed = flash_mha(q, k, v, interpret=True, window=128)
+    np.testing.assert_allclose(np.asarray(windowed), np.asarray(full), atol=0, rtol=0)
+
+
+@pytest.mark.parametrize("S,W", [(128, 32), (256, 100)])
+def test_flash_window_backward_matches_xla(S, W):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(6), S=S)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_mha(q, k, v, interpret=True, window=W) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha(q, k, v, force_xla=True, window=W) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-4)
+
+
+def test_xla_window_mask_semantics():
+    """Each query sees exactly the trailing W keys (inclusive of itself)."""
+    S, W = 8, 3
+    q = jnp.zeros((1, S, 1, 64), jnp.float32)
+    # v rows are one-hot position markers; uniform scores => output averages
+    # exactly the visible rows.
+    k = jnp.zeros((1, S, 1, 64), jnp.float32)
+    v = jnp.eye(S, 64)[None, :, None, :]
+    out = mha(q, k, v, force_xla=True, window=W)[0, :, 0, :]
+    for t in range(S):
+        lo = max(0, t - W + 1)
+        expect = np.zeros(64)
+        expect[lo:t + 1] = 1.0 / (t - lo + 1)
+        np.testing.assert_allclose(np.asarray(out[t]), expect, atol=1e-6)
+
+
+def test_window_validation():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(7), S=64)
+    with pytest.raises(ValueError, match="causal"):
+        mha(q, k, v, causal=False, window=16)
+    with pytest.raises(ValueError, match=">= 0"):
+        mha(q, k, v, force_xla=True, window=-1)
+
+
+def test_window_narrows_inner_grid():
+    """The windowed kernels shrink the grid itself — O(S·W) programs, not
+    O(S²) programs with skipped bodies."""
+    from tpu_engine.ops._flash_pallas import _n_kv_blocks, _n_q_blocks
+
+    # mistral-7b shapes: S=32768, block 512 (bwd), W=4096
+    assert _n_kv_blocks(64, 512, 4096) == 9   # vs 64 unwindowed
+    assert _n_q_blocks(64, 512, 4096) == 9
+    # window inside one block
+    assert _n_kv_blocks(8, 64, 1) == 1
+    assert _n_kv_blocks(8, 64, 64) == 2
+    # no window: full inner dim
+    assert _n_kv_blocks(8, 64, 0) == 8 and _n_q_blocks(8, 64, 0) == 8

@@ -142,3 +142,27 @@ def test_sampling_param_sweep_does_not_recompile():
     generate(params, tokens, cfg, max_new_tokens=3, temperature=0.2,
              top_p=0.95, compute_dtype=jnp.float32)
     assert _generate_jit._cache_size() == after_first > base
+
+
+def test_sliding_window_decode_matches_forward():
+    """Windowed decode must match the windowed training forward position-
+    for-position — seq 24 > window 6, so old keys really drop out."""
+    cfg, params, tokens = _setup(S=24)
+    cfg = cfg.with_(sliding_window=6)
+    B, S = tokens.shape
+    full = tfm.forward(params, tokens, cfg, compute_dtype=jnp.float32)
+
+    cache = init_cache(cfg, B, S, dtype=jnp.float32)
+    logits, cache = forward_with_cache(
+        params, tokens[:, :4], cache, cfg, compute_dtype=jnp.float32
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(full[:, :4]), atol=2e-4, rtol=2e-4
+    )
+    for t in range(4, S):
+        logits, cache = forward_with_cache(
+            params, tokens[:, t : t + 1], cache, cfg, compute_dtype=jnp.float32
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits[:, 0]), np.asarray(full[:, t]), atol=2e-4, rtol=2e-4
+        )

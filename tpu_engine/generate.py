@@ -137,8 +137,12 @@ def _decode_block(x, layer_params, k_cache, v_cache, length, positions, cfg: Mod
     ) * scale
     # Key m is visible to query t iff m ≤ its global position (causal) —
     # positions beyond length+T hold zeros and are masked the same way.
+    # Sliding-window models additionally hide keys older than the window,
+    # matching the training-time mask.
     key_pos = jnp.arange(M)
     mask = key_pos[None, :] <= positions[:, :, None]  # [B, T, M]
+    if cfg.sliding_window:
+        mask &= key_pos[None, :] > positions[:, :, None] - cfg.sliding_window
     scores = jnp.where(mask[:, None, :, :], scores, _NEG_INF)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
     attn = jnp.einsum("bhtm,bmhd->bthd", probs, vc).reshape(B, T, H * HD)

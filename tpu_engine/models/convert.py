@@ -70,6 +70,9 @@ def config_from_hf(hf_config: Any) -> ModelConfig:
         max_seq_len=getattr(hf_config, "max_position_embeddings", 2048),
         rope_theta=getattr(hf_config, "rope_theta", 10_000.0),
         norm_eps=getattr(hf_config, "rms_norm_eps", 1e-5),
+        # MistralConfig carries sliding_window (None = disabled); Llama has
+        # no such attribute. Tensor layouts are otherwise identical.
+        sliding_window=getattr(hf_config, "sliding_window", None) or 0,
     )
 
 
@@ -139,9 +142,7 @@ def hf_config_from(cfg: ModelConfig) -> Any:
     describing this model (dense Llama-style models only)."""
     if cfg.is_moe:
         raise ValueError("MoE models have no LlamaForCausalLM representation")
-    from transformers import LlamaConfig
-
-    return LlamaConfig(
+    common = dict(
         vocab_size=cfg.vocab_size,
         hidden_size=cfg.d_model,
         intermediate_size=cfg.d_ff,
@@ -151,23 +152,33 @@ def hf_config_from(cfg: ModelConfig) -> Any:
         max_position_embeddings=cfg.max_seq_len,
         rope_theta=cfg.rope_theta,
         rms_norm_eps=cfg.norm_eps,
-        attention_bias=False,
         tie_word_embeddings=False,
     )
+    if cfg.sliding_window:
+        # Sliding-window models round-trip as Mistral (same tensor layout,
+        # windowed attention carried in the config).
+        from transformers import MistralConfig
+
+        return MistralConfig(sliding_window=cfg.sliding_window, **common)
+    from transformers import LlamaConfig
+
+    return LlamaConfig(attention_bias=False, **common)
 
 
 def save_hf_checkpoint(params: dict[str, Any], cfg: ModelConfig, out_dir: str) -> str:
-    """Write ``params`` as a loadable HF ``LlamaForCausalLM`` checkpoint
-    directory (config.json + safetensors). Returns ``out_dir``."""
+    """Write ``params`` as a loadable HF checkpoint directory (config.json +
+    safetensors) — ``LlamaForCausalLM``, or ``MistralForCausalLM`` for
+    sliding-window models. Returns ``out_dir``."""
     import torch
-    from transformers import LlamaForCausalLM
+    from transformers import LlamaForCausalLM, MistralForCausalLM
 
     hf_cfg = hf_config_from(cfg)
+    model_cls = MistralForCausalLM if cfg.sliding_window else LlamaForCausalLM
     sd = {k: torch.tensor(v) for k, v in to_hf_llama(params, cfg).items()}
     # meta device: never allocate (or randomly initialise) a second full
     # weight copy just to overwrite it — assign=True adopts our tensors.
     with torch.device("meta"):
-        model = LlamaForCausalLM(hf_cfg)
+        model = model_cls(hf_cfg)
     missing, unexpected = model.load_state_dict(sd, strict=False, assign=True)
     if unexpected or any("rotary" not in m and "inv_freq" not in m for m in missing):
         raise ValueError(f"export mismatch: missing={missing} unexpected={unexpected}")

@@ -47,6 +47,10 @@ class ModelConfig:
     norm_eps: float = 1e-5
     # Attention implementation: "xla" (fallback) or "flash" (Pallas kernel).
     attention_impl: str = "xla"
+    # Sliding-window (Mistral-style) attention: each query sees only the
+    # trailing `sliding_window` keys. 0 = full causal. The flash kernel
+    # skips out-of-window blocks entirely (O(S·W) cost); the XLA path masks.
+    sliding_window: int = 0
     # Mixture-of-Experts (0 experts = dense MLP). Experts ride the "expert"
     # logical axis → "model" mesh axis (expert parallelism). Routing is
     # top-k with a fixed per-expert capacity (static shapes for XLA).
@@ -98,6 +102,11 @@ MODEL_CONFIGS: dict[str, ModelConfig] = {
     "llama-70b": ModelConfig(
         name="llama-70b", vocab_size=32_000, d_model=8192, n_layers=80, n_heads=64,
         n_kv_heads=8, d_ff=28_672, max_seq_len=4096,
+    ),
+    # Sliding-window (Mistral) family: GQA + windowed attention.
+    "mistral-7b": ModelConfig(
+        name="mistral-7b", vocab_size=32_000, d_model=4096, n_layers=32, n_heads=32,
+        n_kv_heads=8, d_ff=14_336, max_seq_len=32_768, sliding_window=4096,
     ),
     # Mixture-of-Experts family (expert parallelism over the "model" axis).
     "moe-tiny": ModelConfig(
@@ -204,9 +213,12 @@ def active_param_count(cfg: ModelConfig) -> int:
 
 def train_flops_per_token(cfg: ModelConfig, seq_len: int) -> float:
     """Approximate training FLOPs/token: 6·N_active_matmul + attention term
-    (12·L·D·S accounting fwd+bwd of the S×S score/value matmuls)."""
+    (12·L·D·S accounting fwd+bwd of the S×S score/value matmuls). With
+    sliding-window attention each query attends at most ``sliding_window``
+    keys, so the attention term uses min(S, W) — keeping MFU honest."""
     n = active_param_count(cfg) - cfg.vocab_size * cfg.d_model  # embedding lookup is not a matmul
-    return 6.0 * n + 12.0 * cfg.n_layers * cfg.d_model * seq_len
+    attn_ctx = min(seq_len, cfg.sliding_window) if cfg.sliding_window else seq_len
+    return 6.0 * n + 12.0 * cfg.n_layers * cfg.d_model * attn_ctx
 
 
 # ---------------------------------------------------------------------------
@@ -233,7 +245,7 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
 
 
-def _attention(q, k, v, impl: str, mesh=None):
+def _attention(q, k, v, impl: str, mesh=None, window: int = 0):
     """Causal attention dispatch:
 
     - ``"ring"`` — sequence-parallel ring attention over the mesh's
@@ -242,22 +254,30 @@ def _attention(q, k, v, impl: str, mesh=None):
       shard swap, ``tpu_engine/parallel/ulysses_attention.py``);
     - ``"flash"`` — Pallas TPU flash kernel (``tpu_engine/ops``);
     - ``"xla"``  — plain XLA attention (fallback / reference semantics).
-    """
-    if impl == "ring":
-        if mesh is None:
-            raise ValueError("attention_impl='ring' requires a mesh")
-        from tpu_engine.parallel.ring_attention import ring_mha
 
-        return ring_mha(q, k, v, mesh=mesh, causal=True)
-    if impl == "ulysses":
+    ``window > 0`` = sliding-window attention (flash/xla paths only; the
+    sequence-parallel strategies are full-context by construction).
+    """
+    if impl in ("ring", "ulysses"):
+        if window:
+            raise ValueError(
+                f"sliding_window is not supported with attention_impl={impl!r}; "
+                "use 'flash' or 'xla' (a windowed model has no use for "
+                "full-sequence context parallelism)"
+            )
         if mesh is None:
-            raise ValueError("attention_impl='ulysses' requires a mesh")
+            raise ValueError(f"attention_impl={impl!r} requires a mesh")
+        if impl == "ring":
+            from tpu_engine.parallel.ring_attention import ring_mha
+
+            return ring_mha(q, k, v, mesh=mesh, causal=True)
         from tpu_engine.parallel.ulysses_attention import ulysses_mha
 
         return ulysses_mha(q, k, v, mesh=mesh, causal=True)
     from tpu_engine.ops import flash_attention  # lazy: avoids import cycles
 
-    return flash_attention.mha(q, k, v, causal=True, force_xla=(impl != "flash"))
+    return flash_attention.mha(q, k, v, causal=True,
+                               force_xla=(impl != "flash"), window=window)
 
 
 def _moe_mlp(h, layer_params, cfg: ModelConfig):
@@ -369,7 +389,8 @@ def _block(
     q = tag(_rope(q, positions, cfg.rope_theta), "q")
     k = tag(_rope(k, positions, cfg.rope_theta), "k")
     v = tag(v, "v")
-    attn = _attention(q, k, v, cfg.attention_impl, mesh=mesh)
+    attn = _attention(q, k, v, cfg.attention_impl, mesh=mesh,
+                      window=cfg.sliding_window)
     attn = tag(attn.reshape(B, S, H * HD), "attn_out")
     x = x + _proj(attn, layer_params["o"]["kernel"], lora.get("o"), lora_scale)
 

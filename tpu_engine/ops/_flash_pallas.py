@@ -51,21 +51,66 @@ def _pick_block(s: int) -> int:
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
-                block_q: int, block_k: int, scale: float):
-    q_idx = pl.program_id(1)
-    k_idx = pl.program_id(2)
-    n_k = pl.num_programs(2)
+def _visible(q_pos, k_pos, window: int):
+    """The causal (optionally sliding-window) visibility predicate."""
+    vis = q_pos >= k_pos
+    if window:
+        vis &= q_pos - k_pos < window
+    return vis
 
-    @pl.when(k_idx == 0)
+
+def _lo_block(q_idx, block: int, window: int):
+    """Lowest K-block index visible to Q-block ``q_idx`` under ``window``
+    (floor division handles the negative early-sequence case)."""
+    return (q_idx * block - (window - 1)) // block
+
+
+def _n_kv_blocks(n_blk: int, block: int, window: int) -> int:
+    """Inner-grid length for Q-major (fwd / dQ) kernels: with a window only
+    ceil((W-1)/block)+1 K-blocks can be visible to any Q-block, so the grid
+    itself shrinks — windowed cost is O(S·W) in *programs*, not just in
+    skipped compute."""
+    if not window:
+        return n_blk
+    return min(n_blk, (window + block - 2) // block + 1)
+
+
+def _n_q_blocks(n_blk: int, block: int, window: int) -> int:
+    """Inner-grid length for the K-major (dK/dV) kernel: at most
+    (block+W-2)//block + 1 Q-blocks can see any K-block."""
+    if not window:
+        return n_blk
+    return min(n_blk, (block + window - 2) // block + 1)
+
+
+def _k_index(q_idx, j, block: int, window: int):
+    """Map the inner grid coordinate ``j`` to an actual K-block index. With
+    a window the inner grid is shortened and offset to start at the lowest
+    visible block; without one it is the K-block index itself."""
+    if not window:
+        return j
+    return jnp.maximum(_lo_block(q_idx, block, window), 0) + j
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
+                block_q: int, block_k: int, scale: float, window: int):
+    q_idx = pl.program_id(1)
+    j = pl.program_id(2)
+    n_j = pl.num_programs(2)
+    k_idx = _k_index(q_idx, j, block_q, window)
+
+    @pl.when(j == 0)
     def _init():
         m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     # Causal with BLOCK_Q == BLOCK_K: only K blocks with k_idx <= q_idx
-    # contribute; later iterations are skipped entirely.
-    @pl.when(k_idx <= q_idx)
+    # contribute; the rest are skipped entirely. (The windowed lower bound
+    # is built into the grid offset — k_idx never starts below it.)
+    active = k_idx <= q_idx
+
+    @pl.when(active)
     def _compute():
         q = q_ref[0].astype(jnp.float32)        # [BQ, D]
         k_blk = k_ref[0].astype(jnp.float32)    # [BK, D]
@@ -75,7 +120,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         ) * scale  # [BQ, BK]
         q_pos = q_idx * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
         k_pos = k_idx * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        s = jnp.where(_visible(q_pos, k_pos, window), s, _NEG_INF)
         m = m_scr[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
@@ -87,29 +132,38 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
             p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    @pl.when(k_idx == n_k - 1)
+    @pl.when(j == n_j - 1)
     def _finalize():
         l_safe = jnp.maximum(l_scr[...], 1e-30)
         o_ref[0] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
         lse_ref[0, 0] = m_scr[...] + jnp.log(l_safe)
 
 
-def _flash_fwd(q, k, v, block: int, interpret: bool):
+def _kv_clamp(block: int, window: int):
+    """Index map for K/V blocks in Q-major grids: map the inner coordinate
+    to the actual K-block, clamped into the active range so causally-masked
+    iterations repeat an index the pipeline has already fetched — no
+    bandwidth is spent on blocks the kernel won't read."""
+    return lambda bh, i, j: (bh, jnp.minimum(_k_index(i, j, block, window), i), 0)
+
+
+def _flash_fwd(q, k, v, block: int, interpret: bool, window: int):
     """q/k/v: [BH, S, D] → (o [BH, S, D], lse [BH, S])."""
     BH, S, D = q.shape
+    n_blk = S // block
     scale = 1.0 / (D ** 0.5)
-    grid = (BH, S // block, S // block)  # K-block dim innermost (sequential)
-    kernel = partial(_fwd_kernel, block_q=block, block_k=block, scale=scale)
+    # Inner dim = K blocks (sequential); with a window it is shortened to
+    # the max number of visible K-blocks per Q-block.
+    grid = (BH, n_blk, _n_kv_blocks(n_blk, block, window))
+    kernel = partial(_fwd_kernel, block_q=block, block_k=block, scale=scale,
+                     window=window)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block, D), lambda bh, i, j: (bh, i, 0)),
-            # K/V block index clamped to min(i, j): for the causally-masked
-            # iterations (j > i) the index repeats, so the pipeline skips the
-            # DMA — no bandwidth is spent on blocks the kernel won't read.
-            pl.BlockSpec((1, block, D), lambda bh, i, j: (bh, jnp.minimum(i, j), 0)),
-            pl.BlockSpec((1, block, D), lambda bh, i, j: (bh, jnp.minimum(i, j), 0)),
+            pl.BlockSpec((1, block, D), _kv_clamp(block, window)),
+            pl.BlockSpec((1, block, D), _kv_clamp(block, window)),
         ],
         out_specs=[
             pl.BlockSpec((1, block, D), lambda bh, i, j: (bh, i, 0)),
@@ -139,19 +193,19 @@ def _flash_fwd(q, k, v, block: int, interpret: bool):
 # ---------------------------------------------------------------------------
 
 
-def _recompute_p(q, k, lse_row, q_idx, k_idx, block_q, block_k, scale):
+def _recompute_p(q, k, lse_row, q_idx, k_idx, block_q, block_k, scale, window):
     """Rebuild one [BQ, BK] tile of attention probabilities from saved lse."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
     q_pos = q_idx * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
     k_pos = k_idx * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-    mask = q_pos >= k_pos
+    mask = _visible(q_pos, k_pos, window)
     return jnp.where(mask, jnp.exp(s - lse_row[:, None]), 0.0)
 
 
 def _p_ds_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               q_idx, k_idx, block_q, block_k, scale):
+               q_idx, k_idx, block_q, block_k, scale, window):
     """Shared gradient-tile math for both backward kernels: load the four
     blocks and return (p, ds, q, k, do) — ds = p ∘ (dO·Vᵀ − Δ) · scale."""
     q = q_ref[0].astype(jnp.float32)            # [BQ, D]
@@ -159,7 +213,7 @@ def _p_ds_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     v_blk = v_ref[0].astype(jnp.float32)        # [BK, D]
     do = do_ref[0].astype(jnp.float32)          # [BQ, D]
     p = _recompute_p(q, k_blk, lse_ref[0, 0], q_idx, k_idx,
-                     block_q, block_k, scale)
+                     block_q, block_k, scale, window)
     dp = jax.lax.dot_general(
         do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )                                           # [BQ, BK]
@@ -168,12 +222,14 @@ def _p_ds_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_scr, *, block_q: int, block_k: int, scale: float):
+                   dq_scr, *, block_q: int, block_k: int, scale: float,
+                   window: int):
     q_idx = pl.program_id(1)
-    k_idx = pl.program_id(2)
-    n_k = pl.num_programs(2)
+    j = pl.program_id(2)
+    n_j = pl.num_programs(2)
+    k_idx = _k_index(q_idx, j, block_q, window)
 
-    @pl.when(k_idx == 0)
+    @pl.when(j == 0)
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
@@ -181,33 +237,51 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _compute():
         _, ds, _, k_blk, _ = _p_ds_tile(q_ref, k_ref, v_ref, do_ref,
                                         lse_ref, delta_ref, q_idx, k_idx,
-                                        block_q, block_k, scale)
+                                        block_q, block_k, scale, window)
         dq_scr[...] += jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    @pl.when(k_idx == n_k - 1)
+    @pl.when(j == n_j - 1)
     def _finalize():
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
+def _hi_block(k_idx, block: int, window: int):
+    """Highest Q-block index that can see K-block ``k_idx`` under ``window``."""
+    return (k_idx * block + block + window - 2) // block
+
+
+def _q_index(k_idx, j, window: int):
+    """Inner grid coordinate → actual Q-block index for the K-major kernel:
+    with a window the grid starts at the diagonal (lowest visible Q-block
+    is the K-block itself)."""
+    return k_idx + j if window else j
+
+
 def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *,
-                    block_q: int, block_k: int, scale: float):
+                    block_q: int, block_k: int, scale: float, window: int,
+                    n_blk: int):
     k_idx = pl.program_id(1)
-    q_idx = pl.program_id(2)
-    n_q = pl.num_programs(2)
+    j = pl.program_id(2)
+    n_j = pl.num_programs(2)
+    q_idx = _q_index(k_idx, j, window)
 
-    @pl.when(q_idx == 0)
+    @pl.when(j == 0)
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    @pl.when(q_idx >= k_idx)
+    active = q_idx >= k_idx
+    if window:
+        active &= q_idx < n_blk  # offset grid can run past the last Q-block
+
+    @pl.when(active)
     def _compute():
         p, ds, q, _, do = _p_ds_tile(q_ref, k_ref, v_ref, do_ref,
                                      lse_ref, delta_ref, q_idx, k_idx,
-                                     block_q, block_k, scale)
+                                     block_q, block_k, scale, window)
         dv_scr[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )                                           # [BK, D]
@@ -215,13 +289,13 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    @pl.when(q_idx == n_q - 1)
+    @pl.when(j == n_j - 1)
     def _finalize():
         dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd(block: int, interpret: bool, res, do):
+def _flash_bwd(block: int, interpret: bool, window: int, res, do):
     q, k, v, o, lse = res  # q/k/v/o: [BH, S, D]; lse: [BH, S]
     BH, S, D = q.shape
     scale = 1.0 / (D ** 0.5)
@@ -240,16 +314,18 @@ def _flash_bwd(block: int, interpret: bool, res, do):
     qkv_spec = pl.BlockSpec((1, bb, D), lambda bh, i, j: (bh, i, 0))
     row_spec = pl.BlockSpec((1, 1, bb), lambda bh, i, j: (bh, 0, i))
 
-    # The jnp.minimum / jnp.maximum index maps below clamp the moving
-    # operand's index on causally-skipped iterations to the last block
-    # actually read, so the pipeline elides the DMA.
+    # The clamped index maps below pin the moving operand's index on
+    # causally- or window-skipped iterations to a block already fetched,
+    # so the pipeline elides the DMA.
     dq = pl.pallas_call(
-        partial(_bwd_dq_kernel, block_q=bb, block_k=bb, scale=scale),
-        grid=(BH, n_blk, n_blk),  # (bh, q-block, k-block innermost)
+        partial(_bwd_dq_kernel, block_q=bb, block_k=bb, scale=scale,
+                window=window),
+        # (bh, q-block, k-block innermost) — inner dim shortened by a window
+        grid=(BH, n_blk, _n_kv_blocks(n_blk, bb, window)),
         in_specs=[
             qkv_spec,  # q
-            pl.BlockSpec((1, bb, D), lambda bh, i, j: (bh, jnp.minimum(i, j), 0)),  # k
-            pl.BlockSpec((1, bb, D), lambda bh, i, j: (bh, jnp.minimum(i, j), 0)),  # v
+            pl.BlockSpec((1, bb, D), _kv_clamp(bb, window)),  # k
+            pl.BlockSpec((1, bb, D), _kv_clamp(bb, window)),  # v
             qkv_spec,  # do
             row_spec,  # lse
             row_spec,  # delta
@@ -263,11 +339,22 @@ def _flash_bwd(block: int, interpret: bool, res, do):
         interpret=interpret,
     )(q, k, v, do, lse3, delta3)
 
-    moving = pl.BlockSpec((1, bb, D), lambda bh, i, j: (bh, jnp.maximum(i, j), 0))
-    moving_row = pl.BlockSpec((1, 1, bb), lambda bh, i, j: (bh, 0, jnp.maximum(i, j)))
+    if window:
+        # Offset inner grid: q-block = i + j, clamped to the last real block
+        # for the tail iterations past the end of the sequence.
+        def _q_blk(i, j):
+            return jnp.minimum(_q_index(i, j, window), n_blk - 1)
+    else:
+        def _q_blk(i, j):
+            return jnp.maximum(i, j)
+
+    moving = pl.BlockSpec((1, bb, D), lambda bh, i, j: (bh, _q_blk(i, j), 0))
+    moving_row = pl.BlockSpec((1, 1, bb), lambda bh, i, j: (bh, 0, _q_blk(i, j)))
     dk, dv = pl.pallas_call(
-        partial(_bwd_dkv_kernel, block_q=bb, block_k=bb, scale=scale),
-        grid=(BH, n_blk, n_blk),  # (bh, k-block, q-block innermost)
+        partial(_bwd_dkv_kernel, block_q=bb, block_k=bb, scale=scale,
+                window=window, n_blk=n_blk),
+        # (bh, k-block, q-block innermost) — inner dim shortened by a window
+        grid=(BH, n_blk, _n_q_blocks(n_blk, bb, window)),
         in_specs=[
             qkv_spec,    # k
             qkv_spec,    # v
@@ -298,26 +385,31 @@ def _flash_bwd(block: int, interpret: bool, res, do):
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_bhsd(q, k, v, block: int, interpret: bool):
-    o, _ = _flash_fwd(q, k, v, block, interpret)
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_bhsd(q, k, v, block: int, interpret: bool, window: int):
+    o, _ = _flash_fwd(q, k, v, block, interpret, window)
     return o
 
 
-def _flash_bhsd_fwd(q, k, v, block, interpret):
-    o, lse = _flash_fwd(q, k, v, block, interpret)
+def _flash_bhsd_fwd(q, k, v, block, interpret, window):
+    o, lse = _flash_fwd(q, k, v, block, interpret, window)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bhsd_bwd(block, interpret, res, do):
-    return _flash_bwd(block, interpret, res, do)
+def _flash_bhsd_bwd(block, interpret, window, res, do):
+    return _flash_bwd(block, interpret, window, res, do)
 
 
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
 
 
-def flash_mha(q, k, v, causal: bool = True, interpret: bool | None = None):
+def flash_mha(q, k, v, causal: bool = True, interpret: bool | None = None,
+              window: int = 0):
     """Flash attention on [B, S, H, D]; returns [B, S, H, D].
+
+    ``window > 0`` restricts each query to the trailing ``window`` keys
+    (sliding-window attention, Mistral-style): block pairs wholly outside
+    the window are skipped — compute and DMA — so cost is O(S·W), not O(S²).
 
     Raises :class:`FlashUnsupported` (at trace time) when the shape doesn't
     tile or attention is non-causal; the dispatcher in
@@ -328,6 +420,10 @@ def flash_mha(q, k, v, causal: bool = True, interpret: bool | None = None):
     block = _pick_block(S)
     if not causal or block == 0 or S < 64:
         raise FlashUnsupported(f"no flash tiling for seq_len={S}, causal={causal}")
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window >= S:
+        window = 0  # a window covering the whole sequence is plain causal
     if interpret is None:
         # Off-TPU the kernel would only run in interpret mode — orders of
         # magnitude slower than XLA attention. Don't do that silently; let
@@ -342,5 +438,5 @@ def flash_mha(q, k, v, causal: bool = True, interpret: bool | None = None):
     def to_bhsd(x):
         return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
 
-    o = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), block, interpret)
+    o = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), block, interpret, window)
     return o.reshape(B, H, S, D).transpose(0, 2, 1, 3)

@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 
 
-def _xla_mha(q, k, v, causal: bool = True):
+def _xla_mha(q, k, v, causal: bool = True, window: int = 0):
     B, S, H, D = q.shape
     KV = k.shape[2]
     if KV != H:
@@ -22,24 +22,33 @@ def _xla_mha(q, k, v, causal: bool = True):
     scale = 1.0 / (D ** 0.5)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
     if causal:
-        mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+        pos = jnp.arange(S)
+        mask = pos[:, None] >= pos[None, :]
+        if window:
+            mask &= pos[:, None] - pos[None, :] < window
         scores = jnp.where(mask[None, None, :, :], scores, -1e9)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def mha(q, k, v, causal: bool = True, force_xla: bool = False):
+def mha(q, k, v, causal: bool = True, force_xla: bool = False, window: int = 0):
     """Multi-head attention dispatch.
 
-    ``force_xla=True`` (or an untileable shape) → the XLA implementation;
-    otherwise the first-party Pallas flash kernel (interpret mode off-TPU,
-    so the kernel logic is exercisable on the CPU test mesh).
+    ``window > 0`` is sliding-window (Mistral-style) attention: each query
+    sees only the trailing ``window`` keys. ``force_xla=True`` (or an
+    untileable shape) → the XLA implementation; otherwise the first-party
+    Pallas flash kernel (interpret mode off-TPU, so the kernel logic is
+    exercisable on the CPU test mesh).
     """
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window and not causal:
+        raise ValueError("sliding-window attention requires causal=True")
     if force_xla:
-        return _xla_mha(q, k, v, causal=causal)
+        return _xla_mha(q, k, v, causal=causal, window=window)
     from tpu_engine.ops._flash_pallas import FlashUnsupported, flash_mha
 
     try:
-        return flash_mha(q, k, v, causal=causal)
+        return flash_mha(q, k, v, causal=causal, window=window)
     except FlashUnsupported:
-        return _xla_mha(q, k, v, causal=causal)
+        return _xla_mha(q, k, v, causal=causal, window=window)

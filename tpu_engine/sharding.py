@@ -281,6 +281,9 @@ class TPUTrainConfig(BaseModel):
     attention_impl: Literal["auto", "xla", "flash", "ring", "ulysses"] = Field(
         default="auto", description="auto | xla | flash | ring | ulysses"
     )
+    # Sliding-window attention override: None = the model preset's own
+    # window (e.g. mistral-7b → 4096); 0 = force full causal; N = window N.
+    sliding_window: Optional[int] = Field(default=None, ge=0)
 
     # LoRA fine-tuning: when lora_rank is set, only rank-sized adapters on
     # lora_targets train (tpu_engine/lora.py); the base model is frozen —

@@ -268,6 +268,17 @@ def build_train_program(
         impl = cfg.attention_impl
     if model_cfg.attention_impl != impl:
         model_cfg = model_cfg.with_(attention_impl=impl)
+    if cfg.sliding_window is not None and model_cfg.sliding_window != cfg.sliding_window:
+        model_cfg = model_cfg.with_(sliding_window=cfg.sliding_window)
+    # Reject window × sequence-parallel here, at build time, rather than
+    # letting the job fail at first-step trace deep inside _attention.
+    if model_cfg.sliding_window and impl in ("ring", "ulysses"):
+        raise ValueError(
+            f"sliding_window={model_cfg.sliding_window} is not supported with "
+            f"attention_impl={impl!r} (a windowed model has no use for "
+            "full-sequence context parallelism); use a mesh without a "
+            "sequence axis, or set sliding_window=0"
+        )
     # Mesh is threaded into the forward pass only for sequence-parallel
     # attention (shard_map over the 'sequence' axis).
     attn_mesh = mesh if impl in ("ring", "ulysses") else None
