@@ -166,3 +166,86 @@ def test_sliding_window_decode_matches_forward():
         np.testing.assert_allclose(
             np.asarray(logits[:, 0]), np.asarray(full[:, t]), atol=2e-4, rtol=2e-4
         )
+
+
+def test_rolling_cache_matches_forward():
+    """Ring-buffer cache: a windowed model decodes with O(window) cache
+    slots; logits must still match the full training forward even after
+    the buffer has wrapped several times."""
+    cfg, params, tokens = _setup(S=40)
+    cfg = cfg.with_(sliding_window=6)
+    B, S = tokens.shape
+    full = tfm.forward(params, tokens, cfg, compute_dtype=jnp.float32)
+
+    prefill = 4
+    cache = init_cache(cfg, B, S, dtype=jnp.float32, max_chunk=prefill)
+    assert cache.max_len == 6 + prefill - 1  # O(window), not O(seq)
+    logits, cache = forward_with_cache(
+        params, tokens[:, :prefill], cache, cfg, compute_dtype=jnp.float32
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(full[:, :prefill]), atol=2e-4, rtol=2e-4
+    )
+    for t in range(prefill, S):  # wraps the 9-slot buffer 4+ times
+        logits, cache = forward_with_cache(
+            params, tokens[:, t : t + 1], cache, cfg, compute_dtype=jnp.float32
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits[:, 0]), np.asarray(full[:, t]), atol=2e-4, rtol=2e-4,
+            err_msg=f"position {t}",
+        )
+
+
+def test_rolling_cache_rejects_oversized_chunk():
+    cfg, params, tokens = _setup(S=32)
+    cfg = cfg.with_(sliding_window=8)
+    cache = init_cache(cfg, 2, 32, dtype=jnp.float32, max_chunk=4)  # 11 slots
+    with pytest.raises(ValueError, match="cache slots"):
+        forward_with_cache(params, tokens[:, :8], cache, cfg,
+                           compute_dtype=jnp.float32)
+
+
+def test_windowed_generate_end_to_end():
+    """generate() on a windowed model allocates an O(window) cache and
+    produces identical tokens to a full-size-cache run."""
+    cfg, params, tokens = _setup(S=8)
+    wcfg = cfg.with_(sliding_window=5)
+    out = generate(params, tokens, wcfg, max_new_tokens=20,
+                   compute_dtype=jnp.float32)
+    assert out.shape == (2, 28)
+    # Reference: same model, cache big enough to never wrap.
+    cache = init_cache(wcfg, 2, 28, dtype=jnp.float32)
+    toks = tokens
+    logits, cache = forward_with_cache(params, toks, cache, wcfg,
+                                       compute_dtype=jnp.float32)
+    nxt = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+    for _ in range(20):
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+        logits, cache = forward_with_cache(params, nxt[:, None], cache, wcfg,
+                                           compute_dtype=jnp.float32)
+        nxt = jnp.argmax(logits[:, 0], -1).astype(jnp.int32)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(toks))
+
+
+def test_windowed_generate_short_run():
+    """Short generations on windowed models (max_new_tokens < window-1)
+    allocate a full-size (non-ring) cache and must not trip the ring guard."""
+    cfg, params, tokens = _setup(S=8)
+    out = generate(params, tokens, cfg.with_(sliding_window=5),
+                   max_new_tokens=2, compute_dtype=jnp.float32)
+    assert out.shape == (2, 10)
+
+
+def test_ring_decode_requires_full_window():
+    """T=1 decode on a ring cache with fewer slots than the window must
+    raise, not silently drop in-window keys."""
+    from tpu_engine.generate import KVCache
+
+    cfg, params, tokens = _setup(S=8)
+    cfg = cfg.with_(sliding_window=8)
+    small = init_cache(cfg, 2, 4, dtype=jnp.float32)
+    small = KVCache(k=small.k, v=small.v, pos=small.pos, length=small.length,
+                    ring=True)  # force ring with M=4 < window=8
+    with pytest.raises(ValueError, match="cache slots"):
+        forward_with_cache(params, tokens[:, :1], small, cfg,
+                           compute_dtype=jnp.float32)

@@ -26,7 +26,7 @@ training-time dispatch dropped a token.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Optional
 
@@ -51,12 +51,21 @@ _NEG_INF = -1e30
 @dataclass
 class KVCache:
     """Per-layer key/value cache (a pytree — crosses jit/scan boundaries).
-    k/v: [L, B, max_len, KV, HD]; ``length`` is the number of positions
-    already written (scalar int32)."""
+
+    k/v: [L, B, slots, KV, HD]; ``pos`` [slots] holds the global position
+    stored in each slot (-1 = empty); ``length`` is the number of positions
+    already written (scalar int32). When ``ring`` is set (sliding-window
+    models whose cache is smaller than the sequence) the buffer wraps:
+    writes go to ``position % slots`` and the attention mask reads ``pos``,
+    so memory and per-step attention cost are O(window), not O(sequence).
+    Non-ring caches keep the classic contract: the caller never writes past
+    ``slots`` positions total."""
 
     k: jax.Array
     v: jax.Array
+    pos: jax.Array
     length: jax.Array
+    ring: bool = field(default=False, metadata=dict(static=True))
 
     @property
     def max_len(self) -> int:
@@ -64,13 +73,26 @@ class KVCache:
 
 
 def init_cache(
-    cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.bfloat16
+    cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.bfloat16,
+    max_chunk: Optional[int] = None,
 ) -> KVCache:
-    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    """Allocate a cache able to hold ``max_len`` positions — or, for a
+    sliding-window model, a ring buffer of ``window + max_chunk - 1`` slots
+    (a chunk of T queries needs the window behind its oldest query to still
+    be resident). ``max_chunk`` defaults to ``max_len`` (no shrink); pass
+    the real prefill length (as :func:`generate` does) to get O(window)
+    memory for long generations."""
+    slots = max_len
+    if cfg.sliding_window:
+        chunk = max_len if max_chunk is None else max_chunk
+        slots = min(max_len, cfg.sliding_window + chunk - 1)
+    shape = (cfg.n_layers, batch, slots, cfg.n_kv_heads, cfg.head_dim)
     return KVCache(
         k=jnp.zeros(shape, dtype),
         v=jnp.zeros(shape, dtype),
+        pos=jnp.full((slots,), -1, jnp.int32),
         length=jnp.zeros((), jnp.int32),
+        ring=slots < max_len,
     )
 
 
@@ -106,15 +128,17 @@ def _moe_mlp_decode(h, layer_params, cfg: ModelConfig):
     return jnp.einsum("bte,bted->btd", weights.astype(h.dtype), expert_out)
 
 
-def _decode_block(x, layer_params, k_cache, v_cache, length, positions, cfg: ModelConfig):
+def _decode_block(x, layer_params, k_cache, v_cache, write, slot_pos, positions,
+                  cfg: ModelConfig):
     """One transformer block attending against the cache.
 
-    x: [B, T, D] new activations; k_cache/v_cache: [B, M, KV, HD]; returns
-    (x, k_cache, v_cache) with the T new positions written at ``length``.
+    x: [B, T, D] new activations; k_cache/v_cache: [B, M, KV, HD];
+    ``write(cache_arr, rows)`` stores the chunk's rows at its slots (built
+    once in :func:`forward_with_cache`); ``slot_pos`` [M] is the global
+    position held by each cache slot after this chunk's writes.
     """
     B, T, D = x.shape
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    M = k_cache.shape[1]
 
     h = _rms_norm(x, layer_params["attn_norm"]["scale"], cfg.norm_eps)
     q = jnp.einsum("btd,de->bte", h, layer_params["q"]["kernel"]).reshape(B, T, H, HD)
@@ -123,8 +147,8 @@ def _decode_block(x, layer_params, k_cache, v_cache, length, positions, cfg: Mod
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
 
-    k_cache = lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, length, 0, 0))
-    v_cache = lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, length, 0, 0))
+    k_cache = write(k_cache, k)
+    v_cache = write(v_cache, v)
 
     kc, vc = k_cache, v_cache
     if KV != H:  # GQA
@@ -135,12 +159,13 @@ def _decode_block(x, layer_params, k_cache, v_cache, length, positions, cfg: Mod
     scores = jnp.einsum(
         "bthd,bmhd->bhtm", q, kc, preferred_element_type=jnp.float32
     ) * scale
-    # Key m is visible to query t iff m ≤ its global position (causal) —
-    # positions beyond length+T hold zeros and are masked the same way.
-    # Sliding-window models additionally hide keys older than the window,
-    # matching the training-time mask.
-    key_pos = jnp.arange(M)
-    mask = key_pos[None, :] <= positions[:, :, None]  # [B, T, M]
+    # Slot m is visible to query t iff it holds a real position (≥ 0) that
+    # is ≤ the query's global position (causal). Sliding-window models
+    # additionally hide keys older than the window, matching the
+    # training-time mask; ring-buffer slots overwritten by in-chunk later
+    # positions are masked for earlier queries by the same comparison.
+    key_pos = slot_pos  # [M]
+    mask = (key_pos[None, :] >= 0) & (key_pos[None, :] <= positions[:, :, None])
     if cfg.sliding_window:
         mask &= key_pos[None, :] > positions[:, :, None] - cfg.sliding_window
     scores = jnp.where(mask[:, None, :, :], scores, _NEG_INF)
@@ -167,15 +192,60 @@ def forward_with_cache(
     Serves both phases: prefill (T = prompt length) and decode (T = 1).
     Returns (logits [B, T, V] fp32, updated cache with length += T).
 
-    The caller must keep ``cache.length + T <= cache.max_len`` (size the
-    cache to prompt + max_new_tokens, as :func:`generate` does): there is no
-    wraparound, and past the end ``dynamic_update_slice`` clamps the write
-    offset, silently overwriting the newest entries.
+    For non-ring caches the caller must keep ``cache.length + T <=
+    cache.max_len`` (size the cache to prompt + max_new_tokens, as
+    :func:`generate` does). Ring caches (sliding-window models with fewer
+    slots than the sequence) wrap; a chunk of T queries needs the window
+    behind its oldest query resident, so the cache must hold at least
+    ``window + T - 1`` slots (checked statically below — T=1 decode needs
+    the full window resident too).
     """
     B, T = tokens.shape
+    M = cache.max_len
+    if cache.ring and M < cfg.sliding_window + T - 1:
+        raise ValueError(
+            f"chunk of {T} queries needs >= {cfg.sliding_window + T - 1} cache "
+            f"slots (window {cfg.sliding_window}), cache has {M}; prefill in "
+            "smaller chunks or allocate with a larger max_chunk"
+        )
     positions = cache.length + jnp.broadcast_to(
         jnp.arange(T, dtype=jnp.int32)[None, :], (B, T)
     )
+    new_pos = cache.length + jnp.arange(T, dtype=jnp.int32)
+    slots = new_pos % M
+    # One-hot select, not .at[].set(): this TPU toolchain's scatter emitter
+    # rejects even the 1-D traced-index scatter (scatter_emitter.cc check
+    # failure), and the select is O(T·M) int32 ops on an M-sized array.
+    pos_onehot = jnp.arange(M)[None, :] == slots[:, None]  # [T, M]
+    pos_new = jnp.where(
+        pos_onehot.any(axis=0),
+        (pos_onehot.astype(jnp.int32) * new_pos[:, None]).sum(axis=0),
+        cache.pos,
+    )
+
+    if cache.ring and T > 1:
+        # A multi-token chunk on a ring cache can wrap mid-chunk; write it
+        # as a one-hot select — TPU's scatter emitter rejects the
+        # [B, slots, ...] multi-dim scatter, and a select fuses cleanly.
+        # Slots within a chunk are distinct (M >= T via the guard above),
+        # so the einsum copies exactly one row per written slot.
+        onehot = pos_onehot
+        written = onehot.any(axis=0)
+
+        def write(cache_arr, rows):
+            rows_m = jnp.einsum("tm,btkh->bmkh", onehot.astype(cache_arr.dtype),
+                                rows.astype(cache_arr.dtype))
+            return jnp.where(written[None, :, None, None], rows_m, cache_arr)
+    else:
+        # Contiguous, non-wrapping write (T=1 ring decode, or any non-ring
+        # chunk): a cheap O(T) dynamic_update_slice at the slot offset.
+        offset = cache.length % M if cache.ring else cache.length
+
+        def write(cache_arr, rows):
+            return lax.dynamic_update_slice(
+                cache_arr, rows.astype(cache_arr.dtype), (0, offset, 0, 0)
+            )
+
     x = embed_tokens(params, tokens, compute_dtype)
     layer_stack = cast_layer_stack(params, compute_dtype)
 
@@ -183,13 +253,14 @@ def forward_with_cache(
         x = carry
         layer_params, k_c, v_c = xs
         x, k_c, v_c = _decode_block(
-            x, layer_params, k_c, v_c, cache.length, positions, cfg
+            x, layer_params, k_c, v_c, write, pos_new, positions, cfg
         )
         return x, (k_c, v_c)
 
     x, (k_new, v_new) = lax.scan(body, x, (layer_stack, cache.k, cache.v))
     logits = unembed(params, x, cfg)
-    return logits, KVCache(k=k_new, v=v_new, length=cache.length + T)
+    return logits, KVCache(k=k_new, v=v_new, pos=pos_new,
+                           length=cache.length + T, ring=cache.ring)
 
 
 def _filtered_sample(
@@ -308,7 +379,8 @@ def _generate_jit(
         )
 
     keys = jax.random.split(rng, max_new_tokens)  # one fresh key per draw
-    cache = init_cache(cfg, B, P + max_new_tokens, dtype=compute_dtype)
+    cache = init_cache(cfg, B, P + max_new_tokens, dtype=compute_dtype,
+                       max_chunk=P)
     logits, cache = forward_with_cache(params, prompt, cache, cfg, compute_dtype)
     first = sample(logits[:, -1, :], keys[0])
 
