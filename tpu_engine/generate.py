@@ -26,6 +26,7 @@ training-time dispatch dropped a token.
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Optional
@@ -398,3 +399,129 @@ def _generate_jit(
     else:
         generated = first[None]
     return jnp.concatenate([prompt, generated.T.astype(jnp.int32)], axis=1)
+
+
+def speculative_generate(
+    params: dict[str, Any],
+    draft_params: dict[str, Any],
+    prompt: jax.Array,
+    cfg: ModelConfig,
+    draft_cfg: ModelConfig,
+    max_new_tokens: int,
+    gamma: int = 4,
+    compute_dtype=jnp.bfloat16,
+    return_stats: bool = False,
+) -> jax.Array:
+    """Speculative greedy decoding: a small draft model proposes ``gamma``
+    tokens autoregressively, the target verifies them in ONE forward pass,
+    and the longest agreeing prefix (plus the target's correction token) is
+    accepted — output is identical to plain greedy decoding of the target,
+    in fewer target forward passes.
+
+    Exactness caveat: the guarantee holds whenever the target's chunked
+    (T=gamma+1) and incremental (T=1) forwards agree on the argmax. That is
+    bit-exact on the CPU backend (pinned in tests); on TPU, XLA's matmul
+    pass structure differs with chunk size (~1e-2 logit deltas), so
+    near-argmax-ties — pervasive in random-init models, rare in trained
+    ones — can resolve differently than single-token greedy.
+
+    Cache rewind is free by construction: rejected positions simply leave
+    stale entries whose stored global position exceeds every later query
+    (masked by the position-based attention mask) until the sequence
+    re-reaches them, at which point the write lands on the same slot before
+    attention runs. ``length`` is rolled back to the accepted frontier and
+    nothing else needs cleaning.
+
+    Batch 1 only (acceptance lengths diverge across rows). Returns
+    [1, P + max_new_tokens] int32 — or, with ``return_stats=True``,
+    ``(tokens, rounds)`` where ``rounds`` is the number of target forward
+    passes taken (a perfect draft needs ceil(N / (gamma+1))).
+    """
+    if prompt.shape[0] != 1:
+        raise ValueError("speculative_generate supports batch size 1")
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    out, rounds = _speculative_jit(
+        params, draft_params, prompt,
+        cfg=cfg, draft_cfg=draft_cfg, max_new_tokens=max_new_tokens,
+        gamma=gamma, compute_dtype=compute_dtype,
+    )
+    return (out, int(rounds)) if return_stats else out
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "draft_cfg", "max_new_tokens", "gamma", "compute_dtype"),
+)
+def _speculative_jit(
+    params, draft_params, prompt, *,
+    cfg: ModelConfig, draft_cfg: ModelConfig,
+    max_new_tokens: int, gamma: int, compute_dtype,
+) -> jax.Array:
+    P = prompt.shape[1]
+    total = P + max_new_tokens
+    buf_len = total + gamma + 1  # room for one over-full final round
+
+    cache = init_cache(cfg, 1, buf_len, dtype=compute_dtype,
+                       max_chunk=max(P - 1, gamma + 1))
+    dcache = init_cache(draft_cfg, 1, buf_len, dtype=compute_dtype,
+                        max_chunk=max(P - 1, 1))
+
+    out = jnp.zeros((1, buf_len), jnp.int32)
+    out = lax.dynamic_update_slice(out, prompt.astype(jnp.int32), (0, 0))
+
+    # Ingest the prompt minus its last token (the last token is re-fed each
+    # round so its logits participate in verification).
+    if P > 1:
+        _, cache = forward_with_cache(params, prompt[:, :-1], cache, cfg,
+                                      compute_dtype)
+        _, dcache = forward_with_cache(draft_params, prompt[:, :-1], dcache,
+                                       draft_cfg, compute_dtype)
+
+    def round_body(state):
+        out, out_len, rounds, cache, dcache = state
+        t_last = lax.dynamic_slice(out, (0, out_len - 1), (1, 1))  # [1, 1]
+
+        # Draft proposes gamma tokens, one at a time. One extra step beyond
+        # gamma (its output discarded) so the draft also ingests its own
+        # last proposal's K/V: on a fully-accepted round the rewind
+        # advances past that position, and without the write it would stay
+        # a permanent hole in the draft cache, silently halving acceptance.
+        def draft_step(carry, _):
+            tok, dc = carry
+            logits, dc = forward_with_cache(draft_params, tok, dc, draft_cfg,
+                                            compute_dtype)
+            nxt = jnp.argmax(logits[:, -1, :], axis=-1)[:, None].astype(jnp.int32)
+            return (nxt, dc), nxt[0, 0]
+
+        (_, dcache), proposals = lax.scan(
+            draft_step, (t_last, dcache), None, length=gamma + 1
+        )
+        proposals = proposals[:gamma]  # [gamma]
+
+        # Target verifies the whole proposal chain in one forward pass.
+        chain = jnp.concatenate([t_last[0], proposals])[None, :]  # [1, gamma+1]
+        logits, cache = forward_with_cache(params, chain, cache, cfg,
+                                           compute_dtype)
+        tgt = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)  # [gamma+1]
+
+        # Longest agreeing prefix; tgt[a] is the free correction/bonus token.
+        matches = proposals == tgt[:-1]
+        a = jnp.sum(jnp.cumprod(matches.astype(jnp.int32)))
+        out = lax.dynamic_update_slice(out, tgt[None, :], (0, out_len))
+        new_len = out_len + a + 1
+
+        # Rewind both caches to the accepted frontier (stale entries are
+        # masked by position and overwritten on re-arrival).
+        cache = dataclasses.replace(cache, length=new_len - 1)
+        dcache = dataclasses.replace(dcache, length=new_len - 1)
+        return out, new_len, rounds + 1, cache, dcache
+
+    def cond(state):
+        return state[1] < total
+
+    out, _, rounds, _, _ = lax.while_loop(
+        cond, round_body,
+        (out, jnp.asarray(P, jnp.int32), jnp.zeros((), jnp.int32), cache, dcache),
+    )
+    return out[:, :total], rounds
