@@ -205,3 +205,66 @@ def test_mistral_export_roundtrip(tmp_path):
         tfm.forward(params, jnp.asarray(tokens, jnp.int32), cfg, compute_dtype=jnp.float32)
     )
     np.testing.assert_allclose(ours, hf_logits, atol=2e-3, rtol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# GPT-2 family
+# ---------------------------------------------------------------------------
+
+
+def _tiny_gpt2(seed=0):
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    torch.manual_seed(seed)
+    hf_cfg = GPT2Config(
+        vocab_size=256, n_embd=64, n_layer=2, n_head=4, n_inner=128,
+        n_positions=64, layer_norm_epsilon=1e-5, activation_function="gelu_new",
+        attn_implementation="eager",
+    )
+    return hf_cfg, GPT2LMHeadModel(hf_cfg).eval()
+
+
+def test_gpt2_to_ours_logit_parity():
+    """GPT-2 parity pins LayerNorm+bias, learned positions, fused-c_attn
+    split, Conv1D orientation, gelu_new, and the tied head."""
+    from tpu_engine.models.convert import from_hf_gpt2
+
+    hf_cfg, model = _tiny_gpt2()
+    cfg = config_from_hf(hf_cfg)
+    assert cfg.arch == "gpt2" and cfg.d_ff == 128
+    params = from_hf_gpt2(model.state_dict(), cfg)
+
+    tokens = np.random.default_rng(5).integers(0, 256, (2, 24))
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(
+        tfm.forward(params, jnp.asarray(tokens, jnp.int32), cfg, compute_dtype=jnp.float32)
+    )
+    np.testing.assert_allclose(ours, hf_logits, atol=2e-3, rtol=2e-3)
+
+
+def test_gpt2_export_roundtrip(tmp_path):
+    from transformers import GPT2LMHeadModel
+
+    from tpu_engine.models.convert import save_hf_checkpoint
+
+    cfg = tfm.MODEL_CONFIGS["gpt2-tiny"]
+    params = tfm.init_params(jax.random.PRNGKey(11), cfg)
+    out = save_hf_checkpoint(params, cfg, str(tmp_path / "gpt2-export"))
+    reloaded = GPT2LMHeadModel.from_pretrained(out, attn_implementation="eager").eval()
+    tokens = np.random.default_rng(6).integers(0, cfg.vocab_size, (1, 20))
+    with torch.no_grad():
+        hf_logits = reloaded(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(
+        tfm.forward(params, jnp.asarray(tokens, jnp.int32), cfg, compute_dtype=jnp.float32)
+    )
+    np.testing.assert_allclose(ours, hf_logits, atol=2e-3, rtol=2e-3)
+
+
+def test_gpt2_unsupported_variants_rejected():
+    from transformers import GPT2Config
+
+    with pytest.raises(ValueError, match="activation_function"):
+        config_from_hf(GPT2Config(activation_function="relu"))
+    with pytest.raises(ValueError, match="scale_attn_by_inverse_layer_idx"):
+        config_from_hf(GPT2Config(scale_attn_by_inverse_layer_idx=True))

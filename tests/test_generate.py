@@ -305,3 +305,34 @@ def test_speculative_validation():
         speculative_generate(params, params, tokens, cfg, cfg, 4)
     with pytest.raises(ValueError, match="gamma"):
         speculative_generate(params, params, tokens[:1], cfg, cfg, 4, gamma=0)
+
+
+def test_gpt2_decode_matches_forward():
+    """GPT-2 decode (learned positions at embed, biases, LayerNorm) must
+    match the training forward position-for-position."""
+    cfg, params, tokens = _setup(name="gpt2-tiny")
+    B, S = tokens.shape
+    full = tfm.forward(params, tokens, cfg, compute_dtype=jnp.float32)
+    cache = init_cache(cfg, B, S, dtype=jnp.float32)
+    logits, cache = forward_with_cache(params, tokens[:, :5], cache, cfg,
+                                       compute_dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(full[:, :5]),
+                               atol=2e-4, rtol=2e-4)
+    for t in range(5, S):
+        logits, cache = forward_with_cache(params, tokens[:, t:t+1], cache, cfg,
+                                           compute_dtype=jnp.float32)
+        np.testing.assert_allclose(np.asarray(logits[:, 0]), np.asarray(full[:, t]),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_gpt2_position_table_bounds():
+    """Out-of-table positions must raise, not silently clamp."""
+    cfg, params, _ = _setup(name="gpt2-tiny")
+    long_cfg = cfg.with_(max_seq_len=8)
+    params8 = tfm.init_params(jax.random.PRNGKey(0), long_cfg)
+    toks = jnp.zeros((1, 16), jnp.int32)
+    with pytest.raises(ValueError, match="position table"):
+        tfm.forward(params8, toks, long_cfg, compute_dtype=jnp.float32)
+    with pytest.raises(ValueError, match="position table"):
+        generate(params8, toks[:, :4], long_cfg, max_new_tokens=8,
+                 compute_dtype=jnp.float32)

@@ -203,3 +203,32 @@ def test_lora_job_exports_merged_hf_checkpoint(tmp_path):
         compute_dtype=jnp.float32,
     ))
     np.testing.assert_allclose(ours, hf_logits, atol=2e-3, rtol=2e-3)
+
+
+def test_gpt2_lora_targets():
+    """GPT-2 LoRA: fc/proj are the MLP targets (not gate/up/down), and an
+    adapter-only training step runs."""
+    from tpu_engine.lora import target_shapes, validate_targets
+    from tpu_engine.models import transformer as tfm
+
+    cfg = tfm.MODEL_CONFIGS["gpt2-tiny"]
+    shapes = target_shapes(cfg)
+    assert "fc" in shapes and "proj" in shapes and "gate" not in shapes
+    with pytest.raises(ValueError, match="lora_targets"):
+        validate_targets(cfg, ("q", "gate"))
+
+    tcfg = TPUTrainConfig(
+        model_name="gpt2-tiny", sharding_stage=ShardingStage.FULL_PARTITIONING,
+        mesh=MeshConfig(data=2, fsdp=4), micro_batch_size=1,
+        gradient_accumulation_steps=2, seq_len=32, precision=Precision.FP32,
+        learning_rate=1e-2, warmup_steps=2, total_steps=50,
+        activation_checkpointing=True, lora_rank=4,
+        lora_targets=("q", "v", "fc", "proj"),
+    )
+    prog = build_train_program(tcfg)
+    state = prog.init(jax.random.PRNGKey(0))
+    losses = []
+    for _ in range(6):
+        state, m = prog.step(state, prog.synthetic_batch(0))
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0], losses

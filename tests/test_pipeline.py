@@ -117,3 +117,11 @@ def test_stage_layer_stack_shapes():
     assert q.shape[:2] == (2, cfg.n_layers // 2)
     with pytest.raises(ValueError, match="divisible"):
         stage_layer_stack(params["layers"], 3, cfg.n_layers)
+
+
+def test_pipeline_gpt2_arch():
+    """GPT-2 blocks (biases, LayerNorm, learned positions) stream through
+    the GPipe schedule identically to the accumulation path."""
+    pipe = _run(_cfg(MeshConfig(data=2, fsdp=2, pipe=2), model_name="gpt2-tiny"))[1]
+    ref = _run(_cfg(MeshConfig(data=2, fsdp=2, model=2), model_name="gpt2-tiny"))[1]
+    np.testing.assert_allclose([l for l, _ in pipe], [l for l, _ in ref], rtol=2e-5)

@@ -38,7 +38,8 @@ from jax import lax
 from tpu_engine.models.transformer import (
     ModelConfig,
     _dense_mlp,
-    _rms_norm,
+    _norm,
+    _proj,
     _rope,
     cast_layer_stack,
     embed_tokens,
@@ -141,12 +142,19 @@ def _decode_block(x, layer_params, k_cache, v_cache, write, slot_pos, positions,
     B, T, D = x.shape
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
-    h = _rms_norm(x, layer_params["attn_norm"]["scale"], cfg.norm_eps)
-    q = jnp.einsum("btd,de->bte", h, layer_params["q"]["kernel"]).reshape(B, T, H, HD)
-    k = jnp.einsum("btd,de->bte", h, layer_params["k"]["kernel"]).reshape(B, T, KV, HD)
-    v = jnp.einsum("btd,de->bte", h, layer_params["v"]["kernel"]).reshape(B, T, KV, HD)
-    q = _rope(q, positions, cfg.rope_theta)
-    k = _rope(k, positions, cfg.rope_theta)
+    gpt2 = cfg.arch == "gpt2"
+
+    def proj(h, name):
+        return _proj(h, layer_params[name]["kernel"],
+                     bias=layer_params[name]["bias"] if gpt2 else None)
+
+    h = _norm(x, layer_params["attn_norm"], cfg)
+    q = proj(h, "q").reshape(B, T, H, HD)
+    k = proj(h, "k").reshape(B, T, KV, HD)
+    v = proj(h, "v").reshape(B, T, KV, HD)
+    if not gpt2:  # gpt2 adds learned positions at embed time instead
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
 
     k_cache = write(k_cache, k)
     v_cache = write(v_cache, v)
@@ -172,13 +180,13 @@ def _decode_block(x, layer_params, k_cache, v_cache, write, slot_pos, positions,
     scores = jnp.where(mask[:, None, :, :], scores, _NEG_INF)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
     attn = jnp.einsum("bhtm,bmhd->bthd", probs, vc).reshape(B, T, H * HD)
-    x = x + jnp.einsum("bte,ed->btd", attn, layer_params["o"]["kernel"])
+    x = x + proj(attn, "o")
 
-    h = _rms_norm(x, layer_params["mlp_norm"]["scale"], cfg.norm_eps)
+    h = _norm(x, layer_params["mlp_norm"], cfg)
     if cfg.is_moe:
         x = x + _moe_mlp_decode(h, layer_params, cfg)
         return x, k_cache, v_cache
-    return x + _dense_mlp(h, layer_params), k_cache, v_cache
+    return x + _dense_mlp(h, layer_params, cfg=cfg), k_cache, v_cache
 
 
 def forward_with_cache(
@@ -203,6 +211,13 @@ def forward_with_cache(
     """
     B, T = tokens.shape
     M = cache.max_len
+    if cfg.arch == "gpt2" and not cache.ring and M > cfg.max_seq_len:
+        # The cache is sized to the full generation; a learned position
+        # table shorter than that would be silently clamped by jnp.take.
+        raise ValueError(
+            f"generation length {M} exceeds the learned position table "
+            f"(max_seq_len={cfg.max_seq_len}) of gpt2-family model {cfg.name!r}"
+        )
     if cache.ring and M < cfg.sliding_window + T - 1:
         raise ValueError(
             f"chunk of {T} queries needs >= {cfg.sliding_window + T - 1} cache "
@@ -247,7 +262,7 @@ def forward_with_cache(
                 cache_arr, rows.astype(cache_arr.dtype), (0, offset, 0, 0)
             )
 
-    x = embed_tokens(params, tokens, compute_dtype)
+    x = embed_tokens(params, tokens, compute_dtype, positions=positions)
     layer_stack = cast_layer_stack(params, compute_dtype)
 
     def body(carry, xs):

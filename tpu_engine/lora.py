@@ -43,7 +43,9 @@ def target_shapes(cfg: ModelConfig) -> dict[str, tuple[int, int, int]]:
         "v": (L, D, KV * HD),
         "o": (L, H * HD, D),
     }
-    if not cfg.is_moe:
+    if cfg.arch == "gpt2":
+        shapes.update({"fc": (L, D, F), "proj": (L, F, D)})
+    elif not cfg.is_moe:
         shapes.update({"gate": (L, D, F), "up": (L, D, F), "down": (L, F, D)})
     return shapes
 

@@ -20,7 +20,10 @@ from tpu_engine.models.transformer import (
 )
 from tpu_engine.models.convert import (
     config_from_hf,
+    from_hf,
+    from_hf_gpt2,
     from_hf_llama,
+    to_hf_gpt2,
     to_hf_llama,
 )
 
@@ -28,7 +31,10 @@ __all__ = [
     "ModelConfig",
     "MODEL_CONFIGS",
     "config_from_hf",
+    "from_hf",
+    "from_hf_gpt2",
     "from_hf_llama",
+    "to_hf_gpt2",
     "to_hf_llama",
     "active_param_count",
     "init_params",

@@ -45,6 +45,8 @@ def config_from_hf(hf_config: Any) -> ModelConfig:
     ``rope_scaling``) and a ``head_dim`` decoupled from
     ``hidden_size // num_attention_heads`` are rejected.
     """
+    if getattr(hf_config, "model_type", "") == "gpt2":
+        return config_from_hf_gpt2(hf_config)
     scaling = getattr(hf_config, "rope_scaling", None)
     if scaling:
         raise ValueError(
@@ -138,10 +140,24 @@ def from_hf_llama(
 
 
 def hf_config_from(cfg: ModelConfig) -> Any:
-    """Inverse of :func:`config_from_hf`: a ``transformers.LlamaConfig``
-    describing this model (dense Llama-style models only)."""
+    """Inverse of :func:`config_from_hf`: the ``transformers`` config class
+    describing this model (dense Llama/Mistral/GPT-2 models only)."""
     if cfg.is_moe:
         raise ValueError("MoE models have no LlamaForCausalLM representation")
+    if cfg.arch == "gpt2":
+        from transformers import GPT2Config
+
+        return GPT2Config(
+            vocab_size=cfg.vocab_size,
+            n_embd=cfg.d_model,
+            n_layer=cfg.n_layers,
+            n_head=cfg.n_heads,
+            n_inner=cfg.d_ff,
+            n_positions=cfg.max_seq_len,
+            layer_norm_epsilon=cfg.norm_eps,
+            activation_function="gelu_new",
+            tie_word_embeddings=True,
+        )
     common = dict(
         vocab_size=cfg.vocab_size,
         hidden_size=cfg.d_model,
@@ -167,14 +183,20 @@ def hf_config_from(cfg: ModelConfig) -> Any:
 
 def save_hf_checkpoint(params: dict[str, Any], cfg: ModelConfig, out_dir: str) -> str:
     """Write ``params`` as a loadable HF checkpoint directory (config.json +
-    safetensors) — ``LlamaForCausalLM``, or ``MistralForCausalLM`` for
-    sliding-window models. Returns ``out_dir``."""
+    safetensors) — ``LlamaForCausalLM``, ``MistralForCausalLM`` for
+    sliding-window models, or ``GPT2LMHeadModel`` for the GPT-2 family.
+    Returns ``out_dir``."""
     import torch
-    from transformers import LlamaForCausalLM, MistralForCausalLM
+    from transformers import GPT2LMHeadModel, LlamaForCausalLM, MistralForCausalLM
 
     hf_cfg = hf_config_from(cfg)
-    model_cls = MistralForCausalLM if cfg.sliding_window else LlamaForCausalLM
-    sd = {k: torch.tensor(v) for k, v in to_hf_llama(params, cfg).items()}
+    if cfg.arch == "gpt2":
+        model_cls, to_hf = GPT2LMHeadModel, to_hf_gpt2
+    elif cfg.sliding_window:
+        model_cls, to_hf = MistralForCausalLM, to_hf_llama
+    else:
+        model_cls, to_hf = LlamaForCausalLM, to_hf_llama
+    sd = {k: torch.tensor(v) for k, v in to_hf(params, cfg).items()}
     # meta device: never allocate (or randomly initialise) a second full
     # weight copy just to overwrite it — assign=True adopts our tensors.
     with torch.device("meta"):
@@ -217,3 +239,141 @@ def to_hf_llama(params: dict[str, Any], cfg: ModelConfig) -> dict[str, np.ndarra
             w = np.asarray(stacked[i], np.float32)
             sd[f"model.layers.{i}.{suffix}"] = w.T if transpose else w
     return sd
+
+
+# ---------------------------------------------------------------------------
+# GPT-2 family (tied embeddings, fused c_attn, Conv1D [in, out] weights)
+# ---------------------------------------------------------------------------
+
+
+def config_from_hf_gpt2(hf_config: Any) -> ModelConfig:
+    """Map a ``transformers.GPT2Config`` onto :class:`ModelConfig`
+    (arch="gpt2"). Rejects variants whose attention math differs from this
+    implementation rather than converting to silently-wrong weights."""
+    act = getattr(hf_config, "activation_function", "gelu_new")
+    if act != "gelu_new":
+        raise ValueError(f"activation_function={act!r} unsupported (need gelu_new)")
+    if getattr(hf_config, "scale_attn_by_inverse_layer_idx", False):
+        raise ValueError("scale_attn_by_inverse_layer_idx is not supported")
+    if getattr(hf_config, "reorder_and_upcast_attn", False):
+        raise ValueError("reorder_and_upcast_attn is not supported")
+    if not getattr(hf_config, "scale_attn_weights", True):
+        raise ValueError("scale_attn_weights=False is not supported")
+    return ModelConfig(
+        name=getattr(hf_config, "name_or_path", "") or "hf-gpt2",
+        arch="gpt2",
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.n_embd,
+        n_layers=hf_config.n_layer,
+        n_heads=hf_config.n_head,
+        n_kv_heads=hf_config.n_head,
+        d_ff=hf_config.n_inner or 4 * hf_config.n_embd,
+        max_seq_len=hf_config.n_positions,
+        norm_eps=getattr(hf_config, "layer_norm_epsilon", 1e-5),
+    )
+
+
+def from_hf_gpt2(
+    state_dict: Mapping[str, Any], cfg: ModelConfig, dtype=jnp.float32
+) -> dict[str, Any]:
+    """HF ``GPT2LMHeadModel.state_dict()`` → this framework's param pytree.
+    Conv1D weights are already [in, out] (no transpose); the fused
+    ``c_attn`` [D, 3D] is split into separate q/k/v projections."""
+    sd = state_dict
+    D = cfg.d_model
+    consumed: set[str] = set()
+
+    def leaf(name: str):
+        consumed.add(name)
+        return jnp.asarray(_np(sd[name]), dtype)
+
+    def stacked(fmt: str):
+        return jnp.stack([leaf(fmt.format(i=i)) for i in range(cfg.n_layers)])
+
+    def split_qkv(fmt: str, axis: int):
+        full = stacked(fmt)  # [L, D, 3D] or [L, 3D]
+        return [lax_slice(full, j * D, (j + 1) * D, axis) for j in range(3)]
+
+    def lax_slice(a, lo, hi, axis):
+        idx = [slice(None)] * a.ndim
+        idx[axis] = slice(lo, hi)
+        return a[tuple(idx)]
+
+    p = "transformer.h.{i}."
+    qw, kw, vw = split_qkv(p + "attn.c_attn.weight", axis=2)
+    qb, kb, vb = split_qkv(p + "attn.c_attn.bias", axis=1)
+    params = {
+        "embed": {"embedding": leaf("transformer.wte.weight")},
+        "pos_embed": {"embedding": leaf("transformer.wpe.weight")},
+        "layers": {
+            "attn_norm": {"scale": stacked(p + "ln_1.weight"),
+                          "bias": stacked(p + "ln_1.bias")},
+            "q": {"kernel": qw, "bias": qb},
+            "k": {"kernel": kw, "bias": kb},
+            "v": {"kernel": vw, "bias": vb},
+            "o": {"kernel": stacked(p + "attn.c_proj.weight"),
+                  "bias": stacked(p + "attn.c_proj.bias")},
+            "mlp_norm": {"scale": stacked(p + "ln_2.weight"),
+                         "bias": stacked(p + "ln_2.bias")},
+            "fc": {"kernel": stacked(p + "mlp.c_fc.weight"),
+                   "bias": stacked(p + "mlp.c_fc.bias")},
+            "proj": {"kernel": stacked(p + "mlp.c_proj.weight"),
+                     "bias": stacked(p + "mlp.c_proj.bias")},
+        },
+        "final_norm": {"scale": leaf("transformer.ln_f.weight"),
+                       "bias": leaf("transformer.ln_f.bias")},
+    }
+    leftover = [
+        k for k in sd
+        if k not in consumed
+        and not k.endswith(("attn.bias", "attn.masked_bias"))  # causal-mask buffers
+        and k != "lm_head.weight"  # tied to wte
+    ]
+    if leftover:
+        raise ValueError(
+            f"state dict has {len(leftover)} tensors this converter would "
+            f"drop (unsupported GPT-2 variant?): {sorted(leftover)[:8]}"
+        )
+    return params
+
+
+def to_hf_gpt2(params: dict[str, Any], cfg: ModelConfig) -> dict[str, np.ndarray]:
+    """This framework's GPT-2 param pytree → HF GPT2LMHeadModel state-dict
+    layout (numpy, Conv1D [in, out] orientation)."""
+    import jax
+
+    host = jax.device_get(params)
+    lay = host["layers"]
+    sd: dict[str, np.ndarray] = {
+        "transformer.wte.weight": np.asarray(host["embed"]["embedding"], np.float32),
+        "transformer.wpe.weight": np.asarray(host["pos_embed"]["embedding"], np.float32),
+        "transformer.ln_f.weight": np.asarray(host["final_norm"]["scale"], np.float32),
+        "transformer.ln_f.bias": np.asarray(host["final_norm"]["bias"], np.float32),
+        "lm_head.weight": np.asarray(host["embed"]["embedding"], np.float32),
+    }
+    for i in range(cfg.n_layers):
+        pre = f"transformer.h.{i}."
+        sd[pre + "ln_1.weight"] = np.asarray(lay["attn_norm"]["scale"][i], np.float32)
+        sd[pre + "ln_1.bias"] = np.asarray(lay["attn_norm"]["bias"][i], np.float32)
+        sd[pre + "attn.c_attn.weight"] = np.concatenate(
+            [np.asarray(lay[n]["kernel"][i], np.float32) for n in ("q", "k", "v")],
+            axis=1)
+        sd[pre + "attn.c_attn.bias"] = np.concatenate(
+            [np.asarray(lay[n]["bias"][i], np.float32) for n in ("q", "k", "v")])
+        sd[pre + "attn.c_proj.weight"] = np.asarray(lay["o"]["kernel"][i], np.float32)
+        sd[pre + "attn.c_proj.bias"] = np.asarray(lay["o"]["bias"][i], np.float32)
+        sd[pre + "ln_2.weight"] = np.asarray(lay["mlp_norm"]["scale"][i], np.float32)
+        sd[pre + "ln_2.bias"] = np.asarray(lay["mlp_norm"]["bias"][i], np.float32)
+        sd[pre + "mlp.c_fc.weight"] = np.asarray(lay["fc"]["kernel"][i], np.float32)
+        sd[pre + "mlp.c_fc.bias"] = np.asarray(lay["fc"]["bias"][i], np.float32)
+        sd[pre + "mlp.c_proj.weight"] = np.asarray(lay["proj"]["kernel"][i], np.float32)
+        sd[pre + "mlp.c_proj.bias"] = np.asarray(lay["proj"]["bias"][i], np.float32)
+    return sd
+
+
+def from_hf(state_dict: Mapping[str, Any], cfg: ModelConfig, dtype=jnp.float32) -> dict[str, Any]:
+    """Arch-dispatching import: GPT-2 state dicts for ``arch="gpt2"``
+    configs, Llama/Mistral layout otherwise."""
+    if cfg.arch == "gpt2":
+        return from_hf_gpt2(state_dict, cfg, dtype)
+    return from_hf_llama(state_dict, cfg, dtype)

@@ -36,6 +36,9 @@ from jax.ad_checkpoint import checkpoint_name
 @dataclass(frozen=True)
 class ModelConfig:
     name: str = "gpt-125m"
+    # Architecture family: "llama" (RMSNorm, RoPE, SwiGLU, untied head) or
+    # "gpt2" (LayerNorm+bias, learned positions, GELU, biases, tied head).
+    arch: str = "llama"
     vocab_size: int = 32_000
     d_model: int = 768
     n_layers: int = 12
@@ -108,6 +111,19 @@ MODEL_CONFIGS: dict[str, ModelConfig] = {
         name="mistral-7b", vocab_size=32_000, d_model=4096, n_layers=32, n_heads=32,
         n_kv_heads=8, d_ff=14_336, max_seq_len=32_768, sliding_window=4096,
     ),
+    # GPT-2 family: LayerNorm + learned positions + GELU + tied embeddings.
+    "gpt2-tiny": ModelConfig(
+        name="gpt2-tiny", arch="gpt2", vocab_size=512, d_model=64, n_layers=2,
+        n_heads=4, n_kv_heads=4, d_ff=256, max_seq_len=256,
+    ),
+    "gpt2-124m": ModelConfig(
+        name="gpt2-124m", arch="gpt2", vocab_size=50_257, d_model=768, n_layers=12,
+        n_heads=12, n_kv_heads=12, d_ff=3072, max_seq_len=1024,
+    ),
+    "gpt2-xl": ModelConfig(
+        name="gpt2-xl", arch="gpt2", vocab_size=50_257, d_model=1600, n_layers=48,
+        n_heads=25, n_kv_heads=25, d_ff=6400, max_seq_len=1024,
+    ),
     # Mixture-of-Experts family (expert parallelism over the "model" axis).
     "moe-tiny": ModelConfig(
         name="moe-tiny", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
@@ -136,6 +152,33 @@ def init_params(rng: jax.Array, cfg: ModelConfig, dtype=jnp.float32) -> dict[str
 
     def norm(key, shape, s):
         return (jax.random.normal(key, shape, jnp.float32) * s).astype(dtype)
+
+    if cfg.arch == "gpt2":
+        return {
+            "embed": {"embedding": norm(k_embed, (V, D), std)},
+            "pos_embed": {"embedding": norm(k_head, (cfg.max_seq_len, D), 0.01)},
+            "layers": {
+                "attn_norm": {"scale": jnp.ones((L, D), dtype),
+                              "bias": jnp.zeros((L, D), dtype)},
+                "q": {"kernel": norm(k_q, (L, D, H * HD), std),
+                      "bias": jnp.zeros((L, H * HD), dtype)},
+                "k": {"kernel": norm(k_k, (L, D, H * HD), std),
+                      "bias": jnp.zeros((L, H * HD), dtype)},
+                "v": {"kernel": norm(k_v, (L, D, H * HD), std),
+                      "bias": jnp.zeros((L, H * HD), dtype)},
+                "o": {"kernel": norm(k_o, (L, H * HD, D), res_std),
+                      "bias": jnp.zeros((L, D), dtype)},
+                "mlp_norm": {"scale": jnp.ones((L, D), dtype),
+                             "bias": jnp.zeros((L, D), dtype)},
+                "fc": {"kernel": norm(k_up, (L, D, F), std),
+                       "bias": jnp.zeros((L, F), dtype)},
+                "proj": {"kernel": norm(k_down, (L, F, D), res_std),
+                         "bias": jnp.zeros((L, D), dtype)},
+            },
+            "final_norm": {"scale": jnp.ones((D,), dtype),
+                           "bias": jnp.zeros((D,), dtype)},
+            # LM head is tied to the token embedding (no separate weight).
+        }
 
     layers: dict[str, Any] = {
         "attn_norm": {"scale": jnp.ones((L, D), dtype)},
@@ -167,6 +210,30 @@ def init_params(rng: jax.Array, cfg: ModelConfig, dtype=jnp.float32) -> dict[str
 
 def logical_axes(cfg: ModelConfig) -> dict[str, Any]:
     """Logical-axis tree matching :func:`init_params`' structure exactly."""
+    if cfg.arch == "gpt2":
+        return {
+            "embed": {"embedding": ("vocab", "embed")},
+            "pos_embed": {"embedding": (None, "embed")},
+            "layers": {
+                "attn_norm": {"scale": ("layers", "embed"),
+                              "bias": ("layers", "embed")},
+                "q": {"kernel": ("layers", "embed", "heads"),
+                      "bias": ("layers", "heads")},
+                "k": {"kernel": ("layers", "embed", "heads"),
+                      "bias": ("layers", "heads")},
+                "v": {"kernel": ("layers", "embed", "heads"),
+                      "bias": ("layers", "heads")},
+                "o": {"kernel": ("layers", "heads", "embed"),
+                      "bias": ("layers", "embed")},
+                "mlp_norm": {"scale": ("layers", "embed"),
+                             "bias": ("layers", "embed")},
+                "fc": {"kernel": ("layers", "embed", "mlp"),
+                       "bias": ("layers", "mlp")},
+                "proj": {"kernel": ("layers", "mlp", "embed"),
+                         "bias": ("layers", "embed")},
+            },
+            "final_norm": {"scale": ("embed",), "bias": ("embed",)},
+        }
     layers: dict[str, Any] = {
         "attn_norm": {"scale": ("layers", "embed")},
         "q": {"kernel": ("layers", "embed", "heads")},
@@ -195,6 +262,11 @@ def logical_axes(cfg: ModelConfig) -> dict[str, Any]:
 def param_count(cfg: ModelConfig) -> int:
     L, D, V, F = cfg.n_layers, cfg.d_model, cfg.vocab_size, cfg.d_ff
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    if cfg.arch == "gpt2":
+        attn = 4 * D * D + 4 * D  # q/k/v/o kernels + biases (H·HD == D)
+        mlp = 2 * D * F + F + D   # fc/proj kernels + biases
+        per_layer = attn + mlp + 4 * D  # two LayerNorms (scale + bias)
+        return V * D + cfg.max_seq_len * D + L * per_layer + 2 * D  # tied head
     mlp = 3 * D * F * (cfg.n_experts if cfg.is_moe else 1)
     router = D * cfg.n_experts if cfg.is_moe else 0
     per_layer = D * H * HD + 2 * D * KV * HD + H * HD * D + mlp + router + 2 * D
@@ -216,7 +288,12 @@ def train_flops_per_token(cfg: ModelConfig, seq_len: int) -> float:
     (12·L·D·S accounting fwd+bwd of the S×S score/value matmuls). With
     sliding-window attention each query attends at most ``sliding_window``
     keys, so the attention term uses min(S, W) — keeping MFU honest."""
-    n = active_param_count(cfg) - cfg.vocab_size * cfg.d_model  # embedding lookup is not a matmul
+    if cfg.arch == "gpt2":
+        # Tied head: the V·D weight is a real matmul at the head; only the
+        # positional-embedding lookup is not.
+        n = active_param_count(cfg) - cfg.max_seq_len * cfg.d_model
+    else:
+        n = active_param_count(cfg) - cfg.vocab_size * cfg.d_model  # embedding lookup is not a matmul
     attn_ctx = min(seq_len, cfg.sliding_window) if cfg.sliding_window else seq_len
     return 6.0 * n + 12.0 * cfg.n_layers * cfg.d_model * attn_ctx
 
@@ -231,6 +308,22 @@ def _rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
     var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
     out = x32 * lax.rsqrt(var + eps)
     return (out * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def _layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float) -> jax.Array:
+    """Mean-subtracting LayerNorm with bias (GPT-2 family)."""
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+    out = (x32 - mu) * lax.rsqrt(var + eps)
+    return (out * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def _norm(x: jax.Array, p: dict, cfg: "ModelConfig") -> jax.Array:
+    """Arch-dispatching norm: RMSNorm (llama) or LayerNorm+bias (gpt2)."""
+    if cfg.arch == "gpt2":
+        return _layer_norm(x, p["scale"], p["bias"], cfg.norm_eps)
+    return _rms_norm(x, p["scale"], cfg.norm_eps)
 
 
 def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -341,22 +434,32 @@ def _moe_mlp(h, layer_params, cfg: ModelConfig):
     return out, aux
 
 
-def _proj(h, kernel, lora_ab=None, lora_scale=1.0):
-    """Last-dim projection ``h @ W``, with an optional rank-sized LoRA term
-    ``scale·(h@A)@B`` — the activation-side formulation: only [.., r]
+def _proj(h, kernel, lora_ab=None, lora_scale=1.0, bias=None):
+    """Last-dim projection ``h @ W (+ b)``, with an optional rank-sized LoRA
+    term ``scale·(h@A)@B`` — the activation-side formulation: only [.., r]
     intermediates and rank-sized cotangents, never a full ΔW.
     h: [B, S, in], kernel: [in, out] → [B, S, out]."""
     out = jnp.einsum("bsi,io->bso", h, kernel)
+    if bias is not None:
+        out = out + bias.astype(out.dtype)
     if lora_ab is not None:
         hA = jnp.einsum("bsi,ir->bsr", h, lora_ab["A"].astype(h.dtype))
         out = out + lora_scale * jnp.einsum("bsr,ro->bso", hA, lora_ab["B"].astype(h.dtype))
     return out
 
 
-def _dense_mlp(h, layer_params, lora=None, lora_scale=1.0):
-    """SwiGLU MLP shared by the training block and the decode block.
+def _dense_mlp(h, layer_params, lora=None, lora_scale=1.0, cfg=None):
+    """MLP shared by the training block and the decode block: SwiGLU
+    (llama) or biased GELU-tanh fc/proj (gpt2).
     h: [B, S, D] (already normed) → [B, S, D]."""
     lora = lora or {}
+    if cfg is not None and cfg.arch == "gpt2":
+        h = jax.nn.gelu(
+            _proj(h, layer_params["fc"]["kernel"], lora.get("fc"), lora_scale,
+                  bias=layer_params["fc"]["bias"]),
+            approximate=True)
+        return _proj(h, layer_params["proj"]["kernel"], lora.get("proj"),
+                     lora_scale, bias=layer_params["proj"]["bias"])
     gate = _proj(h, layer_params["gate"]["kernel"], lora.get("gate"), lora_scale)
     up = _proj(h, layer_params["up"]["kernel"], lora.get("up"), lora_scale)
     return _proj(jax.nn.silu(gate) * up, layer_params["down"]["kernel"],
@@ -382,24 +485,31 @@ def _block(
     tag = checkpoint_name if tag_names else (lambda a, _name: a)
     lora = lora or {}
 
-    h = _rms_norm(x, layer_params["attn_norm"]["scale"], cfg.norm_eps)
-    q = _proj(h, layer_params["q"]["kernel"], lora.get("q"), lora_scale).reshape(B, S, H, HD)
-    k = _proj(h, layer_params["k"]["kernel"], lora.get("k"), lora_scale).reshape(B, S, KV, HD)
-    v = _proj(h, layer_params["v"]["kernel"], lora.get("v"), lora_scale).reshape(B, S, KV, HD)
-    q = tag(_rope(q, positions, cfg.rope_theta), "q")
-    k = tag(_rope(k, positions, cfg.rope_theta), "k")
-    v = tag(v, "v")
+    gpt2 = cfg.arch == "gpt2"
+    bias = (lambda name: layer_params[name]["bias"]) if gpt2 else (lambda name: None)
+    h = _norm(x, layer_params["attn_norm"], cfg)
+    q = _proj(h, layer_params["q"]["kernel"], lora.get("q"), lora_scale,
+              bias("q")).reshape(B, S, H, HD)
+    k = _proj(h, layer_params["k"]["kernel"], lora.get("k"), lora_scale,
+              bias("k")).reshape(B, S, KV, HD)
+    v = _proj(h, layer_params["v"]["kernel"], lora.get("v"), lora_scale,
+              bias("v")).reshape(B, S, KV, HD)
+    if not gpt2:  # gpt2 uses learned absolute positions, added at embed time
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+    q, k, v = tag(q, "q"), tag(k, "k"), tag(v, "v")
     attn = _attention(q, k, v, cfg.attention_impl, mesh=mesh,
                       window=cfg.sliding_window)
     attn = tag(attn.reshape(B, S, H * HD), "attn_out")
-    x = x + _proj(attn, layer_params["o"]["kernel"], lora.get("o"), lora_scale)
+    x = x + _proj(attn, layer_params["o"]["kernel"], lora.get("o"), lora_scale,
+                  bias("o"))
 
-    h = _rms_norm(x, layer_params["mlp_norm"]["scale"], cfg.norm_eps)
+    h = _norm(x, layer_params["mlp_norm"], cfg)
     if cfg.is_moe:
         mlp_out, aux = _moe_mlp(h, layer_params, cfg)
         x = x + mlp_out
         return x, aux
-    return x + _dense_mlp(h, layer_params, lora, lora_scale), jnp.zeros((), jnp.float32)
+    return x + _dense_mlp(h, layer_params, lora, lora_scale, cfg), jnp.zeros((), jnp.float32)
 
 
 _REMAT_POLICIES = {
@@ -461,17 +571,30 @@ def remat_scan_body(
     return scan_body
 
 
-def embed_tokens(params: dict[str, Any], tokens: jax.Array, compute_dtype=jnp.bfloat16) -> jax.Array:
-    """Embedding lookup: tokens [..., S] int32 → activations [..., S, D]."""
+def embed_tokens(params: dict[str, Any], tokens: jax.Array, compute_dtype=jnp.bfloat16,
+                 positions: Optional[jax.Array] = None) -> jax.Array:
+    """Embedding lookup: tokens [..., S] int32 → activations [..., S, D].
+    GPT-2-family params (a ``pos_embed`` table is present) add learned
+    absolute position embeddings — pass ``positions`` for decode offsets
+    (defaults to 0..S-1)."""
     embed = params["embed"]["embedding"].astype(compute_dtype)
-    return jnp.take(embed, tokens, axis=0)
+    x = jnp.take(embed, tokens, axis=0)
+    if "pos_embed" in params:
+        if positions is None:
+            positions = jnp.arange(tokens.shape[-1], dtype=jnp.int32)
+        wpe = params["pos_embed"]["embedding"].astype(compute_dtype)
+        x = x + jnp.take(wpe, positions, axis=0)
+    return x
 
 
 def unembed(params: dict[str, Any], x: jax.Array, cfg: ModelConfig) -> jax.Array:
-    """Final norm + LM head: activations [..., S, D] → logits [..., S, V] fp32."""
-    x = _rms_norm(x, params["final_norm"]["scale"].astype(x.dtype), cfg.norm_eps)
+    """Final norm + LM head: activations [..., S, D] → logits [..., S, V]
+    fp32. GPT-2-family models tie the head to the token embedding."""
+    x = _norm(x, jax.tree.map(lambda a: a.astype(x.dtype), params["final_norm"]), cfg)
+    head = (params["embed"]["embedding"].T if cfg.arch == "gpt2"
+            else params["lm_head"]["kernel"])
     return jnp.einsum(
-        "...sd,dv->...sv", x, params["lm_head"]["kernel"].astype(x.dtype),
+        "...sd,dv->...sv", x, head.astype(x.dtype),
         preferred_element_type=jnp.float32,
     )
 
@@ -508,10 +631,17 @@ def forward_hidden_and_aux(
     XLA saves the *master-dtype* param slices as loop residuals for the
     backward pass, costing a full fp32 copy instead of a bf16 one)."""
     B, S = tokens.shape
+    if cfg.arch == "gpt2" and S > cfg.max_seq_len:
+        # Learned position table: jnp.take would silently clamp out-of-range
+        # rows (RoPE models have no such bound).
+        raise ValueError(
+            f"seq_len {S} exceeds the learned position table "
+            f"(max_seq_len={cfg.max_seq_len}) of gpt2-family model {cfg.name!r}"
+        )
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
 
-    x = embed_tokens(params, tokens, compute_dtype)  # [B, S, D]
+    x = embed_tokens(params, tokens, compute_dtype, positions=positions)  # [B, S, D]
     layer_stack = cast_layer_stack(params, compute_dtype)
     body = remat_scan_body(cfg, positions, mesh, remat, remat_policy, lora_scale)
     xs = (layer_stack, lora["layers"]) if lora is not None else layer_stack

@@ -188,11 +188,11 @@ class TrainingJob:
         if cfg.lora_rank and cfg.lora_base_hf_checkpoint:
             from transformers import AutoModelForCausalLM
 
-            from tpu_engine.models.convert import config_from_hf, from_hf_llama
+            from tpu_engine.models.convert import config_from_hf, from_hf
 
             hf_model = AutoModelForCausalLM.from_pretrained(cfg.lora_base_hf_checkpoint)
             model_cfg = config_from_hf(hf_model.config)
-            base = from_hf_llama(hf_model.state_dict(), model_cfg)
+            base = from_hf(hf_model.state_dict(), model_cfg)
             del hf_model
             log.info(
                 "job %s: LoRA base loaded from %s (%s)",

@@ -462,7 +462,9 @@ def build_train_program(
             accum = batch.shape[0]
             B, S = batch.shape[1], batch.shape[2]
             positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
-            x_mb = tfm.embed_tokens(params, batch, compute_dtype)  # [M, B, S, D]
+            # positions also feed learned absolute embeddings (gpt2 family).
+            x_mb = tfm.embed_tokens(params, batch, compute_dtype,
+                                    positions=positions)  # [M, B, S, D]
             staged = stage_layer_stack(
                 tfm.cast_layer_stack(params, compute_dtype), pipe_size, model_cfg.n_layers
             )
