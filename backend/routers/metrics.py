@@ -1,0 +1,98 @@
+"""Prometheus exposition endpoint: ``GET /metrics``.
+
+The reference has no metrics surface at all (its observability is JSON
+endpoints polled by hand — SURVEY.md §5). This exports both telemetry
+planes — chip fleet and training jobs — in the Prometheus text format so a
+standard scraper gets them for free. Hand-rendered exposition (no client
+library in the image); label values are escaped per the format spec.
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from backend import state
+
+_PREFIX = "tpu_engine"
+
+
+def _esc(v: object) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def _line(name: str, value, labels: dict | None = None) -> str:
+    lab = ""
+    if labels:
+        inner = ",".join(f'{k}="{_esc(v)}"' for k, v in labels.items())
+        lab = "{" + inner + "}"
+    return f"{_PREFIX}_{name}{lab} {float(value)}"
+
+
+def render_metrics() -> str:
+    out: list[str] = []
+
+    # -- fleet plane --------------------------------------------------------
+    # get_fleet_status() never raises — runtime failures come back as a
+    # zero-device status with a fleet alert — so "up" keys off the device
+    # count, not an exception.
+    fleet = state.manager.get_fleet_status()
+    out.append(_line("fleet_up", 1 if fleet.total_devices > 0 else 0))
+    out.append(_line("fleet_devices_total", fleet.total_devices))
+    out.append(_line("fleet_devices_available", fleet.available_devices))
+    for d in fleet.devices:
+        lab = {"device": d.index, "kind": d.device_kind}
+        out.append(_line("device_hbm_total_bytes", d.hbm_total_gb * 2**30, lab))
+        out.append(_line("device_hbm_used_bytes", d.hbm_used_gb * 2**30, lab))
+        if d.duty_cycle_pct is not None:
+            out.append(_line("device_duty_cycle_pct", d.duty_cycle_pct, lab))
+        if d.temperature_c is not None:
+            out.append(_line("device_temperature_celsius", d.temperature_c, lab))
+
+    # -- training plane -----------------------------------------------------
+    for job in state.launcher.list_jobs():
+        lab = {"job_id": job["job_id"], "model": job["model_name"]}
+        out.append(_line("job_info", 1, {**lab, "status": job["status"]}))
+        out.append(_line("job_step", job["current_step"] or 0, lab))
+        out.append(_line("job_rollbacks_total", job["rollback_count"] or 0, lab))
+        if job.get("tokens_per_sec"):
+            out.append(_line("job_tokens_per_sec", job["tokens_per_sec"], lab))
+        mon = job.get("monitor") or {}
+        if mon.get("current_loss") is not None:
+            out.append(_line("job_loss", mon["current_loss"], lab))
+        out.append(_line("job_alerts_total", mon.get("total_alerts") or 0, lab))
+        for kind, n in (mon.get("alerts_by_type") or {}).items():
+            out.append(_line("job_alerts_by_type_total", n, {**lab, "type": kind}))
+        prof = job.get("profile") or {}
+        if prof.get("mfu") is not None:
+            out.append(_line("job_mfu", prof["mfu"], lab))
+
+    # External jobs pushing metrics over HTTP ingest (their monitors live in
+    # the standalone registry, not the supervisor).
+    for job_id in state.list_monitored_jobs():
+        if state.is_supervised(job_id):
+            continue  # already exported above
+        mon = state.get_monitor(job_id)
+        if mon is None:
+            continue
+        summary = mon.get_summary()
+        lab = {"job_id": job_id, "model": "external"}
+        out.append(_line("job_info", 1, {**lab, "status": "external"}))
+        if summary.get("current_loss") is not None:
+            out.append(_line("job_loss", summary["current_loss"], lab))
+        out.append(_line("job_alerts_total", summary.get("total_alerts") or 0, lab))
+        for kind, n in (summary.get("alerts_by_type") or {}).items():
+            out.append(_line("job_alerts_by_type_total", n, {**lab, "type": kind}))
+    return "\n".join(out) + "\n"
+
+
+async def metrics(request: web.Request) -> web.Response:
+    return web.Response(
+        text=render_metrics(),
+        content_type="text/plain",
+        charset="utf-8",
+    )
+
+
+def setup(app: web.Application) -> None:
+    # Conventional scrape path is unprefixed /metrics.
+    app.router.add_get("/metrics", metrics)

@@ -526,3 +526,36 @@ def test_text_generation_and_job_delete(client, tmp_path_factory):
     assert client.delete(f"/api/v1/training/jobs/{job_id}").status_code == 200
     assert client.get(f"/api/v1/training/jobs/{job_id}").status_code == 404
     assert client.delete(f"/api/v1/training/jobs/{job_id}").status_code == 404
+
+
+def test_prometheus_metrics_endpoint(client):
+    """/metrics exports both telemetry planes in Prometheus text format."""
+    # Launch a tiny job so the training plane has something to export.
+    r = client.post("/api/v1/training/launch", json={
+        "model_name": "gpt-tiny", "mesh": {"data": 2, "fsdp": 4},
+        "micro_batch_size": 1, "seq_len": 32, "precision": "fp32",
+        "total_steps": 3, "warmup_steps": 1, "dry_run": False, "block": True,
+    })
+    assert r.status_code == 200, r.text
+    job_id = r.json()["job_id"]
+
+    m = client.get("/metrics")
+    assert m.status_code == 200
+    assert m.headers["content-type"].startswith("text/plain")
+    body = m.text
+    assert "tpu_engine_fleet_up 1" in body
+    assert "tpu_engine_fleet_devices_total" in body
+    assert f'tpu_engine_job_step{{job_id="{job_id}",model="gpt-tiny"}}' in body
+    assert f'tpu_engine_job_info{{job_id="{job_id}",model="gpt-tiny",status=' in body
+    # External HTTP-ingest jobs are exported too (second namespace).
+    r2 = client.post("/api/v1/monitoring/ingest/single", json={
+        "job_id": "ext-scrape-job", "step": 1, "loss": 2.5,
+        "learning_rate": 1e-4,
+    })
+    assert r2.status_code == 200, r2.text
+    body = client.get("/metrics").text
+    assert 'tpu_engine_job_loss{job_id="ext-scrape-job",model="external"} 2.5' in body
+    # Every line parses as "name{labels} value" with a float value.
+    for line in body.strip().splitlines():
+        assert line.startswith("tpu_engine_"), line
+        float(line.rsplit(" ", 1)[1])
