@@ -255,6 +255,10 @@ class GenerateRequest(BaseModel):
     top_k: Optional[int] = Field(default=None, ge=1)
     top_p: Optional[float] = Field(default=None, gt=0.0, le=1.0)
     seed: int = 0
+    # Speculative decoding: a local HF checkpoint directory holding a small
+    # draft model (same tokenizer/vocab). Greedy only, single prompt row.
+    draft_hf_checkpoint: Optional[str] = None
+    gamma: int = Field(default=4, ge=1, le=16)
 
 
 _tokenizer_cache: dict[tuple[str, int], Any] = {}
@@ -342,6 +346,32 @@ async def generate_from_job(request: web.Request) -> web.Response:
         raise ApiError(422, "provide exactly one of prompt_tokens | prompt_text")
     if req.prompt_text is not None and not req.tokenizer_json:
         raise ApiError(422, "prompt_text requires tokenizer_json")
+
+    if req.draft_hf_checkpoint is not None:
+        # Speculative decoding: greedy, single token-prompt row.
+        if req.temperature != 0.0:
+            raise ApiError(422, "speculative decoding is greedy (temperature=0)")
+        if req.prompt_tokens is None or len(req.prompt_tokens) != 1:
+            raise ApiError(422, "speculative decoding takes one prompt_tokens row")
+
+        try:
+            tokens, rounds = await asyncio.to_thread(
+                job.speculative_sample,
+                req.prompt_tokens[0],
+                draft_hf_checkpoint=req.draft_hf_checkpoint,
+                max_new_tokens=req.max_new_tokens,
+                gamma=req.gamma,
+            )
+        except (ValueError, RuntimeError, OSError, KeyError, AttributeError) as e:
+            # KeyError/AttributeError: an HF checkpoint whose state dict does
+            # not match a supported architecture (convert raises KeyError).
+            raise ApiError(422, str(e))
+        return json_response({
+            "job_id": job_id,
+            "tokens": [tokens],
+            "target_forward_passes": rounds,
+            "speculative": True,
+        })
 
     def sample(rows: list[list[int]]) -> list[list[int]]:
         return job.generate_sample(

@@ -530,14 +530,29 @@ def test_text_generation_and_job_delete(client, tmp_path_factory):
 
 def test_prometheus_metrics_endpoint(client):
     """/metrics exports both telemetry planes in Prometheus text format."""
+    # Admission cap is 1: wait for earlier tests' jobs to finish first.
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        jobs = client.get("/api/v1/training/jobs").json()["jobs"]
+        if all(j["status"] in ("completed", "failed", "stopped") for j in jobs):
+            break
+        time.sleep(1)
     # Launch a tiny job so the training plane has something to export.
     r = client.post("/api/v1/training/launch", json={
         "model_name": "gpt-tiny", "mesh": {"data": 2, "fsdp": 4},
         "micro_batch_size": 1, "seq_len": 32, "precision": "fp32",
-        "total_steps": 3, "warmup_steps": 1, "dry_run": False, "block": True,
+        "total_steps": 3, "warmup_steps": 1, "dry_run": False,
     })
-    assert r.status_code == 200, r.text
+    assert r.status_code == 200 and r.json()["status"] == "launched", r.text
     job_id = r.json()["job_id"]
+    deadline = time.time() + 240  # fresh budget for this job's completion
+    body = {}
+    while time.time() < deadline:
+        body = client.get(f"/api/v1/training/jobs/{job_id}").json()
+        if body.get("status") in ("completed", "failed"):
+            break
+        time.sleep(1)
+    assert body.get("status") == "completed", body
 
     m = client.get("/metrics")
     assert m.status_code == 200
@@ -559,3 +574,61 @@ def test_prometheus_metrics_endpoint(client):
     for line in body.strip().splitlines():
         assert line.startswith("tpu_engine_"), line
         float(line.rsplit(" ", 1)[1])
+
+
+def test_speculative_generate_over_http(client, tmp_path_factory):
+    """End-to-end over HTTP only: train a job, export its weights as an HF
+    checkpoint, use that export as the speculative draft (a perfect draft),
+    and check the output equals plain greedy generation in the minimum
+    number of target forward passes."""
+    # Admission cap is 1: wait for jobs from earlier tests to reach a
+    # terminal state before launching.
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        jobs = client.get("/api/v1/training/jobs").json()["jobs"]
+        if all(j["status"] in ("completed", "failed", "stopped") for j in jobs):
+            break
+        time.sleep(1)
+    r = client.post("/api/v1/training/launch", json={
+        "model_name": "gpt-tiny", "mesh": {"data": 2, "fsdp": 4},
+        "micro_batch_size": 1, "seq_len": 32, "precision": "fp32",
+        "total_steps": 3, "warmup_steps": 1, "dry_run": False,
+    })
+    assert r.status_code == 200 and r.json()["status"] == "launched", r.text
+    job_id = r.json()["job_id"]
+    deadline = time.time() + 240
+    body = {}
+    while time.time() < deadline:
+        body = client.get(f"/api/v1/training/jobs/{job_id}").json()
+        if body.get("status") in ("completed", "failed"):
+            break
+        time.sleep(1)
+    assert body.get("status") == "completed", body
+
+    out_dir = str(tmp_path_factory.mktemp("spec-draft"))
+    r = client.post(f"/api/v1/training/jobs/{job_id}/export",
+                    json={"out_dir": out_dir}, timeout=120)
+    assert r.status_code == 200, r.text
+
+    prompt = [[3, 1, 4, 1, 5]]
+    greedy = client.post(f"/api/v1/training/jobs/{job_id}/generate", json={
+        "prompt_tokens": prompt, "max_new_tokens": 14,
+    }, timeout=180)
+    assert greedy.status_code == 200, greedy.text
+
+    spec = client.post(f"/api/v1/training/jobs/{job_id}/generate", json={
+        "prompt_tokens": prompt, "max_new_tokens": 14,
+        "draft_hf_checkpoint": out_dir, "gamma": 4,
+    }, timeout=300)
+    assert spec.status_code == 200, spec.text
+    body = spec.json()
+    assert body["speculative"] is True
+    assert body["tokens"] == greedy.json()["tokens"]
+    assert body["target_forward_passes"] == 3  # ceil(14 / (gamma+1))
+
+    # Sampling params are rejected for the speculative path.
+    bad = client.post(f"/api/v1/training/jobs/{job_id}/generate", json={
+        "prompt_tokens": prompt, "max_new_tokens": 4,
+        "draft_hf_checkpoint": out_dir, "temperature": 0.7,
+    })
+    assert bad.status_code == 422

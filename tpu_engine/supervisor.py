@@ -607,6 +607,50 @@ class TrainingJob:
                 )
         return [[int(t) for t in jax.device_get(o)[0]] for o in outs]
 
+    def speculative_sample(
+        self,
+        prompt_tokens: list[int],
+        draft_hf_checkpoint: str,
+        max_new_tokens: int = 32,
+        gamma: int = 4,
+    ) -> tuple[list[int], int]:
+        """Greedy speculative decoding from the job's current weights with a
+        small draft model loaded from a local HF checkpoint directory
+        (cached per path). Returns (prompt+continuation ids, verification
+        rounds — i.e. target forward passes taken).
+        """
+        import jax.numpy as jnp
+
+        from tpu_engine.generate import speculative_generate
+
+        if self.program is None or self._state is None:
+            raise RuntimeError("job has no initialized state to sample from")
+        if not prompt_tokens:
+            raise ValueError("prompt must be non-empty")
+        model_cfg = self.program.model_config
+        vocab = model_cfg.vocab_size
+        if any(t < 0 or t >= vocab for t in prompt_tokens):
+            raise ValueError(f"prompt token id out of range [0, {vocab})")
+        draft_params, draft_cfg = _load_draft(
+            draft_hf_checkpoint, self.program.config.compute_dtype()
+        )
+        if draft_cfg.vocab_size != model_cfg.vocab_size:
+            raise ValueError(
+                f"draft vocab ({draft_cfg.vocab_size}) != target vocab "
+                f"({model_cfg.vocab_size}); speculative verification needs a "
+                "shared tokenizer"
+            )
+        prompt = jnp.asarray([prompt_tokens], jnp.int32)
+        with self._state_lock:
+            params = self._full_params_locked()
+            out, rounds = speculative_generate(
+                params, draft_params, prompt, model_cfg, draft_cfg,
+                max_new_tokens=max_new_tokens, gamma=gamma,
+                compute_dtype=self.program.config.compute_dtype(),
+                return_stats=True,
+            )
+        return [int(t) for t in jax.device_get(out)[0]], rounds
+
     def _full_params_locked(self):
         """Full model params for the current step (caller holds _state_lock):
         the trainable tree itself, or (LoRA) base+adapters merged — cached
@@ -676,3 +720,41 @@ class TrainingJob:
             "latest_perplexity": _perplexity(loss),
             "history": [{"step": s, "loss": l} for s, l in self.eval_history],
         }
+
+
+# -- speculative-draft cache -------------------------------------------------
+
+_draft_cache: dict[tuple[str, int, str], tuple] = {}
+_DRAFT_CACHE_MAX = 4
+
+
+def _load_draft(path: str, compute_dtype):
+    """Load (and cache) a draft model from a local HF checkpoint directory
+    for speculative decoding. Cached per (path, mtime, dtype) — a re-export
+    to the same directory refreshes the draft; the cache is tiny because
+    drafts are meant to be small."""
+    import os
+
+    import jax.numpy as jnp
+
+    if not os.path.isdir(path):
+        raise ValueError(
+            f"draft_hf_checkpoint {path!r} is not a local directory "
+            "(hub repo ids are not fetched)"
+        )
+    key = (path, os.stat(path).st_mtime_ns, jnp.dtype(compute_dtype).name)
+    hit = _draft_cache.get(key)
+    if hit is not None:
+        return hit
+    from transformers import AutoModelForCausalLM
+
+    from tpu_engine.models.convert import config_from_hf, from_hf
+
+    hf_model = AutoModelForCausalLM.from_pretrained(path, local_files_only=True)
+    cfg = config_from_hf(hf_model.config)
+    params = from_hf(hf_model.state_dict(), cfg, dtype=compute_dtype)
+    del hf_model
+    if len(_draft_cache) >= _DRAFT_CACHE_MAX:
+        _draft_cache.pop(next(iter(_draft_cache)))
+    _draft_cache[key] = (params, cfg)
+    return params, cfg
