@@ -196,3 +196,27 @@ def test_tokenize_rejects_overflow(tmp_path):
     text.write_text("hello\n")
     with pytest.raises(ValueError, match="int32"):
         tokenize_text_file(str(text), str(tmp_path / "o.bin"), FakeTok())
+
+
+def test_pack_sft_examples():
+    from tpu_engine.data import pack_sft_examples
+
+    rows = pack_sft_examples([([5, 6], [7, 8, 9])], seq_len=8)
+    assert rows.dtype == np.int32 and rows.shape == (1, 8)
+    # prompt stored as -(t+1), completion as-is, padding as -1
+    assert rows[0].tolist() == [-6, -7, 7, 8, 9, -1, -1, -1]
+    with pytest.raises(ValueError, match="exceeds seq_len"):
+        pack_sft_examples([([1] * 6, [2] * 6)], seq_len=8)
+    with pytest.raises(ValueError, match=">= 0"):
+        pack_sft_examples([([-1], [2])], seq_len=8)
+
+
+def test_write_token_file_rejects_out_of_range(tmp_path):
+    from tpu_engine.data import pack_sft_examples, write_token_file
+
+    rows = pack_sft_examples([([5], [7, 8])], seq_len=4)
+    with pytest.raises(ValueError, match="int32"):
+        write_token_file(rows.reshape(-1), str(tmp_path / "bad.bin"))  # uint16
+    write_token_file(rows.reshape(-1), str(tmp_path / "ok.bin"), dtype="int32")
+    with pytest.raises(ValueError, match="do not fit"):
+        write_token_file(np.array([70000]), str(tmp_path / "big.bin"))

@@ -214,3 +214,92 @@ def test_gpt2_arch_trains():
     cfg = tiny_config(model_name="gpt2-tiny", mesh=MeshConfig(data=2, fsdp=2, model=2))
     _, _, losses = run_steps(cfg, n=8)
     assert losses[-1] < losses[0] * 0.7, losses
+
+
+# -- SFT loss masking --------------------------------------------------------
+
+
+def _sft_batch(vocab=512, B=8, S=32, accum=2, seed=0):
+    """An [accum, B, S] batch of SFT-packed rows (in-band -(t+1) masking)."""
+    from tpu_engine.data import pack_sft_examples
+
+    rng = np.random.default_rng(seed)
+    pairs = []
+    for _ in range(accum * B):
+        p = rng.integers(0, vocab, rng.integers(4, 12)).tolist()
+        c = rng.integers(0, vocab, rng.integers(4, S - 16)).tolist()
+        pairs.append((p, c))
+    return jnp.asarray(pack_sft_examples(pairs, S).reshape(accum, B, S))
+
+
+def test_sft_masked_loss_matches_manual():
+    """eval_step on an SFT-packed batch == the GLOBAL valid-target mean CE
+    computed by hand — after training, where microbatches have uneven
+    valid counts and per-token losses differ, so a mean of per-microbatch
+    means would NOT match (the accumulation paths must divide once by the
+    batch-wide count, not average per-microbatch means)."""
+    cfg = tiny_config(activation_checkpointing=False)
+    prog = build_train_program(cfg)
+    state = prog.init(jax.random.PRNGKey(0))
+    batch = _sft_batch()
+    for _ in range(6):  # train so per-token losses are non-uniform
+        state, _ = prog.step(state, batch)
+    got = float(prog.eval_step(state, batch))
+
+    from tpu_engine.train import decode_masked_tokens
+
+    raw = batch.reshape(-1, batch.shape[-1])
+    clean, loss_view = decode_masked_tokens(raw)
+    params = jax.device_get(state["params"])
+    logits = tfm.forward(params, clean, tfm.MODEL_CONFIGS["gpt-tiny"],
+                         compute_dtype=jnp.float32)
+    tgt = np.asarray(loss_view[:, 1:])
+    logp = jax.nn.log_softmax(np.asarray(logits[:, :-1], np.float32), axis=-1)
+    valid = tgt >= 0
+    ll = np.take_along_axis(np.asarray(logp), np.maximum(tgt, 0)[..., None], -1)[..., 0]
+    manual = -(ll * valid).sum() / valid.sum()
+    np.testing.assert_allclose(got, manual, rtol=1e-4)
+
+
+def test_sft_chunked_matches_unchunked():
+    batch = _sft_batch()
+    a = build_train_program(tiny_config(activation_checkpointing=False))
+    b = build_train_program(tiny_config(activation_checkpointing=False, loss_chunk_size=8))
+    sa = a.init(jax.random.PRNGKey(0))
+    sb = b.init(jax.random.PRNGKey(0))
+    np.testing.assert_allclose(
+        float(a.eval_step(sa, batch)), float(b.eval_step(sb, batch)), rtol=1e-6
+    )
+
+
+def test_sft_pipeline_matches_accumulation():
+    batch = _sft_batch(accum=2)
+    pipe = build_train_program(tiny_config(mesh=MeshConfig(data=2, fsdp=2, pipe=2)))
+    ref = build_train_program(tiny_config(mesh=MeshConfig(data=2, fsdp=2, model=2)))
+    sp = pipe.init(jax.random.PRNGKey(0))
+    sr = ref.init(jax.random.PRNGKey(0))
+    np.testing.assert_allclose(
+        float(pipe.eval_step(sp, batch)), float(ref.eval_step(sr, batch)), rtol=2e-5
+    )
+
+
+def test_sft_fully_masked_batch_is_finite():
+    """A batch with zero valid targets yields loss 0, not NaN."""
+    cfg = tiny_config(activation_checkpointing=False)
+    prog = build_train_program(cfg)
+    state = prog.init(jax.random.PRNGKey(0))
+    raw = jnp.full((2, 8, 32), -1, jnp.int32)  # all masked (context token 0)
+    assert float(prog.eval_step(state, raw)) == 0.0
+
+
+def test_sft_training_learns_completions_only():
+    """Training on SFT-packed rows drives completion loss down."""
+    cfg = tiny_config(activation_checkpointing=False)
+    prog = build_train_program(cfg)
+    state = prog.init(jax.random.PRNGKey(0))
+    batch = _sft_batch()
+    losses = []
+    for _ in range(8):
+        state, m = prog.step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.8, losses

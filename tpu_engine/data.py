@@ -30,9 +30,25 @@ _NP_DTYPES = {"uint16": np.uint16, "int32": np.int32}
 
 
 def write_token_file(tokens: np.ndarray, path: str, dtype: str = "uint16") -> str:
-    """Serialize a 1-D token array to the flat binary format both readers use."""
-    arr = np.asarray(tokens).astype(_NP_DTYPES[dtype])
-    arr.tofile(path)
+    """Serialize a 1-D token array to the flat binary format both readers use.
+
+    Values outside the target dtype's range are rejected rather than
+    silently wrapped — in particular, SFT-masked streams
+    (:func:`pack_sft_examples`) carry negative codes and must be written
+    with ``dtype="int32"``; a uint16 cast would corrupt every masked
+    position into a large positive token id with no error anywhere
+    downstream.
+    """
+    arr = np.asarray(tokens)
+    info = np.iinfo(_NP_DTYPES[dtype])
+    lo, hi = int(arr.min(initial=0)), int(arr.max(initial=0))
+    if lo < info.min or hi > info.max:
+        raise ValueError(
+            f"token values [{lo}, {hi}] do not fit dtype {dtype} "
+            f"[{info.min}, {info.max}]"
+            + ("; SFT-masked streams need dtype='int32'" if lo < 0 else "")
+        )
+    arr.astype(_NP_DTYPES[dtype]).tofile(path)
     return path
 
 
@@ -293,3 +309,34 @@ def make_eval_data_fn(program: Any, dataset: "TokenFileDataset") -> Callable[[in
         return _place_global(flat.reshape(accum, global_micro, seq_len), sharding)
 
     return eval_fn
+
+
+# -- SFT packing -------------------------------------------------------------
+
+
+def pack_sft_examples(
+    pairs: "list[tuple[list[int], list[int]]]", seq_len: int
+) -> np.ndarray:
+    """Pack (prompt, completion) token pairs into fixed-length rows with
+    in-band loss masking: prompt tokens are stored as ``-(t+1)`` (real
+    context whose prediction is not trained on), completion tokens as-is,
+    and padding as ``-1`` (masked token 0). The loss then trains only on
+    predicting the completion — the standard SFT objective.
+
+    The result is ``[n, seq_len] int32``; write it with
+    :func:`write_token_file` using ``dtype="int32"`` (the masked encoding
+    needs the sign bit — uint16 streams cannot carry masks).
+    """
+    rows = np.full((len(pairs), seq_len), -1, np.int32)
+    for i, (prompt, completion) in enumerate(pairs):
+        if any(t < 0 for t in prompt) or any(t < 0 for t in completion):
+            raise ValueError(f"pair {i}: token ids must be >= 0")
+        seq = [-(t + 1) for t in prompt] + list(completion)
+        if len(seq) > seq_len:
+            raise ValueError(
+                f"pair {i}: prompt+completion is {len(seq)} tokens, "
+                f"exceeds seq_len={seq_len} (truncating would silently "
+                "change the example; split or shorten it)"
+            )
+        rows[i, : len(seq)] = np.asarray(seq, np.int32)
+    return rows

@@ -121,42 +121,75 @@ def make_optimizer(cfg: TPUTrainConfig) -> tuple[optax.GradientTransformation, o
     return tx, schedule
 
 
+def _ce_sums(
+    logits: jax.Array, tokens: jax.Array
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Raw next-token CE sums: (Σ log-likelihood, Σ logZ², valid count).
+
+    Positions whose *target* token is negative are excluded (the in-band
+    SFT masking convention — see ``decode_masked_tokens``). Returning sums
+    lets the caller choose the normaliser — per-call mean (``lm_loss``) or
+    a global valid-target count across gradient-accumulation microbatches
+    (the train/eval steps), which keeps the objective the documented
+    global mean rather than a mean of per-microbatch means.
+    """
+    targets = tokens[:, 1:]
+    valid = (targets >= 0).astype(jnp.float32)
+    logits = logits[:, :-1, :].astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)  # [B, S-1]
+    logp = logits - logz[..., None]
+    ll = jnp.take_along_axis(
+        logp, jnp.maximum(targets, 0)[..., None], axis=-1
+    ).squeeze(-1)
+    return (
+        jnp.sum(ll * valid),
+        jnp.sum(jnp.square(logz) * valid),
+        jnp.sum(valid),
+    )
+
+
 def lm_loss(
     logits: jax.Array, tokens: jax.Array, z_loss_coef: float = 0.0
 ) -> jax.Array:
-    """Next-token cross-entropy in fp32. logits [B,S,V], tokens [B,S].
+    """Next-token cross-entropy in fp32. logits [B,S,V], tokens [B,S]:
+    the mean over this call's valid targets (masked targets excluded).
 
     ``z_loss_coef > 0`` adds the PaLM-style logit-normaliser penalty
     ``coef·mean(log Z²)``, keeping softmax logits from drifting — the
     standard bf16-training stabiliser.
     """
-    targets = tokens[:, 1:]
-    logits = logits[:, :-1, :].astype(jnp.float32)
-    logz = jax.nn.logsumexp(logits, axis=-1)  # [B, S-1]
-    logp = logits - logz[..., None]
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
-    loss = -jnp.mean(ll)
+    ll_sum, z_sum, n_valid = _ce_sums(logits, tokens)
+    denom = jnp.maximum(n_valid, 1.0)
+    loss = -ll_sum / denom
     if z_loss_coef:
-        loss = loss + z_loss_coef * jnp.mean(jnp.square(logz))
+        loss = loss + z_loss_coef * z_sum / denom
     return loss
 
 
-def chunked_lm_loss(
+def decode_masked_tokens(raw: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """In-band SFT loss masking: a position stored as ``-(token+1)`` is a
+    real context token whose *prediction* must not be trained on (prompt
+    tokens, padding). Returns (clean tokens for the forward pass, loss-view
+    tokens where masked positions are ``-1`` so both loss paths skip them
+    as targets). A no-op (identity, empty mask) for ordinary streams."""
+    masked = raw < 0
+    clean = jnp.where(masked, -raw - 1, raw)
+    return clean, jnp.where(masked, -1, raw)
+
+
+def _chunked_ce_sums(
     params: Any,
     hidden: jax.Array,
     tokens: jax.Array,
     model_cfg: tfm.ModelConfig,
     chunk: int,
-    z_loss_coef: float = 0.0,
-) -> jax.Array:
-    """Next-token cross-entropy computed ``chunk`` sequence positions at a
-    time, so the full fp32 [B, S, V] logits tensor is never materialised
-    (at 1B scale that buffer plus its softmax temp is ~4 GB of HBM — often
-    the difference between fitting a config and not). The chunk body is
-    wrapped in ``jax.checkpoint`` so the backward pass recomputes each
-    chunk's logits instead of keeping them alive.
-
-    Numerically identical to ``lm_loss(unembed(params, hidden), tokens)``.
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Raw CE sums (see :func:`_ce_sums`) computed ``chunk`` sequence
+    positions at a time, so the full fp32 [B, S, V] logits tensor is never
+    materialised (at 1B scale that buffer plus its softmax temp is ~4 GB of
+    HBM — often the difference between fitting a config and not). The chunk
+    body is wrapped in ``jax.checkpoint`` so the backward pass recomputes
+    each chunk's logits instead of keeping them alive.
     """
     B, S, D = hidden.shape
     n_chunks = S // chunk
@@ -175,18 +208,38 @@ def chunked_lm_loss(
         ll = jnp.take_along_axis(
             logp, jnp.maximum(tc, 0)[..., None].astype(jnp.int32), axis=-1
         ).squeeze(-1)
-        ll_sum, z_sum = acc
+        ll_sum, z_sum, n_sum = acc
         return (
             ll_sum + jnp.sum(ll * mask),
             z_sum + jnp.sum(jnp.square(logz) * mask),
+            n_sum + jnp.sum(mask.astype(jnp.float32)),
         ), None
 
-    (ll_total, z_total), _ = jax.lax.scan(
+    (ll_total, z_total, n_total), _ = jax.lax.scan(
         jax.checkpoint(body),
-        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
+         jnp.zeros((), jnp.float32)),
         (h, tgt),
     )
-    denom = B * (S - 1)
+    return ll_total, z_total, n_total
+
+
+def chunked_lm_loss(
+    params: Any,
+    hidden: jax.Array,
+    tokens: jax.Array,
+    model_cfg: tfm.ModelConfig,
+    chunk: int,
+    z_loss_coef: float = 0.0,
+) -> jax.Array:
+    """Chunked next-token cross-entropy — numerically identical to
+    ``lm_loss(unembed(params, hidden), tokens)`` (masked targets excluded
+    from the mean), with the flash-memory profile of
+    :func:`_chunked_ce_sums`."""
+    ll_total, z_total, n_total = _chunked_ce_sums(
+        params, hidden, tokens, model_cfg, chunk
+    )
+    denom = jnp.maximum(n_total, 1.0)
     loss = -ll_total / denom
     if z_loss_coef:
         loss = loss + z_loss_coef * z_total / denom
@@ -406,7 +459,19 @@ def build_train_program(
     seq_ax = "sequence" if runtime.axis_sizes["sequence"] > 1 else None
     batch_sharding = NamedSharding(mesh, P(None, BATCH_AXES, seq_ax))
 
-    def loss_fn(params, tokens, include_aux: bool = True, lora_params=None):
+    def loss_fn(params, raw_tokens, include_aux: bool = True, lora_params=None,
+                denom=None, aux_weight: float = 1.0):
+        """Masked LM loss for one microbatch.
+
+        ``denom=None`` → this microbatch's own valid-target mean. With a
+        ``denom`` (the batch-wide valid count), returns raw sums divided by
+        it, so summing over microbatches yields the *global* valid-target
+        mean — not a mean of per-microbatch means, which would up-weight
+        tokens in sparsely-supervised (heavily masked) microbatches.
+        ``aux_weight`` scales the MoE router term (1/accum when summing).
+        """
+        # In-band SFT masking: -(t+1) positions are context-only (no loss).
+        tokens, loss_tokens = decode_masked_tokens(raw_tokens)
         hidden, aux = tfm.forward_hidden_and_aux(
             params,
             tokens,
@@ -422,13 +487,19 @@ def build_train_program(
         # so eval_step reports pure cross-entropy.
         z_coef = cfg.z_loss_coef if include_aux else 0.0
         if cfg.loss_chunk_size:
-            loss = chunked_lm_loss(
-                params, hidden, tokens, model_cfg, cfg.loss_chunk_size, z_coef
+            ll_sum, z_sum, n_valid = _chunked_ce_sums(
+                params, hidden, loss_tokens, model_cfg, cfg.loss_chunk_size
             )
         else:
-            loss = lm_loss(tfm.unembed(params, hidden, model_cfg), tokens, z_coef)
+            ll_sum, z_sum, n_valid = _ce_sums(
+                tfm.unembed(params, hidden, model_cfg), loss_tokens
+            )
+        d = jnp.maximum(n_valid, 1.0) if denom is None else denom
+        loss = -ll_sum / d
+        if z_coef:
+            loss = loss + z_coef * z_sum / d
         if model_cfg.is_moe and include_aux:
-            loss = loss + model_cfg.router_aux_coef * aux
+            loss = loss + aux_weight * model_cfg.router_aux_coef * aux
         return loss
 
     if use_lora:
@@ -436,8 +507,11 @@ def build_train_program(
         # projection (h@A@B — never a full ΔW, so cotangents stay
         # rank-sized). The frozen base enters the compiled step as captured
         # constants.
-        def train_loss_fn(adapter_params, tokens, include_aux: bool = True):
-            return loss_fn(base_params, tokens, include_aux, lora_params=adapter_params)
+        def train_loss_fn(adapter_params, tokens, include_aux: bool = True,
+                          denom=None, aux_weight: float = 1.0):
+            return loss_fn(base_params, tokens, include_aux,
+                           lora_params=adapter_params, denom=denom,
+                           aux_weight=aux_weight)
     else:
         train_loss_fn = loss_fn
 
@@ -458,7 +532,9 @@ def build_train_program(
         )
         buf_sh = NamedSharding(mesh, P("pipe", BATCH_AXES, seq_ax))
 
-        def pipe_loss_fn(params, batch, include_aux: bool = True):
+        def pipe_loss_fn(params, raw_batch, include_aux: bool = True):
+            # In-band SFT masking, as in loss_fn.
+            batch, loss_batch = decode_masked_tokens(raw_batch)
             accum = batch.shape[0]
             B, S = batch.shape[1], batch.shape[2]
             positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
@@ -481,20 +557,25 @@ def build_train_program(
             )
 
             z_coef = cfg.z_loss_coef if include_aux else 0.0
+            # Batch-wide valid-target count: one division at the end, so the
+            # objective is the global masked mean (see loss_fn).
+            denom = jnp.maximum(
+                jnp.sum((loss_batch[:, :, 1:] >= 0).astype(jnp.float32)), 1.0
+            )
 
             def loss_body(acc, xs):
                 out, toks = xs
                 if cfg.loss_chunk_size:
-                    return acc + chunked_lm_loss(
-                        params, out, toks, model_cfg, cfg.loss_chunk_size, z_coef
-                    ), None
-                return acc + lm_loss(
-                    tfm.unembed(params, out, model_cfg), toks, z_coef
-                ), None
+                    ll, zz, _ = _chunked_ce_sums(
+                        params, out, toks, model_cfg, cfg.loss_chunk_size
+                    )
+                else:
+                    ll, zz, _ = _ce_sums(tfm.unembed(params, out, model_cfg), toks)
+                return acc + (-ll + z_coef * zz), None
 
             body = jax.checkpoint(loss_body) if cfg.activation_checkpointing else loss_body
-            loss_sum, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (outputs, batch))
-            loss = loss_sum / accum
+            loss_sum, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (outputs, loss_batch))
+            loss = loss_sum / denom
             if model_cfg.is_moe and include_aux:
                 loss = loss + model_cfg.router_aux_coef * aux_mean
             return loss
@@ -509,10 +590,18 @@ def build_train_program(
             grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
             grads = jax.lax.with_sharding_constraint(grads, grad_sh)
         else:
+            accum = batch.shape[0]
+            # Batch-wide valid-target count (masked SFT targets excluded):
+            # each microbatch contributes raw sums / this denominator, so
+            # the summed loss and grads realise the global mean.
+            denom = jnp.maximum(
+                jnp.sum((batch[:, :, 1:] >= 0).astype(jnp.float32)), 1.0
+            )
 
             def accum_body(carry, tokens):
                 loss_acc, grad_acc = carry
-                loss, grads = grad_fn(params, tokens)
+                loss, grads = grad_fn(params, tokens, True, denom=denom,
+                                      aux_weight=1.0 / accum)
                 grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
                 # Stage >= 2: constrain accumulated grads to fsdp shards so XLA
                 # reduce-scatters instead of all-reducing (ZeRO-2 semantics).
@@ -522,13 +611,10 @@ def build_train_program(
 
             zero_grads = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
             zero_grads = jax.lax.with_sharding_constraint(zero_grads, grad_sh)
-            (loss_sum, grad_sum), _ = jax.lax.scan(
+            (loss, grad_sum), _ = jax.lax.scan(
                 accum_body, (jnp.zeros((), jnp.float32), zero_grads), batch
             )
-
-            accum = batch.shape[0]
-            loss = loss_sum / accum
-            grads = jax.tree.map(lambda g: g / accum, grad_sum)
+            grads = grad_sum
         grad_norm = optax.global_norm(grads)
 
         lr = schedule(state["step"]).astype(jnp.float32) * state["lr_scale"]
@@ -563,11 +649,16 @@ def build_train_program(
         if pipe_size > 1:
             return pipe_loss_fn(params, batch, include_aux=False)
 
+        denom = jnp.maximum(
+            jnp.sum((batch[:, :, 1:] >= 0).astype(jnp.float32)), 1.0
+        )
+
         def body(acc, tokens):
-            return acc + train_loss_fn(params, tokens, include_aux=False), None
+            return acc + train_loss_fn(params, tokens, include_aux=False,
+                                       denom=denom), None
 
         loss_sum, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), batch)
-        return loss_sum / batch.shape[0]
+        return loss_sum
 
     jit_eval = jax.jit(
         eval_step, in_shardings=(state_shardings, batch_sharding), out_shardings=None
