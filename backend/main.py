@@ -36,9 +36,17 @@ async def root(request: web.Request) -> web.Response:
                 "tensor ('model'), pipeline ('pipe'), sequence (ring or "
                 "all-to-all 'ulysses'), and expert parallelism on one mesh; "
                 "multislice DCN data parallelism (dcn_data)",
+                "three model families (Llama/RoPE, Mistral sliding-window, "
+                "GPT-2) plus Mixtral-shape MoE, one sharded engine",
+                "first-party Pallas flash attention (fwd+bwd, causal block "
+                "skipping, O(S*W) sliding window)",
+                "SFT loss masking (in-band -(t+1) encoding; global "
+                "valid-target objective)",
                 "LoRA fine-tuning over frozen HF base checkpoints; "
-                "bidirectional HF Llama checkpoint conversion and export",
-                "KV-cache generation (token or text in/out) from live jobs",
+                "bidirectional HF Llama/Mistral/GPT-2 conversion and export",
+                "KV-cache generation (token or text in/out) from live jobs; "
+                "ring-buffer cache for windowed models; speculative decoding "
+                "with a draft checkpoint (HTTP: draft_hf_checkpoint)",
                 "held-out evaluation (interval and on-demand) with perplexity",
                 "loss-spike / divergence / plateau / grad-norm / LR monitoring",
                 "Orbax checkpointing with stable-pointer rollback, auto-resume, "
@@ -47,6 +55,7 @@ async def root(request: web.Request) -> web.Response:
                 "real ICI topology introspection",
                 "jax.profiler trace capture, per-step wall-clock breakdown, "
                 "and structured JSONL metrics logs",
+                "Prometheus /metrics exporting both telemetry planes",
             ],
             "endpoints": {
                 "tpu": "/api/v1/tpu",
@@ -54,6 +63,7 @@ async def root(request: web.Request) -> web.Response:
                 "monitoring": "/api/v1/monitoring",
                 "topology": "/api/v1/topology",
                 "profile": "/api/v1/profile",
+                "metrics": "/metrics",
             },
         }
     )
