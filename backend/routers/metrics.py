@@ -2,9 +2,10 @@
 
 The reference has no metrics surface at all (its observability is JSON
 endpoints polled by hand — SURVEY.md §5). This exports both telemetry
-planes — chip fleet and training jobs — in the Prometheus text format so a
+planes — chip fleet and training jobs — in the Prometheus text format
+(version 0.0.4: ``# HELP``/``# TYPE`` per family, escaped label values) so a
 standard scraper gets them for free. Hand-rendered exposition (no client
-library in the image); label values are escaped per the format spec.
+library in the image).
 """
 
 from __future__ import annotations
@@ -15,56 +16,104 @@ from backend import state
 
 _PREFIX = "tpu_engine"
 
+# family -> (type, help)
+_FAMILIES = {
+    "fleet_up": ("gauge", "1 when the TPU runtime reports at least one device"),
+    "fleet_devices_total": ("gauge", "Number of TPU devices visible to the runtime"),
+    "fleet_devices_available": ("gauge", "Devices currently schedulable (healthy, HBM headroom)"),
+    "device_hbm_total_bytes": ("gauge", "HBM capacity per device"),
+    "device_hbm_used_bytes": ("gauge", "HBM in use per device"),
+    "device_duty_cycle_pct": ("gauge", "Percent of time the chip was executing (libtpu or engine-derived)"),
+    "device_tensorcore_util_pct": ("gauge", "TensorCore (MXU) utilization percent"),
+    "device_throttle_score": ("gauge", "libtpu throttle score: 0 none, 1-10 = throttled by 10-100%"),
+    "device_temperature_celsius": ("gauge", "Chip temperature when a telemetry source reports it"),
+    "device_power_draw_watts": ("gauge", "Chip power draw when a telemetry source reports it"),
+    "ici_link_health_score": ("gauge", "ICI link health: 0 healthy, 1-5 transient, 6-9 persistent, 10 unusable"),
+    "job_info": ("gauge", "Training job presence; status carried as a label"),
+    "job_step": ("gauge", "Current training step"),
+    "job_rollbacks_total": ("counter", "Divergence rollbacks performed by the supervisor"),
+    "job_tokens_per_sec": ("gauge", "Training throughput in tokens/sec"),
+    "job_loss": ("gauge", "Latest training loss"),
+    "job_alerts_total": ("counter", "Loss-monitor alerts emitted"),
+    "job_alerts_by_type_total": ("counter", "Loss-monitor alerts by detector type"),
+    "job_mfu": ("gauge", "Model-FLOPs utilization in [0, 1]"),
+}
+
 
 def _esc(v: object) -> str:
     return str(v).replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
 
 
-def _line(name: str, value, labels: dict | None = None) -> str:
-    lab = ""
-    if labels:
-        inner = ",".join(f'{k}="{_esc(v)}"' for k, v in labels.items())
-        lab = "{" + inner + "}"
-    return f"{_PREFIX}_{name}{lab} {float(value)}"
+class _Exposition:
+    """Accumulates samples grouped per family so HELP/TYPE precede them."""
+
+    def __init__(self):
+        self._samples: dict[str, list[str]] = {}
+
+    def add(self, family: str, value, labels: dict | None = None) -> None:
+        lab = ""
+        if labels:
+            inner = ",".join(f'{k}="{_esc(v)}"' for k, v in labels.items())
+            lab = "{" + inner + "}"
+        self._samples.setdefault(family, []).append(
+            f"{_PREFIX}_{family}{lab} {float(value)}"
+        )
+
+    def render(self) -> str:
+        out: list[str] = []
+        for family, lines in self._samples.items():
+            mtype, help_text = _FAMILIES.get(family, ("gauge", family))
+            out.append(f"# HELP {_PREFIX}_{family} {help_text}")
+            out.append(f"# TYPE {_PREFIX}_{family} {mtype}")
+            out.extend(lines)
+        return "\n".join(out) + "\n"
 
 
 def render_metrics() -> str:
-    out: list[str] = []
+    exp = _Exposition()
 
     # -- fleet plane --------------------------------------------------------
     # get_fleet_status() never raises — runtime failures come back as a
     # zero-device status with a fleet alert — so "up" keys off the device
     # count, not an exception.
     fleet = state.manager.get_fleet_status()
-    out.append(_line("fleet_up", 1 if fleet.total_devices > 0 else 0))
-    out.append(_line("fleet_devices_total", fleet.total_devices))
-    out.append(_line("fleet_devices_available", fleet.available_devices))
+    exp.add("fleet_up", 1 if fleet.total_devices > 0 else 0)
+    exp.add("fleet_devices_total", fleet.total_devices)
+    exp.add("fleet_devices_available", fleet.available_devices)
     for d in fleet.devices:
         lab = {"device": d.index, "kind": d.device_kind}
-        out.append(_line("device_hbm_total_bytes", d.hbm_total_gb * 2**30, lab))
-        out.append(_line("device_hbm_used_bytes", d.hbm_used_gb * 2**30, lab))
+        exp.add("device_hbm_total_bytes", d.hbm_total_gb * 2**30, lab)
+        exp.add("device_hbm_used_bytes", d.hbm_used_gb * 2**30, lab)
         if d.duty_cycle_pct is not None:
-            out.append(_line("device_duty_cycle_pct", d.duty_cycle_pct, lab))
+            exp.add("device_duty_cycle_pct", d.duty_cycle_pct, lab)
+        if d.tensorcore_util_pct is not None:
+            exp.add("device_tensorcore_util_pct", d.tensorcore_util_pct, lab)
+        if d.throttle_score is not None:
+            exp.add("device_throttle_score", d.throttle_score, lab)
         if d.temperature_c is not None:
-            out.append(_line("device_temperature_celsius", d.temperature_c, lab))
+            exp.add("device_temperature_celsius", d.temperature_c, lab)
+        if d.power_draw_w is not None:
+            exp.add("device_power_draw_watts", d.power_draw_w, lab)
+    for loc, score in fleet.ici_links:
+        exp.add("ici_link_health_score", score, {"link": loc})
 
     # -- training plane -----------------------------------------------------
     for job in state.launcher.list_jobs():
         lab = {"job_id": job["job_id"], "model": job["model_name"]}
-        out.append(_line("job_info", 1, {**lab, "status": job["status"]}))
-        out.append(_line("job_step", job["current_step"] or 0, lab))
-        out.append(_line("job_rollbacks_total", job["rollback_count"] or 0, lab))
+        exp.add("job_info", 1, {**lab, "status": job["status"]})
+        exp.add("job_step", job["current_step"] or 0, lab)
+        exp.add("job_rollbacks_total", job["rollback_count"] or 0, lab)
         if job.get("tokens_per_sec"):
-            out.append(_line("job_tokens_per_sec", job["tokens_per_sec"], lab))
+            exp.add("job_tokens_per_sec", job["tokens_per_sec"], lab)
         mon = job.get("monitor") or {}
         if mon.get("current_loss") is not None:
-            out.append(_line("job_loss", mon["current_loss"], lab))
-        out.append(_line("job_alerts_total", mon.get("total_alerts") or 0, lab))
+            exp.add("job_loss", mon["current_loss"], lab)
+        exp.add("job_alerts_total", mon.get("total_alerts") or 0, lab)
         for kind, n in (mon.get("alerts_by_type") or {}).items():
-            out.append(_line("job_alerts_by_type_total", n, {**lab, "type": kind}))
+            exp.add("job_alerts_by_type_total", n, {**lab, "type": kind})
         prof = job.get("profile") or {}
         if prof.get("mfu") is not None:
-            out.append(_line("job_mfu", prof["mfu"], lab))
+            exp.add("job_mfu", prof["mfu"], lab)
 
     # External jobs pushing metrics over HTTP ingest (their monitors live in
     # the standalone registry, not the supervisor).
@@ -76,21 +125,20 @@ def render_metrics() -> str:
             continue
         summary = mon.get_summary()
         lab = {"job_id": job_id, "model": "external"}
-        out.append(_line("job_info", 1, {**lab, "status": "external"}))
+        exp.add("job_info", 1, {**lab, "status": "external"})
         if summary.get("current_loss") is not None:
-            out.append(_line("job_loss", summary["current_loss"], lab))
-        out.append(_line("job_alerts_total", summary.get("total_alerts") or 0, lab))
+            exp.add("job_loss", summary["current_loss"], lab)
+        exp.add("job_alerts_total", summary.get("total_alerts") or 0, lab)
         for kind, n in (summary.get("alerts_by_type") or {}).items():
-            out.append(_line("job_alerts_by_type_total", n, {**lab, "type": kind}))
-    return "\n".join(out) + "\n"
+            exp.add("job_alerts_by_type_total", n, {**lab, "type": kind})
+    return exp.render()
 
 
 async def metrics(request: web.Request) -> web.Response:
-    return web.Response(
-        text=render_metrics(),
-        content_type="text/plain",
-        charset="utf-8",
-    )
+    resp = web.Response(text=render_metrics())
+    # The exact exposition content type scrapers negotiate for.
+    resp.headers["Content-Type"] = "text/plain; version=0.0.4; charset=utf-8"
+    return resp
 
 
 def setup(app: web.Application) -> None:

@@ -144,5 +144,8 @@ def setup(app: web.Application, prefix: str = "/api/v1/monitoring") -> None:
     app.router.add_get(f"{prefix}/summary/{{job_id}}", get_monitor_summary)
     app.router.add_get(f"{prefix}/loss-curve/{{job_id}}", get_loss_curve)
     app.router.add_get(f"{prefix}/alerts/{{job_id}}", get_alerts)
+    # POST is the native spelling; DELETE matches the reference's route
+    # exactly (``backend/routers/monitoring.py:119`` — endpoint compat).
     app.router.add_post(f"{prefix}/reset/{{job_id}}", reset_monitor)
+    app.router.add_delete(f"{prefix}/reset/{{job_id}}", reset_monitor)
     app.router.add_get(f"{prefix}/jobs", list_monitored_jobs)

@@ -13,7 +13,7 @@ import asyncio
 from typing import Any, Literal, Optional
 
 from aiohttp import web
-from pydantic import BaseModel, Field
+from pydantic import BaseModel, ConfigDict, Field
 
 from backend import state
 from backend.http import ApiError, json_response, parse_body
@@ -23,7 +23,16 @@ from tpu_engine.sharding import OffloadDevice, Precision, ShardingStage, TPUTrai
 
 class TrainingLaunchRequest(BaseModel):
     """Mirrors reference ``TrainingLaunchRequest`` (``training.py:16-45``),
-    re-based to TPU fields (mesh instead of num_gpus/num_nodes etc.)."""
+    re-based to TPU fields (mesh instead of num_gpus/num_nodes etc.).
+
+    Unknown fields are a 422, not silently dropped — in particular the
+    comm-tuning knobs (``async_collectives``/``latency_hiding_scheduler``/
+    ``xla_extra_flags``) are deliberately NOT accepted here: XLA flags
+    cannot take effect once the server's backend is initialised, so jobs
+    that need them must go through the worker CLI (round-1 review
+    finding — no inert config knobs)."""
+
+    model_config = ConfigDict(extra="forbid")
 
     model_name: str = "gpt-125m"
     sharding_stage: int = Field(default=3, ge=0, le=3)
@@ -43,6 +52,8 @@ class TrainingLaunchRequest(BaseModel):
     weight_decay: float = Field(default=0.1, ge=0)
     grad_clip_norm: float = Field(default=1.0, gt=0)
     optimizer_offload: str = "none"
+    param_offload: str = "none"
+    grad_allreduce_dtype: Optional[str] = None
     attention_impl: Literal["auto", "xla", "flash", "ring", "ulysses"] = "auto"
     sliding_window: Optional[int] = Field(
         default=None, ge=0,
@@ -69,10 +80,19 @@ class TrainingLaunchRequest(BaseModel):
 class PresetLaunchRequest(BaseModel):
     """Mirrors reference ``PresetLaunchRequest`` (``training.py:47-53``)."""
 
+    model_config = ConfigDict(extra="forbid")
+
     preset_name: str
     overrides: dict[str, Any] = Field(default_factory=dict)
     max_steps: Optional[int] = Field(default=None, ge=1)
     dry_run: bool = True
+
+
+# Config fields that are XLA process flags: inert once the server's backend
+# is up, so a live (non-dry-run) server launch rejects them outright.
+_COMM_FLAG_FIELDS = frozenset(
+    {"async_collectives", "latency_hiding_scheduler", "xla_extra_flags"}
+)
 
 
 def _to_config(req: TrainingLaunchRequest) -> TPUTrainConfig:
@@ -112,6 +132,12 @@ def _to_config(req: TrainingLaunchRequest) -> TPUTrainConfig:
             weight_decay=req.weight_decay,
             grad_clip_norm=req.grad_clip_norm,
             optimizer_offload=OffloadDevice(req.optimizer_offload),
+            param_offload=OffloadDevice(req.param_offload),
+            grad_allreduce_dtype=(
+                Precision(req.grad_allreduce_dtype)
+                if req.grad_allreduce_dtype
+                else None
+            ),
             attention_impl=req.attention_impl,
             sliding_window=req.sliding_window,
             activation_checkpointing=req.activation_checkpointing,
@@ -156,6 +182,14 @@ async def launch_from_preset(request: web.Request) -> web.Response:
         )
     config = presets[req.preset_name]
     if req.overrides:
+        inert = _COMM_FLAG_FIELDS & req.overrides.keys()
+        if inert and not req.dry_run:
+            raise ApiError(
+                422,
+                f"{sorted(inert)} are XLA process flags and cannot take "
+                "effect in an already-running server; launch via the worker "
+                "CLI (tpu_engine.launcher worker) to apply them",
+            )
         try:
             config = TPUTrainConfig(**{**config.model_dump(), **req.overrides})
         except ValueError as e:

@@ -1,0 +1,74 @@
+"""AOT fit-tuning sweep for the 7b/13b/70b presets.
+
+Compiles candidate (mesh, batch, chunking) combinations against described
+v5e topologies and reports per-device HBM so the shipped presets can be
+ones that PROVABLY fit their target slice — unlike the reference's, whose
+GPU sizing was never validated anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+CANDIDATES = [
+    # (name, model, topology, mesh, micro, seq, overrides)
+    ("7b-v5e8-mb2", "llama-7b", "v5e:2x4", dict(data=1, fsdp=8), 2, 4096,
+     {"optimizer_offload": "host"}),
+    ("7b-v5e8-mb1", "llama-7b", "v5e:2x4", dict(data=1, fsdp=8), 1, 4096,
+     {"optimizer_offload": "host"}),
+    ("13b-v5e16-mb1", "llama-13b", "v5e:4x4", dict(data=1, fsdp=16), 1, 4096,
+     {"optimizer_offload": "host", "param_offload": "host",
+      "loss_chunk_size": 1024}),
+    ("13b-v5e8-mb1-chunk", "llama-13b", "v5e:2x4", dict(data=1, fsdp=8), 1, 4096,
+     {"optimizer_offload": "host", "param_offload": "host",
+      "loss_chunk_size": 1024}),
+    ("70b-v5e256-fsdp64", "llama-70b", "v5e:16x16", dict(data=4, fsdp=64), 1, 4096,
+     {"optimizer_offload": "host", "param_offload": "host",
+      "loss_chunk_size": 1024}),
+    ("70b-v5e64-fsdp64", "llama-70b", "v5e:8x8", dict(data=1, fsdp=64), 1, 4096,
+     {"optimizer_offload": "host", "param_offload": "host",
+      "loss_chunk_size": 1024}),
+]
+
+
+def main() -> int:
+    from jax.experimental import topologies
+
+    from benchmarks.hbm_projection import _build
+
+    gib = 2**30
+    for name, model, topo_name, mesh_axes, micro, seq, overrides in CANDIDATES:
+        t0 = time.time()
+        try:
+            topo = topologies.get_topology_desc(topo_name, platform="tpu")
+            prog = _build(model, mesh_axes, micro, 1, seq, overrides,
+                          devices=topo.devices)
+            state_shape = jax.eval_shape(prog.init, jax.random.PRNGKey(0))
+            batch = jax.ShapeDtypeStruct(prog.global_batch_shape(), jnp.int32)
+            comp = prog.step.lower(state_shape, batch).compile()
+            ma = comp.memory_analysis()
+            peak = (ma.argument_size_in_bytes + ma.temp_size_in_bytes) / gib
+            print(json.dumps({
+                "candidate": name, "topology": topo_name, "mesh": mesh_axes,
+                "micro": micro,
+                "device_args_gib": round(ma.argument_size_in_bytes / gib, 2),
+                "device_temp_gib": round(ma.temp_size_in_bytes / gib, 2),
+                "device_peak_gib": round(peak, 2),
+                "fits_16gib_hbm": peak < 15.5,
+                "compile_s": round(time.time() - t0, 1),
+            }), flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({
+                "candidate": name,
+                "error": f"{type(e).__name__}: {e}"[:260],
+                "compile_s": round(time.time() - t0, 1),
+            }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -118,7 +118,37 @@ def test_config_generate(client):
 def test_presets_listing(client):
     r = client.get("/api/v1/training/presets").json()
     assert {"125m", "7b", "13b", "70b"} <= set(r)
-    assert r["7b"]["effective_batch_size"] == 2 * 16 * 4
+    assert r["7b"]["effective_batch_size"] == 128  # reference's 7b eff. batch
+
+
+def test_comm_flags_rejected_for_live_server_launch(client):
+    """XLA process flags cannot act in a running server: a live preset
+    launch that overrides them is a 422, not a silent no-op (round-1
+    review finding); a dry run may still carry them (plan generation)."""
+    r = client.post(
+        "/api/v1/training/launch/preset",
+        json={"preset_name": "125m",
+              "overrides": {"xla_extra_flags": "--xla_foo=1"},
+              "dry_run": False},
+    )
+    assert r.status_code == 422
+    assert "worker CLI" in r.text
+    r = client.post(
+        "/api/v1/training/launch/preset",
+        json={"preset_name": "125m",
+              "overrides": {"async_collectives": False}, "dry_run": True},
+    )
+    assert r.status_code == 200
+
+
+def test_unknown_launch_fields_are_422(client):
+    # extra="forbid": typos and unsupported knobs fail loudly instead of
+    # being silently dropped.
+    r = client.post(
+        "/api/v1/training/launch",
+        json={"model_name": "gpt-tiny", "async_collectives": True},
+    )
+    assert r.status_code == 422
 
 
 def test_preset_launch_not_found_and_overrides(client):
@@ -228,6 +258,14 @@ def test_monitor_create_ingest_summary_reset(client):
     assert summary["alerts_by_type"]["loss_spike"] == 1
 
     assert client.post(f"/api/v1/monitoring/reset/{jid}").json()["reset"]
+    assert client.get(f"/api/v1/monitoring/summary/{jid}").json()["total_steps_seen"] == 0
+
+    # DELETE is the reference's exact route spelling
+    # (reference monitoring.py:119) — endpoint compat.
+    client.post(
+        "/api/v1/monitoring/ingest/single", json={"job_id": jid, "step": 1, "loss": 2.0}
+    )
+    assert client.delete(f"/api/v1/monitoring/reset/{jid}").json()["reset"]
     assert client.get(f"/api/v1/monitoring/summary/{jid}").json()["total_steps_seen"] == 0
 
 
@@ -570,9 +608,21 @@ def test_prometheus_metrics_endpoint(client):
     assert r2.status_code == 200, r2.text
     body = client.get("/metrics").text
     assert 'tpu_engine_job_loss{job_id="ext-scrape-job",model="external"} 2.5' in body
-    # Every line parses as "name{labels} value" with a float value.
+    # Proper exposition format: versioned content type, HELP/TYPE per
+    # family preceding its samples (round-1 advisor finding).
+    assert "version=0.0.4" in m.headers["content-type"]
+    assert "# HELP tpu_engine_fleet_up" in body
+    assert "# TYPE tpu_engine_fleet_up gauge" in body
+    seen_families = set()
     for line in body.strip().splitlines():
+        if line.startswith("# TYPE "):
+            seen_families.add(line.split()[2])
+            continue
+        if line.startswith("#"):
+            continue
+        name = line.split("{")[0].split(" ")[0]
         assert line.startswith("tpu_engine_"), line
+        assert name in seen_families, f"samples before TYPE for {name}"
         float(line.rsplit(" ", 1)[1])
 
 
@@ -632,3 +682,7 @@ def test_speculative_generate_over_http(client, tmp_path_factory):
         "draft_hf_checkpoint": out_dir, "temperature": 0.7,
     })
     assert bad.status_code == 422
+
+
+# Compile-heavy module: excluded from the fast core run (pytest -m "not slow").
+pytestmark = pytest.mark.slow

@@ -235,3 +235,7 @@ def test_elastic_resume_across_mesh_shapes(tmp_path):
     assert step == 6
     q_after = jax.device_get(restored["params"]["layers"]["q"]["kernel"])
     assert (q_before == q_after).all()
+
+
+# Compile-heavy module: excluded from the fast core run (pytest -m "not slow").
+pytestmark = pytest.mark.slow

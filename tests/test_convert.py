@@ -268,3 +268,7 @@ def test_gpt2_unsupported_variants_rejected():
         config_from_hf(GPT2Config(activation_function="relu"))
     with pytest.raises(ValueError, match="scale_attn_by_inverse_layer_idx"):
         config_from_hf(GPT2Config(scale_attn_by_inverse_layer_idx=True))
+
+
+# Compile-heavy module: excluded from the fast core run (pytest -m "not slow").
+pytestmark = pytest.mark.slow

@@ -220,3 +220,27 @@ def test_write_token_file_rejects_out_of_range(tmp_path):
     write_token_file(rows.reshape(-1), str(tmp_path / "ok.bin"), dtype="int32")
     with pytest.raises(ValueError, match="do not fit"):
         write_token_file(np.array([70000]), str(tmp_path / "big.bin"))
+
+
+def test_row_structured_seq_len_contract(tmp_path):
+    """Packed (2-D) files record their row length; opening at any other
+    seq_len fails loudly instead of silently misaligning SFT masks
+    (round-1 advisor finding). Rewriting the path with a 1-D stream
+    clears the sidecar."""
+    from tpu_engine.data import TokenFileDataset, pack_sft_examples, write_token_file
+
+    rows = pack_sft_examples([([5], [7, 8])] * 4, seq_len=8)
+    path = str(tmp_path / "sft.bin")
+    write_token_file(rows, path, dtype="int32")
+    # Matching seq_len opens fine.
+    ds = TokenFileDataset(path, seq_len=8, dtype="int32")
+    assert ds.num_sequences == 4
+    ds.close()
+    # Any other seq_len is a hard error.
+    with pytest.raises(ValueError, match="row_len=8"):
+        TokenFileDataset(path, seq_len=16, dtype="int32")
+    # A later 1-D rewrite clears the sidecar: any seq_len is valid again.
+    write_token_file(np.arange(64, dtype=np.int32), path, dtype="int32")
+    ds2 = TokenFileDataset(path, seq_len=16, dtype="int32")
+    assert ds2.num_sequences == 4
+    ds2.close()

@@ -2,6 +2,7 @@
 
 import jax
 import numpy as np
+import pytest
 
 from tpu_engine import TPULauncher, TPUTrainConfig
 from tpu_engine.mesh_runtime import MeshConfig
@@ -217,3 +218,7 @@ def test_run_eval_now():
     unstarted = TrainingJob(job_id="x", config=_cfg(eval_interval_steps=5))
     with pytest.raises(RuntimeError, match="retry once it is running"):
         unstarted.run_eval_now()
+
+
+# Compile-heavy module: excluded from the fast core run (pytest -m "not slow").
+pytestmark = pytest.mark.slow

@@ -175,3 +175,36 @@ def test_window_narrows_inner_grid():
     assert _n_kv_blocks(8, 64, 64) == 2
     # no window: full inner dim
     assert _n_kv_blocks(8, 64, 0) == 8 and _n_q_blocks(8, 64, 0) == 8
+
+
+def test_flash_under_shard_map_matches_xla_on_mesh():
+    """Mosaic calls cannot be GSPMD-partitioned: on a multi-device mesh the
+    train program wraps the flash kernel in shard_map (batch over
+    data/fsdp, heads over model). The full sharded train step must match
+    the XLA-attention step bit-for-bit-close."""
+    import jax
+
+    from tpu_engine.mesh_runtime import MeshConfig
+    from tpu_engine.sharding import ShardingStage, TPUTrainConfig
+    from tpu_engine.train import build_train_program
+
+    def step_loss(impl):
+        cfg = TPUTrainConfig(
+            model_name="gpt-tiny",
+            sharding_stage=ShardingStage.FULL_PARTITIONING,
+            mesh=MeshConfig(data=2, fsdp=2, model=2),
+            micro_batch_size=2, seq_len=128, precision="fp32",
+            attention_impl=impl, activation_checkpointing=True,
+        )
+        prog = build_train_program(cfg)
+        state = prog.init(jax.random.PRNGKey(0))
+        state, m = prog.step(state, prog.synthetic_batch(0))
+        return float(m["loss"]), float(m["grad_norm"])
+
+    flash = step_loss("flash")
+    xla = step_loss("xla")
+    assert flash == pytest.approx(xla, rel=1e-5)
+
+
+# Compile-heavy module: excluded from the fast core run (pytest -m "not slow").
+pytestmark = pytest.mark.slow

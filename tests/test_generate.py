@@ -336,3 +336,7 @@ def test_gpt2_position_table_bounds():
     with pytest.raises(ValueError, match="position table"):
         generate(params8, toks[:, :4], long_cfg, max_new_tokens=8,
                  compute_dtype=jnp.float32)
+
+
+# Compile-heavy module: excluded from the fast core run (pytest -m "not slow").
+pytestmark = pytest.mark.slow

@@ -232,3 +232,7 @@ def test_gpt2_lora_targets():
         state, m = prog.step(state, prog.synthetic_batch(0))
         losses.append(float(m["loss"]))
     assert losses[-1] < losses[0], losses
+
+
+# Compile-heavy module: excluded from the fast core run (pytest -m "not slow").
+pytestmark = pytest.mark.slow

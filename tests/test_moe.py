@@ -124,3 +124,7 @@ def test_moe_training_end_to_end_with_expert_parallelism():
         losses.append(float(metrics["loss"]))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses
+
+
+# Compile-heavy module: excluded from the fast core run (pytest -m "not slow").
+pytestmark = pytest.mark.slow

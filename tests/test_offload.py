@@ -1,0 +1,178 @@
+"""Host offload (params + optimizer state) and gradient-communication dtype.
+
+The reference emits ZeRO-3 CPU offload and ``communication_data_type`` as
+DeepSpeed JSON (``deepspeed_launcher.py:60-62,167-169,197-212``); here both
+are real engine behavior:
+
+- ``param_offload=host``: master params live in pinned host memory, layers
+  stream to device one at a time inside the remat-wrapped scan body
+  (``tpu_engine/models/transformer.py:remat_scan_body``), update shards
+  transit device memory (``tpu_engine/train.py``);
+- ``optimizer_offload=host``: optimizer state resident in pinned host;
+- ``grad_allreduce_dtype``: reduced-precision mode differentiates wrt the
+  compute-dtype params so the cotangent chain (and the gradient collectives
+  XLA inserts in it) carries the comm dtype.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_engine.mesh_runtime import MeshConfig
+from tpu_engine.sharding import (
+    OffloadDevice,
+    ShardingStage,
+    TPUTrainConfig,
+    host_memory_kind_available,
+)
+from tpu_engine.train import build_train_program
+
+
+def _cfg(**kw):
+    base = dict(
+        model_name="gpt-tiny",
+        sharding_stage=ShardingStage.FULL_PARTITIONING,
+        mesh=MeshConfig(data=2, fsdp=4),
+        micro_batch_size=1,
+        seq_len=32,
+        warmup_steps=1,
+        learning_rate=1e-2,
+        activation_checkpointing=True,
+    )
+    base.update(kw)
+    return TPUTrainConfig(**base)
+
+
+def _kinds(tree):
+    return {leaf.sharding.memory_kind for leaf in jax.tree.leaves(tree)}
+
+
+def test_host_memory_kind_available_on_cpu_backend():
+    # The CPU backend supports pinned_host placement (probed, not
+    # introspected) — this is what lets the offload paths run in CI at all.
+    prog = build_train_program(_cfg(param_offload=OffloadDevice.NONE))
+    assert host_memory_kind_available(prog.mesh)
+
+
+def test_param_offload_placement_and_numerics():
+    """Params live in pinned host memory and the training trajectory matches
+    the non-offloaded program bit-for-bit-close (fp32 determinism)."""
+    kw = dict(precision="fp32", seed=3)
+    off = build_train_program(
+        _cfg(param_offload=OffloadDevice.HOST,
+             optimizer_offload=OffloadDevice.HOST, **kw)
+    )
+    ref = build_train_program(_cfg(**kw))
+
+    s_off = off.init(jax.random.PRNGKey(0))
+    s_ref = ref.init(jax.random.PRNGKey(0))
+    assert _kinds(s_off["params"]) == {"pinned_host"}
+    assert _kinds(s_ref["params"]) == {None} or _kinds(s_ref["params"]) == {"device"}
+    # Param-shaped optimizer leaves are host-resident too.
+    assert "pinned_host" in _kinds(s_off["opt_state"])
+
+    losses_off, losses_ref = [], []
+    for i in range(3):
+        batch = ref.synthetic_batch(i)
+        s_off, m_off = off.step(s_off, batch)
+        s_ref, m_ref = ref.step(s_ref, batch)
+        losses_off.append(float(m_off["loss"]))
+        losses_ref.append(float(m_ref["loss"]))
+    assert losses_off == pytest.approx(losses_ref, abs=1e-5)
+    # Updated params return to pinned host after every step.
+    assert _kinds(s_off["params"]) == {"pinned_host"}
+    # And the trajectory actually moved (lr warms up after step 1).
+    assert losses_off[2] != pytest.approx(losses_off[0], abs=1e-9)
+
+
+def test_param_offload_eval_step_runs():
+    prog = build_train_program(
+        _cfg(param_offload=OffloadDevice.HOST, precision="fp32")
+    )
+    state = prog.init(jax.random.PRNGKey(0))
+    loss = float(prog.eval_step(state, prog.synthetic_batch(0)))
+    assert jnp.isfinite(loss)
+
+
+def test_param_offload_rejects_lora():
+    with pytest.raises(ValueError, match="param_offload is not supported with LoRA"):
+        build_train_program(
+            _cfg(param_offload=OffloadDevice.HOST, lora_rank=4)
+        )
+
+
+def test_param_offload_rejects_pipeline():
+    with pytest.raises(ValueError, match="pipeline"):
+        build_train_program(
+            _cfg(param_offload=OffloadDevice.HOST,
+                 mesh=MeshConfig(data=1, fsdp=4, pipe=2))
+        )
+
+
+def test_param_offload_rejects_reduced_comm():
+    with pytest.raises(ValueError, match="grad_allreduce_dtype"):
+        build_train_program(
+            _cfg(param_offload=OffloadDevice.HOST, grad_allreduce_dtype="bf16")
+        )
+
+
+def test_grad_allreduce_dtype_must_match_precision():
+    with pytest.raises(ValueError, match="grad_allreduce_dtype"):
+        _cfg(grad_allreduce_dtype="fp16")  # bf16 compute
+    # fp32 and the compute dtype itself are always legal.
+    _cfg(grad_allreduce_dtype="fp32")
+    _cfg(grad_allreduce_dtype="bf16")
+
+
+def test_reduced_comm_executes_and_tracks_default():
+    """bf16 gradient communication: runs green; the loss trajectory tracks
+    the default config (grads differ only by the cast boundary at the
+    master-param edge)."""
+    red = build_train_program(_cfg(grad_allreduce_dtype="bf16", seed=5))
+    ref = build_train_program(_cfg(seed=5))
+    s_red = red.init(jax.random.PRNGKey(1))
+    s_ref = ref.init(jax.random.PRNGKey(1))
+    for i in range(2):
+        batch = ref.synthetic_batch(i)
+        s_red, m_red = red.step(s_red, batch)
+        s_ref, m_ref = ref.step(s_ref, batch)
+    assert float(m_red["loss"]) == pytest.approx(float(m_ref["loss"]), rel=2e-2)
+    assert jnp.isfinite(float(m_red["grad_norm"]))
+
+
+@pytest.mark.slow
+@pytest.mark.tpu_aot
+def test_tpu_hlo_gradient_collectives_ride_bf16():
+    """AOT-compile the train step for a described v5e:2x4 topology (libtpu
+    compile-only — no chip needed) and assert the layer-gradient collectives
+    ride bf16. Measured reality on TPU: with bf16 compute, XLA places the
+    gradient psum at the bf16 dot output, so the dominant gradient traffic
+    is half-width with or without ``grad_allreduce_dtype`` — the knob makes
+    the boundary dtype explicit rather than changing the collective."""
+    import re
+
+    from jax.experimental import topologies
+
+    from tpu_engine.mesh_runtime import MeshRuntime
+
+    try:
+        topo = topologies.get_topology_desc("v5e:2x4", platform="tpu")
+    except Exception as e:  # no libtpu in this environment
+        pytest.skip(f"TPU AOT topology unavailable: {e}")
+    cfg = _cfg(grad_allreduce_dtype="bf16",
+               sharding_stage=ShardingStage.GRADIENT_PARTITIONING,
+               activation_checkpointing=False)
+    runtime = MeshRuntime(cfg.mesh, devices=topo.devices)
+    prog = build_train_program(cfg, runtime=runtime)
+    state_shape = jax.eval_shape(prog.init, jax.random.PRNGKey(0))
+    batch = jax.ShapeDtypeStruct(prog.global_batch_shape(), jnp.int32)
+    txt = prog.step.lower(state_shape, batch).compile().as_text()
+    colls = re.findall(
+        r"(bf16|f32)\[[\d,]*\][^\n]*\b(all-reduce|reduce-scatter)\(", txt
+    )
+    bf16_reduces = [c for c in colls if c[0] == "bf16"]
+    assert bf16_reduces, f"expected bf16 gradient collectives, got {colls}"
+
+
+# Compile-heavy module: excluded from the fast core run (pytest -m "not slow").
+pytestmark = pytest.mark.slow

@@ -125,3 +125,7 @@ def test_pipeline_gpt2_arch():
     pipe = _run(_cfg(MeshConfig(data=2, fsdp=2, pipe=2), model_name="gpt2-tiny"))[1]
     ref = _run(_cfg(MeshConfig(data=2, fsdp=2, model=2), model_name="gpt2-tiny"))[1]
     np.testing.assert_allclose([l for l, _ in pipe], [l for l, _ in ref], rtol=2e-5)
+
+
+# Compile-heavy module: excluded from the fast core run (pytest -m "not slow").
+pytestmark = pytest.mark.slow

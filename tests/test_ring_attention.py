@@ -58,3 +58,7 @@ def test_ring_with_combined_mesh_axes():
     ref = mha(q, k, v, causal=True, force_xla=True)
     out = jax.jit(lambda q, k, v: ring_mha(q, k, v, mesh=mesh))(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+# Compile-heavy module: excluded from the fast core run (pytest -m "not slow").
+pytestmark = pytest.mark.slow

@@ -55,3 +55,9 @@ def test_sequence_parallel_batch_sharded_over_sequence():
     assert prog.batch_sharding.spec == jax.sharding.PartitionSpec(
         None, ("data", "fsdp"), "sequence"
     )
+
+
+import pytest
+
+# Compile-heavy module: excluded from the fast core run (pytest -m "not slow").
+pytestmark = pytest.mark.slow

@@ -303,3 +303,7 @@ def test_sft_training_learns_completions_only():
         state, m = prog.step(state, batch)
         losses.append(float(m["loss"]))
     assert losses[-1] < losses[0] * 0.8, losses
+
+
+# Compile-heavy module: excluded from the fast core run (pytest -m "not slow").
+pytestmark = pytest.mark.slow

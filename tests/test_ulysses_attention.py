@@ -126,3 +126,7 @@ def test_ulysses_training_matches_ring_and_baseline():
     _, losses_ref = run(cfg(mesh=MeshConfig(data=2, fsdp=4), micro_batch_size=1))
     np.testing.assert_allclose(losses_uly, losses_ref, rtol=1e-3)
     assert losses_uly[-1] < losses_uly[0]
+
+
+# Compile-heavy module: excluded from the fast core run (pytest -m "not slow").
+pytestmark = pytest.mark.slow

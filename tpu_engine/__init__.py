@@ -28,6 +28,11 @@ from tpu_engine.tpu_manager import (
     TPUHealthStatus,
     TPUManager,
 )
+from tpu_engine.telemetry import (
+    DerivedDutySource,
+    LibtpuSdkSource,
+    TelemetrySnapshot,
+)
 from tpu_engine.sharding import (
     ShardingStage,
     OffloadDevice,
@@ -62,6 +67,9 @@ __all__ = [
     "TPUFleetStatus",
     "TPUHealthStatus",
     "TPUManager",
+    "DerivedDutySource",
+    "LibtpuSdkSource",
+    "TelemetrySnapshot",
     "ShardingStage",
     "OffloadDevice",
     "TPUTrainConfig",

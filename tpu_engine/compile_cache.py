@@ -1,0 +1,84 @@
+"""Persistent XLA compilation cache.
+
+SURVEY.md §7 hard part (c): MTTR < 90 s auto-resume needs warm-start
+compilation — a preempted worker that restarts must not pay the full
+multi-minute XLA compile again. JAX's persistent compilation cache keys
+compiled executables by (HLO, compile options, libtpu version) and reuses
+them across processes, so the supervisor's resume path costs restore + one
+*cache hit* instead of restore + cold compile.
+
+Enabled by the worker CLI and by every supervised job
+(``tpu_engine/supervisor.py``); idempotent and safe to call at any point —
+JAX consults the cache per compilation, not at backend init.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+DEFAULT_CACHE_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "tpu_engine", "xla-cache"
+)
+
+_enabled_dir: Optional[str] = None
+
+
+def enable_compilation_cache(
+    cache_dir: Optional[str] = None, force: bool = False
+) -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``cache_dir`` (idempotent).
+
+    Resolution order: explicit argument > ``JAX_COMPILATION_CACHE_DIR`` env
+    (set by infra/tpu-jobset.yaml onto a persistent volume) > the local
+    default. Returns the directory in use, or None when skipped. The
+    thresholds are lowered so the train step (which takes seconds to
+    minutes to compile) always qualifies, while trivial sub-second compiles
+    stay out of the cache.
+
+    NOT enabled on the CPU backend unless ``force``: XLA:CPU AOT reloads
+    are compiled with machine-feature sets that do not round-trip
+    (``cpu_aot_loader`` warns of possible SIGILL, and hard interpreter
+    crashes were observed in the CPU test mesh). The cache's purpose —
+    warm TPU restarts — does not apply there anyway.
+    """
+    global _enabled_dir
+    d = (
+        cache_dir
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        or DEFAULT_CACHE_DIR
+    )
+    if _enabled_dir == d:
+        return d
+    import jax
+
+    if not force and jax.default_backend() == "cpu":
+        log.info("CPU backend: persistent compilation cache not enabled")
+        return None
+
+    os.makedirs(d, exist_ok=True)
+    prev = getattr(jax.config, "jax_compilation_cache_dir", None)
+    jax.config.update("jax_compilation_cache_dir", d)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    if prev is not None and prev != d:
+        # JAX's cache object binds to the directory it was first used with;
+        # re-pointing the config requires dropping it or writes keep going
+        # to the old path.
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:
+            log.warning("could not reset jax compilation cache singleton")
+    _enabled_dir = d
+    log.info("persistent XLA compilation cache: %s", d)
+    return d
+
+
+def cache_dir_in_use() -> Optional[str]:
+    """The directory the cache was enabled with, or None."""
+    return _enabled_dir

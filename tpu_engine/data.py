@@ -17,6 +17,7 @@ input path:
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Any, Callable, Optional
 
@@ -30,7 +31,7 @@ _NP_DTYPES = {"uint16": np.uint16, "int32": np.int32}
 
 
 def write_token_file(tokens: np.ndarray, path: str, dtype: str = "uint16") -> str:
-    """Serialize a 1-D token array to the flat binary format both readers use.
+    """Serialize a token array to the flat binary format both readers use.
 
     Values outside the target dtype's range are rejected rather than
     silently wrapped — in particular, SFT-masked streams
@@ -38,6 +39,13 @@ def write_token_file(tokens: np.ndarray, path: str, dtype: str = "uint16") -> st
     with ``dtype="int32"``; a uint16 cast would corrupt every masked
     position into a large positive token id with no error anywhere
     downstream.
+
+    A 2-D ``[n, row_len]`` array (row-structured data — packed SFT
+    examples) additionally writes a ``<path>.meta.json`` sidecar recording
+    the row length: rows are only meaningful if the training config slices
+    the stream at exactly that seq_len, and :class:`TokenFileDataset`
+    enforces the sidecar at open time instead of silently misaligning
+    masks (round-1 advisor finding).
     """
     arr = np.asarray(tokens)
     info = np.iinfo(_NP_DTYPES[dtype])
@@ -48,6 +56,18 @@ def write_token_file(tokens: np.ndarray, path: str, dtype: str = "uint16") -> st
             f"[{info.min}, {info.max}]"
             + ("; SFT-masked streams need dtype='int32'" if lo < 0 else "")
         )
+    if arr.ndim == 2:
+        with open(path + ".meta.json", "w") as f:
+            json.dump({"row_len": int(arr.shape[1]), "dtype": dtype}, f)
+    elif arr.ndim == 1:
+        # Rewriting a row-structured path with a plain stream must not
+        # leave a stale sidecar vetoing valid seq_len choices.
+        try:
+            os.remove(path + ".meta.json")
+        except FileNotFoundError:
+            pass
+    else:
+        raise ValueError(f"tokens must be 1-D or 2-D, got shape {arr.shape}")
     arr.astype(_NP_DTYPES[dtype]).tofile(path)
     return path
 
@@ -183,6 +203,23 @@ class TokenFileDataset:
             raise ValueError(f"dtype must be one of {sorted(_DTYPE_CODES)}")
         if not os.path.exists(path):
             raise FileNotFoundError(path)
+        # Row-structured files (packed SFT examples) carry a sidecar with
+        # their row length; slicing them at any other seq_len would split
+        # rows and silently shift mask boundaries.
+        meta_path = path + ".meta.json"
+        if os.path.exists(meta_path):
+            try:
+                with open(meta_path) as f:
+                    meta = json.load(f)
+            except (OSError, ValueError):
+                meta = {}
+            row_len = meta.get("row_len")
+            if row_len is not None and int(row_len) != int(seq_len):
+                raise ValueError(
+                    f"{path} was written with row_len={row_len} "
+                    f"(see {meta_path}); reading it at seq_len={seq_len} "
+                    "would misalign rows and SFT mask boundaries"
+                )
         self.path, self.seq_len, self.dtype = path, int(seq_len), dtype
         self.native = False
         if prefer_native and native.available():
@@ -325,7 +362,11 @@ def pack_sft_examples(
 
     The result is ``[n, seq_len] int32``; write it with
     :func:`write_token_file` using ``dtype="int32"`` (the masked encoding
-    needs the sign bit — uint16 streams cannot carry masks).
+    needs the sign bit — uint16 streams cannot carry masks). Writing the
+    2-D array records ``seq_len`` in a ``.meta.json`` sidecar, and
+    :class:`TokenFileDataset` refuses to open the file at any other
+    seq_len — rows are only aligned when the training config's seq_len
+    equals the packing seq_len.
     """
     rows = np.full((len(pairs), seq_len), -1, np.int32)
     for i, (prompt, completion) in enumerate(pairs):

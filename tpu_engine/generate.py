@@ -228,25 +228,23 @@ def forward_with_cache(
         jnp.arange(T, dtype=jnp.int32)[None, :], (B, T)
     )
     new_pos = cache.length + jnp.arange(T, dtype=jnp.int32)
-    slots = new_pos % M
-    # One-hot select, not .at[].set(): this TPU toolchain's scatter emitter
-    # rejects even the 1-D traced-index scatter (scatter_emitter.cc check
-    # failure), and the select is O(T·M) int32 ops on an M-sized array.
-    pos_onehot = jnp.arange(M)[None, :] == slots[:, None]  # [T, M]
-    pos_new = jnp.where(
-        pos_onehot.any(axis=0),
-        (pos_onehot.astype(jnp.int32) * new_pos[:, None]).sum(axis=0),
-        cache.pos,
-    )
-
     if cache.ring and T > 1:
         # A multi-token chunk on a ring cache can wrap mid-chunk; write it
         # as a one-hot select — TPU's scatter emitter rejects the
-        # [B, slots, ...] multi-dim scatter, and a select fuses cleanly.
-        # Slots within a chunk are distinct (M >= T via the guard above),
-        # so the einsum copies exactly one row per written slot.
-        onehot = pos_onehot
+        # [B, slots, ...] multi-dim scatter (and even the 1-D traced-index
+        # scatter for pos), and a select fuses cleanly. Slots within a
+        # chunk are distinct (M >= T via the guard above), so the einsum
+        # copies exactly one row per written slot. O(T·M) int ops — paid
+        # only on this wrapping path, not on contiguous prefill/decode
+        # (round-1 advisor finding).
+        slots = new_pos % M
+        onehot = jnp.arange(M)[None, :] == slots[:, None]  # [T, M]
         written = onehot.any(axis=0)
+        pos_new = jnp.where(
+            written,
+            (onehot.astype(jnp.int32) * new_pos[:, None]).sum(axis=0),
+            cache.pos,
+        )
 
         def write(cache_arr, rows):
             rows_m = jnp.einsum("tm,btkh->bmkh", onehot.astype(cache_arr.dtype),
@@ -254,8 +252,10 @@ def forward_with_cache(
             return jnp.where(written[None, :, None, None], rows_m, cache_arr)
     else:
         # Contiguous, non-wrapping write (T=1 ring decode, or any non-ring
-        # chunk): a cheap O(T) dynamic_update_slice at the slot offset.
+        # chunk): a cheap O(T) dynamic_update_slice at the slot offset, for
+        # the cache rows and the pos vector alike.
         offset = cache.length % M if cache.ring else cache.length
+        pos_new = lax.dynamic_update_slice(cache.pos, new_pos, (offset,))
 
         def write(cache_arr, rows):
             return lax.dynamic_update_slice(

@@ -174,10 +174,18 @@ def initialize_distributed(
 
 
 def _device_array(shape: tuple[int, ...], devs: Sequence[jax.Device]) -> np.ndarray:
-    """ICI-aware device layout, with a plain reshape fallback for host
-    counts/topologies ``create_device_mesh`` can't map."""
+    """ICI-aware device layout, with fallbacks for shapes the default
+    assignment can't map (e.g. a (2, 8) logical mesh on a 4x4 torus —
+    raises NotImplementedError unless physical axes may be split)."""
     try:
         return mesh_utils.create_device_mesh(shape, devices=list(devs))
+    except NotImplementedError:
+        try:
+            return mesh_utils.create_device_mesh(
+                shape, devices=list(devs), allow_split_physical_axes=True
+            )
+        except Exception:
+            return np.asarray(devs).reshape(shape)
     except (ValueError, AssertionError):
         return np.asarray(devs).reshape(shape)
 

@@ -369,6 +369,33 @@ def _attention(q, k, v, impl: str, mesh=None, window: int = 0):
         return ulysses_mha(q, k, v, mesh=mesh, causal=True)
     from tpu_engine.ops import flash_attention  # lazy: avoids import cycles
 
+    if impl == "flash" and mesh is not None and mesh.size > 1:
+        # Mosaic (Pallas) calls cannot be partitioned by GSPMD — on a
+        # multi-device mesh the kernel must run under shard_map with the
+        # activation layout pinned: batch over (data, fsdp), heads over
+        # "model", sequence local (a >1 "sequence" axis never reaches the
+        # flash path — build_train_program routes it to ring/ulysses).
+        from functools import partial
+
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        model_size = mesh.shape.get("model", 1)
+        H, KV = q.shape[2], k.shape[2]
+        if H % model_size == 0 and KV % model_size == 0:
+            spec = P(("data", "fsdp"), None, "model", None)
+            fn = shard_map(
+                partial(flash_attention.mha, causal=True, window=window),
+                mesh=mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+                check_vma=False,
+            )
+            return fn(q, k, v)
+        # GQA ratio would change per-shard (wrong kv mapping) — XLA path.
+        return flash_attention.mha(q, k, v, causal=True, force_xla=True,
+                                   window=window)
+
     return flash_attention.mha(q, k, v, causal=True,
                                force_xla=(impl != "flash"), window=window)
 
@@ -551,16 +578,26 @@ def remat_scan_body(
     remat: bool,
     remat_policy: str,
     lora_scale: float = 1.0,
+    layer_stream=None,
 ):
     """The (optionally remat-wrapped) per-layer scan body shared by the
     plain forward and the pipelined forward.
 
     The scan ``xs`` may be either the layer-params dict alone or a
-    ``(layer_params, lora_layer)`` pair when adapters train alongside."""
+    ``(layer_params, lora_layer)`` pair when adapters train alongside.
+
+    ``layer_stream`` is the param-offload streaming seam: a function applied
+    to each layer's params *inside* the (remat-wrapped) body — e.g. a
+    pinned_host→device transfer + compute-dtype cast. Placing it inside the
+    checkpointed body means the backward pass re-streams each layer from
+    host instead of keeping a device-resident copy alive, so weight
+    residency stays O(one layer) in both passes."""
     policy, tag_names = (None, False) if not remat else resolve_remat_policy(remat_policy)
 
     def scan_body(carry, xs):
         layer_params, lora_layer = xs if isinstance(xs, tuple) else (xs, None)
+        if layer_stream is not None:
+            layer_params = layer_stream(layer_params)
         return _block(
             carry, layer_params, cfg, positions, mesh=mesh, tag_names=tag_names,
             lora=lora_layer, lora_scale=lora_scale,
@@ -618,6 +655,7 @@ def forward_hidden_and_aux(
     mesh=None,
     lora: Optional[dict[str, Any]] = None,
     lora_scale: float = 1.0,
+    layer_stream=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Decoder stack only: tokens [B, S] int32 → (hidden [B, S, D] in the
     compute dtype — final norm / LM head NOT applied, see :func:`unembed` —
@@ -629,7 +667,14 @@ def forward_hidden_and_aux(
     The whole layer stack is cast to the compute dtype up front (casting
     per-layer inside the scan body reads cheaper but is a pessimisation:
     XLA saves the *master-dtype* param slices as loop residuals for the
-    backward pass, costing a full fp32 copy instead of a bf16 one)."""
+    backward pass, costing a full fp32 copy instead of a bf16 one).
+
+    ``layer_stream`` (param offload): when set, the up-front cast is
+    SKIPPED — the scan consumes the raw (pinned_host-resident) master-dtype
+    stack and the hook transfers + casts one layer at a time inside the
+    remat-wrapped body (see :func:`remat_scan_body`). An up-front cast here
+    would materialise the full device-resident stack the offload exists to
+    avoid."""
     B, S = tokens.shape
     if cfg.arch == "gpt2" and S > cfg.max_seq_len:
         # Learned position table: jnp.take would silently clamp out-of-range
@@ -642,8 +687,12 @@ def forward_hidden_and_aux(
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
 
     x = embed_tokens(params, tokens, compute_dtype, positions=positions)  # [B, S, D]
-    layer_stack = cast_layer_stack(params, compute_dtype)
-    body = remat_scan_body(cfg, positions, mesh, remat, remat_policy, lora_scale)
+    if layer_stream is None:
+        layer_stack = cast_layer_stack(params, compute_dtype)
+    else:
+        layer_stack = params["layers"]
+    body = remat_scan_body(cfg, positions, mesh, remat, remat_policy, lora_scale,
+                           layer_stream=layer_stream)
     xs = (layer_stack, lora["layers"]) if lora is not None else layer_stack
     x, aux_per_layer = lax.scan(body, x, xs)
     return x, jnp.mean(aux_per_layer)

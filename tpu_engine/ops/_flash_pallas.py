@@ -247,11 +247,6 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _hi_block(k_idx, block: int, window: int):
-    """Highest Q-block index that can see K-block ``k_idx`` under ``window``."""
-    return (k_idx * block + block + window - 2) // block
-
-
 def _q_index(k_idx, j, window: int):
     """Inner grid coordinate → actual Q-block index for the K-major kernel:
     with a window the grid starts at the diagonal (lowest visible Q-block

@@ -125,6 +125,11 @@ class StepProfiler:
         self._t_step_start = None
         return total
 
+    def last_step_phases(self) -> dict[str, float]:
+        """Phase seconds of the most recently ended step (empty before any).
+        Feeds the derived duty-cycle telemetry source."""
+        return dict(self._current)
+
     # -- views --------------------------------------------------------------
 
     @staticmethod

@@ -39,6 +39,7 @@ from tpu_engine.loss_monitor import (
     MonitorConfig,
     TrainingMetrics,
 )
+from tpu_engine import telemetry
 from tpu_engine.preemption import PreemptionWatcher
 from tpu_engine.profiler import StepProfiler
 from tpu_engine.sharding import TPUTrainConfig
@@ -216,6 +217,12 @@ class TrainingJob:
         self.started_at = time.time()
         try:
             self.status = JobStatus.COMPILING
+            # Warm-start compiles across restarts: a preempted job that
+            # resumes pays a cache hit, not a cold compile (the MTTR bound
+            # this module's docstring promises; SURVEY.md §7 hard part c).
+            from tpu_engine.compile_cache import enable_compilation_cache
+
+            enable_compilation_cache(self.config.compilation_cache_dir)
             if self.program is None:
                 self.program = self._build_program()
             prog = self.program
@@ -302,6 +309,13 @@ class TrainingJob:
                 flops_per_token=tfm.train_flops_per_token(prog.model_config, self.config.seq_len),
                 n_devices=prog.runtime.n_devices,
             )
+            # Derived-telemetry scope: only the chips this job's mesh
+            # drives on this host report its duty cycle.
+            local_device_ids = [
+                int(d.id)
+                for d in prog.runtime.mesh.devices.flat
+                if d.process_index == jax.process_index()
+            ]
 
             step = start_step
             while step < self.max_steps and not self._stop.is_set():
@@ -318,6 +332,13 @@ class TrainingJob:
                 dt = self.profiler.end_step()
                 self.last_step_time_s = dt
                 self.tokens_per_sec = tokens_per_batch / dt if dt > 0 else None
+                # Feed the fleet's derived duty-cycle source: device-phase
+                # time (the blocking device→host read absorbs the step's
+                # device execution) over step wall time.
+                telemetry.observe_step(
+                    self.profiler.last_step_phases().get("device", 0.0), dt,
+                    device_ids=local_device_ids,
+                )
                 step = int(host["step"])
                 self.current_step = step
 
@@ -588,23 +609,26 @@ class TrainingJob:
                 raise ValueError("prompt rows must be non-empty")
             if any(t < 0 or t >= vocab for t in row):
                 raise ValueError(f"prompt token id out of range [0, {vocab})")
+        # One consistent weight snapshot for every row; the per-row decode
+        # loop runs with the state lock RELEASED, so a long ragged
+        # generation never stalls the training thread (_params_snapshot
+        # owns its buffers — donation cannot invalidate them).
+        params = self._params_snapshot()
         outs = []
-        with self._state_lock:
-            params = self._full_params_locked()
-            for i, ids in enumerate(prompt_rows):
-                outs.append(
-                    generate(
-                        params,
-                        jnp.asarray([ids], jnp.int32),
-                        self.program.model_config,
-                        max_new_tokens=max_new_tokens,
-                        rng=jax.random.PRNGKey(seed + i),
-                        temperature=temperature,
-                        top_k=top_k,
-                        top_p=top_p,
-                        compute_dtype=self.program.config.compute_dtype(),
-                    )
+        for i, ids in enumerate(prompt_rows):
+            outs.append(
+                generate(
+                    params,
+                    jnp.asarray([ids], jnp.int32),
+                    self.program.model_config,
+                    max_new_tokens=max_new_tokens,
+                    rng=jax.random.PRNGKey(seed + i),
+                    temperature=temperature,
+                    top_k=top_k,
+                    top_p=top_p,
+                    compute_dtype=self.program.config.compute_dtype(),
                 )
+            )
         return [[int(t) for t in jax.device_get(o)[0]] for o in outs]
 
     def speculative_sample(
@@ -641,14 +665,16 @@ class TrainingJob:
                 "shared tokenizer"
             )
         prompt = jnp.asarray([prompt_tokens], jnp.int32)
-        with self._state_lock:
-            params = self._full_params_locked()
-            out, rounds = speculative_generate(
-                params, draft_params, prompt, model_cfg, draft_cfg,
-                max_new_tokens=max_new_tokens, gamma=gamma,
-                compute_dtype=self.program.config.compute_dtype(),
-                return_stats=True,
-            )
+        # Snapshot once; the draft/verify rounds run outside the state lock
+        # (a speculative decode is many dispatches — holding the lock across
+        # them stalled training; round-1 review finding).
+        params = self._params_snapshot()
+        out, rounds = speculative_generate(
+            params, draft_params, prompt, model_cfg, draft_cfg,
+            max_new_tokens=max_new_tokens, gamma=gamma,
+            compute_dtype=self.program.config.compute_dtype(),
+            return_stats=True,
+        )
         return [int(t) for t in jax.device_get(out)[0]], rounds
 
     def _full_params_locked(self):
@@ -663,6 +689,37 @@ class TrainingJob:
         params = self.program.merged_params(params)
         self._merged_cache = (self.current_step, params)
         return params
+
+    def _params_snapshot(self):
+        """A decode-safe snapshot of the current full params.
+
+        Taken under the state lock, returned with the lock RELEASED: the
+        train step donates the live param buffers, so a multi-dispatch
+        decode loop (ragged rows, speculative rounds) must not keep
+        references into the live tree once training can advance. The
+        merged LoRA tree already owns fresh buffers; host-offloaded params
+        are placed on device (generation computes on device either way);
+        the plain dense tree is copied — one extra params-sized allocation
+        for the duration of the generation, in exchange for never stalling
+        the train loop on a long decode (the round-1 review's finding)."""
+        import jax.numpy as jnp
+
+        from jax.sharding import NamedSharding
+
+        from tpu_engine.sharding import OffloadDevice
+
+        with self._state_lock:
+            params = self._full_params_locked()
+            if self.program.merged_params is not None:
+                return params
+            if self.program.config.param_offload == OffloadDevice.HOST:
+                dev_sh = jax.tree.map(
+                    lambda sh: NamedSharding(self.program.mesh, sh.spec),
+                    self.program.state_shardings["params"],
+                    is_leaf=lambda x: isinstance(x, NamedSharding),
+                )
+                return jax.device_put(params, dev_sh)
+            return jax.tree.map(jnp.copy, params)
 
     def export_hf_checkpoint(self, out_dir: str) -> tuple[str, int]:
         """Write the job's current weights (LoRA: base+adapters merged) as a

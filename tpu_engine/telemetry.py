@@ -1,0 +1,390 @@
+"""Live chip telemetry sources for the TPU fleet manager.
+
+The reference reads live temperature / utilization / power / process tables
+from hardware on every poll by shelling out to ``nvidia-smi``
+(``ai_engine/gpu_manager.py:100-117,138-215``). The TPU-native equivalent has
+no subprocess parse; telemetry comes from layered in-process sources, merged
+in priority order by :func:`sample_overlay`:
+
+1. :class:`LibtpuSdkSource` — the libtpu SDK monitoring API
+   (``libtpu.sdk.tpumonitoring``), the same source the ``tpu-info`` CLI
+   renders. Supplies per-chip TensorCore duty cycle, per-core TensorCore
+   utilization, HBM capacity/usage, the device throttle score (the hardware's
+   own thermal/power-throttling signal — TPU metrics expose *throttling*
+   rather than raw die temperature), and per-link ICI health.
+2. :class:`DerivedDutySource` — duty cycle derived from the engine's own step
+   profiler (device-phase wall time / step wall time). The supervisor feeds
+   it after every train step, so fleets report a live duty cycle even where
+   the libtpu metrics service is unreachable (e.g. remote-tunneled chips).
+
+Injected snapshots (``TPUManager.parse_metrics``) bypass this module entirely
+— they are the canned-telemetry test seam, parity with the reference's
+``parse_xml(xml_str=...)``.
+
+Metric string formats are parsed exactly as documented by
+``tpumonitoring.get_metric(name).description()``:
+
+- ``duty_cycle_pct`` / ``tensorcore_util``: ``["0.00", "20.00", ...]``
+  (percent per chip / per core);
+- ``hbm_capacity_usage`` / ``hbm_capacity_total``: ``["1073741824", ...]``
+  (integer bytes per chip);
+- ``tpu_throttle_score``: ``["0-0", "1-1", ...]`` (``<chip>-<score>``,
+  score 0 = not throttled, 1-10 = throttled by 10-100%);
+- ``ici_link_health``: ``["tray1.chip3.ici0.int: 0", ...]`` (``<loc>: <score>``,
+  0 healthy, 1-5 transient, 6-9 persistent minor, 10 unusable).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Optional, Protocol, Sequence
+
+# ---------------------------------------------------------------------------
+# Snapshot / source protocol
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TelemetrySnapshot:
+    """One source's reading: per-chip overlay dicts + fleet-level extras."""
+
+    source: str
+    sampled_at: float
+    # Overlay fields per chip position (0..n_chips-1). Recognised keys:
+    # duty_cycle_pct, tensorcore_util_pct, throttle_score, temperature_c,
+    # power_draw_w, power_limit_w, hbm_total_gb, hbm_used_gb.
+    per_chip: list[dict[str, Any]] = field(default_factory=list)
+    # (location, score) per ICI link, scores per the libtpu scale (0-10).
+    ici_links: list[tuple[str, int]] = field(default_factory=list)
+
+
+class TelemetrySource(Protocol):
+    name: str
+
+    def sample(self, n_chips: int) -> Optional[TelemetrySnapshot]: ...
+
+
+# ---------------------------------------------------------------------------
+# Parsers for the documented libtpu metric string formats
+# ---------------------------------------------------------------------------
+
+
+def parse_float_list(data: Sequence[str]) -> list[float]:
+    """``["0.00", "20.00"]`` → floats; tolerates ``"<idx>: <val>"`` entries."""
+    out: list[float] = []
+    for item in data:
+        s = str(item).strip()
+        if ":" in s:
+            s = s.rsplit(":", 1)[1].strip()
+        try:
+            out.append(float(s))
+        except ValueError:
+            continue
+    return out
+
+
+def parse_indexed_scores(data: Sequence[str]) -> dict[int, int]:
+    """``["0-0", "1-1"]`` → {chip: score}; tolerates ``"<idx>: <score>"``."""
+    out: dict[int, int] = {}
+    for item in data:
+        s = str(item).strip()
+        sep = "-" if "-" in s else (":" if ":" in s else None)
+        if sep is None:
+            continue
+        left, _, right = s.rpartition(sep)
+        try:
+            out[int(left.strip())] = int(float(right.strip()))
+        except ValueError:
+            continue
+    return out
+
+
+def parse_link_scores(data: Sequence[str]) -> list[tuple[str, int]]:
+    """``["tray1.chip3.ici0.int: 0"]`` → [(location, score)]."""
+    out: list[tuple[str, int]] = []
+    for item in data:
+        s = str(item).strip()
+        loc, sep, score = s.rpartition(":")
+        if not sep:
+            continue
+        try:
+            out.append((loc.strip(), int(float(score.strip()))))
+        except ValueError:
+            continue
+    return out
+
+
+def _per_chip_from_cores(values: list[float], n_chips: int) -> list[float]:
+    """Collapse a per-core list to per-chip means (cores enumerate
+    contiguously per chip). Falls back to 1:1 when counts don't divide."""
+    if n_chips <= 0 or not values:
+        return []
+    if len(values) % n_chips == 0:
+        k = len(values) // n_chips
+        return [sum(values[i * k : (i + 1) * k]) / k for i in range(n_chips)]
+    return values[:n_chips]
+
+
+# ---------------------------------------------------------------------------
+# Source: libtpu SDK monitoring
+# ---------------------------------------------------------------------------
+
+
+class LibtpuSdkSource:
+    """Reads ``libtpu.sdk.tpumonitoring`` (the ``tpu-info`` data source).
+
+    ``monitoring=`` injects a stand-in module for tests; the default imports
+    lazily and degrades to unavailable when libtpu (or its SDK) is absent.
+    A sample with no data in any metric returns None — e.g. when the local
+    libtpu is not the runtime actually driving the chips.
+    """
+
+    name = "libtpu_sdk"
+
+    def __init__(self, monitoring: Any = None):
+        self._monitoring = monitoring
+        self._probed = monitoring is not None
+
+    def _mod(self) -> Any:
+        if not self._probed:
+            self._probed = True
+            try:
+                from libtpu.sdk import tpumonitoring  # type: ignore
+
+                self._monitoring = tpumonitoring
+            except Exception:
+                self._monitoring = None
+        return self._monitoring
+
+    def _data(self, supported: set[str], name: str) -> list[str]:
+        if name not in supported:
+            return []
+        try:
+            return list(self._mod().get_metric(name).data())
+        except Exception:
+            return []
+
+    def sample(self, n_chips: int) -> Optional[TelemetrySnapshot]:
+        mod = self._mod()
+        if mod is None:
+            return None
+        try:
+            supported = set(mod.list_supported_metrics())
+        except Exception:
+            return None
+
+        duty = parse_float_list(self._data(supported, "duty_cycle_pct"))
+        util = parse_float_list(self._data(supported, "tensorcore_util"))
+        hbm_used = parse_float_list(self._data(supported, "hbm_capacity_usage"))
+        hbm_total = parse_float_list(self._data(supported, "hbm_capacity_total"))
+        throttle = parse_indexed_scores(self._data(supported, "tpu_throttle_score"))
+        links = parse_link_scores(self._data(supported, "ici_link_health"))
+        if not any((duty, util, hbm_used, hbm_total, throttle, links)):
+            return None
+
+        util_per_chip = _per_chip_from_cores(util, n_chips)
+        per_chip: list[dict[str, Any]] = []
+        for i in range(n_chips):
+            entry: dict[str, Any] = {}
+            if i < len(duty):
+                entry["duty_cycle_pct"] = round(duty[i], 2)
+            if i < len(util_per_chip):
+                entry["tensorcore_util_pct"] = round(util_per_chip[i], 2)
+            if i < len(hbm_total) and hbm_total[i] > 0:
+                entry["hbm_total_gb"] = round(hbm_total[i] / 2**30, 3)
+            if i < len(hbm_used):
+                entry["hbm_used_gb"] = round(hbm_used[i] / 2**30, 3)
+            if i in throttle:
+                entry["throttle_score"] = throttle[i]
+            per_chip.append(entry)
+        return TelemetrySnapshot(
+            source=self.name,
+            sampled_at=time.time(),
+            per_chip=per_chip,
+            ici_links=links,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Source: engine-derived duty cycle
+# ---------------------------------------------------------------------------
+
+
+class DerivedDutySource:
+    """Duty cycle from the engine's own step timing.
+
+    The train loop calls :meth:`observe` with each step's device-phase and
+    total wall seconds; ``sample`` reports
+    ``100 · Σ device / Σ wall`` over a rolling window, applied to every chip
+    of the (SPMD-synchronous) local mesh. Readings expire after
+    ``max_age_s`` so an idle engine stops claiming a duty cycle.
+    """
+
+    name = "derived"
+
+    def __init__(self, window: int = 50, max_age_s: float = 30.0):
+        self._window: deque[tuple[float, float]] = deque(maxlen=window)
+        self._max_age_s = max_age_s
+        self._last_observed: Optional[float] = None
+        self._device_ids: Optional[frozenset[int]] = None
+        self._lock = threading.Lock()
+
+    def observe(
+        self,
+        device_s: float,
+        wall_s: float,
+        device_ids: Optional[Sequence[int]] = None,
+    ) -> None:
+        """Record one step. ``device_ids`` scopes the reading to the chips
+        the step's mesh actually drives (None = every visible chip) — a
+        4-chip job on an 8-chip host must not report the 4 idle chips as
+        busy."""
+        if wall_s <= 0:
+            return
+        with self._lock:
+            self._window.append((max(device_s, 0.0), wall_s))
+            self._last_observed = time.time()
+            self._device_ids = (
+                frozenset(int(i) for i in device_ids)
+                if device_ids is not None
+                else None
+            )
+
+    def reset(self) -> None:
+        with self._lock:
+            self._window.clear()
+            self._last_observed = None
+            self._device_ids = None
+
+    def sample(self, n_chips: int) -> Optional[TelemetrySnapshot]:
+        with self._lock:
+            if (
+                self._last_observed is None
+                or time.time() - self._last_observed > self._max_age_s
+            ):
+                return None
+            device = sum(d for d, _ in self._window)
+            wall = sum(w for _, w in self._window)
+            ids = self._device_ids
+        if wall <= 0:
+            return None
+        duty = round(min(100.0 * device / wall, 100.0), 2)
+        covered = [True] * n_chips
+        if ids is not None:
+            try:
+                import jax
+
+                covered = [
+                    getattr(d, "id", i) in ids
+                    for i, d in enumerate(jax.devices()[:n_chips])
+                ]
+                covered += [False] * (n_chips - len(covered))
+            except Exception:
+                pass
+        return TelemetrySnapshot(
+            source=self.name,
+            sampled_at=time.time(),
+            per_chip=[
+                {"duty_cycle_pct": duty} if c else {} for c in covered
+            ],
+        )
+
+
+# ---------------------------------------------------------------------------
+# Registry + merge
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TelemetryOverlay:
+    """Priority-merged view across sources, ready to lay over the runtime
+    device table."""
+
+    per_chip: list[dict[str, Any]]
+    ici_links: list[tuple[str, int]]
+    sources: list[str]  # names that contributed, priority order
+
+
+_derived = DerivedDutySource()
+_sources: Optional[list[TelemetrySource]] = None
+_sources_lock = threading.Lock()
+
+
+def derived_duty() -> DerivedDutySource:
+    """The process-wide derived-duty source the train loop feeds."""
+    return _derived
+
+
+def observe_step(
+    device_s: float,
+    wall_s: float,
+    device_ids: Optional[Sequence[int]] = None,
+) -> None:
+    """Record one train step's (device seconds, wall seconds), optionally
+    scoped to the device ids the step's mesh drives."""
+    _derived.observe(device_s, wall_s, device_ids=device_ids)
+
+
+def sources() -> list[TelemetrySource]:
+    global _sources
+    with _sources_lock:
+        if _sources is None:
+            _sources = [LibtpuSdkSource(), _derived]
+        return list(_sources)
+
+
+def set_sources(srcs: Optional[list[TelemetrySource]]) -> None:
+    """Replace the registry (None restores the default stack). Test seam."""
+    global _sources
+    with _sources_lock:
+        _sources = list(srcs) if srcs is not None else None
+
+
+def sample_overlay(n_chips: int) -> Optional[TelemetryOverlay]:
+    """Sample every registered source and merge per-chip fields,
+    first-source-wins. None when no source has data."""
+    merged: list[dict[str, Any]] = [{} for _ in range(n_chips)]
+    links: list[tuple[str, int]] = []
+    contributed: list[str] = []
+    for src in sources():
+        try:
+            snap = src.sample(n_chips)
+        except Exception:
+            continue
+        if snap is None:
+            continue
+        used = False
+        for i, entry in enumerate(snap.per_chip[:n_chips]):
+            for k, v in entry.items():
+                if v is not None and k not in merged[i]:
+                    merged[i][k] = v
+                    used = True
+        if snap.ici_links and not links:
+            links = list(snap.ici_links)
+            used = True
+        if used:
+            contributed.append(snap.source)
+    if not contributed:
+        return None
+    return TelemetryOverlay(per_chip=merged, ici_links=links, sources=contributed)
+
+
+def ici_link_alerts(links: Sequence[tuple[str, int]]) -> list[str]:
+    """Fleet alert lines from ICI link scores (libtpu scale: 0 healthy,
+    1-5 transient problem, 6-9 persistent minor problem, 10 unusable)."""
+    alerts: list[str] = []
+    for loc, score in links:
+        if score >= 10:
+            alerts.append(f"CRITICAL: ICI link {loc} unusable (score {score})")
+        elif score >= 6:
+            alerts.append(
+                f"WARNING: persistent ICI problem on link {loc} (score {score})"
+            )
+        elif score >= 1:
+            alerts.append(
+                f"WARNING: transient ICI problem on link {loc} (score {score})"
+            )
+    return alerts

@@ -9,11 +9,14 @@ from the JAX runtime / libtpu rather than an ``nvidia-smi`` subprocess parse
 
 TPU-honest schema notes (SURVEY.md §7 hard part e): there is no fan speed and
 no per-process memory attribution on TPU; instead we report HBM usage from
-``device.memory_stats()``, TensorCore duty cycle / temperature / power when a
-telemetry source provides them (libtpu metrics or an injected snapshot), and
-``None`` otherwise. Health thresholds mirror the reference's semantics
+``device.memory_stats()``, with duty cycle / TensorCore utilization /
+throttle score / ICI link health laid over from the live telemetry stack
+(``tpu_engine.telemetry``: libtpu SDK monitoring + engine-derived duty
+cycle), and temperature / power when an injected or external source provides
+them. Health thresholds mirror the reference's semantics
 (``gpu_manager.py:92-98``): temp 80/90 °C, memory 85/95 %, utilization 95 %,
-power 0.9× limit.
+power 0.9× limit — plus the TPU-native throttle-score thresholds (the
+hardware's own thermal/power-protection signal).
 """
 
 from __future__ import annotations
@@ -62,7 +65,12 @@ class TPUDevice(BaseModel):
     hbm_used_gb: float = 0.0
     hbm_utilization_pct: float = 0.0
 
-    duty_cycle_pct: Optional[float] = None  # TensorCore utilization
+    duty_cycle_pct: Optional[float] = None  # % of time the chip was executing
+    tensorcore_util_pct: Optional[float] = None  # MXU utilization (per-core mean)
+    # libtpu throttle score: 0 = not throttled, 1-10 = throttled by 10-100%.
+    # TPU metrics expose *throttling* rather than raw die temperature — this
+    # is the hardware-honest signal behind the reference's temp/power alerts.
+    throttle_score: Optional[int] = None
     temperature_c: Optional[float] = None
     power_draw_w: Optional[float] = None
     power_limit_w: Optional[float] = None
@@ -103,6 +111,11 @@ class TPUFleetStatus(BaseModel):
     average_temperature_c: Optional[float] = None
     devices: list[TPUDevice] = Field(default_factory=list)
     fleet_alerts: list[str] = Field(default_factory=list)
+    # Live telemetry sources that contributed to this snapshot, priority
+    # order (e.g. ["libtpu_sdk", "derived"]); empty for injected/mock fleets.
+    telemetry_sources: list[str] = Field(default_factory=list)
+    # (location, score) per ICI link when the libtpu source reports them.
+    ici_links: list[tuple[str, int]] = Field(default_factory=list)
 
 
 class TPUManager:
@@ -123,6 +136,9 @@ class TPUManager:
     HBM_CRITICAL_PCT = 95.0
     DUTY_WARNING_PCT = 95.0
     POWER_WARNING_RATIO = 0.9
+    # libtpu throttle score (0-10): >=1 warning, >=6 critical (throttled by
+    # 60%+ — the chip is protecting itself; treat like a temp-critical GPU).
+    THROTTLE_CRITICAL_SCORE = 6
 
     def __init__(self, devices: Optional[Sequence[jax.Device]] = None):
         self._devices = devices  # None = resolve lazily from jax.devices()
@@ -195,6 +211,8 @@ class TPUManager:
                 hbm_used_gb=used,
                 hbm_utilization_pct=round(float(util), 2),
                 duty_cycle_pct=m.get("duty_cycle_pct"),
+                tensorcore_util_pct=m.get("tensorcore_util_pct"),
+                throttle_score=m.get("throttle_score"),
                 temperature_c=m.get("temperature_c"),
                 power_draw_w=m.get("power_draw_w"),
                 power_limit_w=m.get("power_limit_w"),
@@ -240,6 +258,23 @@ class TPUManager:
             if status == TPUHealthStatus.HEALTHY:
                 status = TPUHealthStatus.WARNING
 
+        if dev.throttle_score is not None and dev.throttle_score >= 1:
+            # The chip's own thermal/power protection kicking in — the TPU
+            # analogue of the reference's temperature/power alerts.
+            if dev.throttle_score >= self.THROTTLE_CRITICAL_SCORE:
+                alerts.append(
+                    f"CRITICAL: throttled by {dev.throttle_score * 10}% "
+                    f"(score {dev.throttle_score}/10)"
+                )
+                status = TPUHealthStatus.CRITICAL
+            else:
+                alerts.append(
+                    f"WARNING: throttled by {dev.throttle_score * 10}% "
+                    f"(score {dev.throttle_score}/10)"
+                )
+                if status == TPUHealthStatus.HEALTHY:
+                    status = TPUHealthStatus.WARNING
+
         if (
             dev.power_draw_w is not None
             and dev.power_limit_w is not None
@@ -264,6 +299,8 @@ class TPUManager:
         metrics_json: Optional[str] = None,
     ) -> TPUFleetStatus:
         """Aggregate fleet view (reference ``get_fleet_status``, ``gpu_manager.py:275-321``)."""
+        telemetry_sources: list[str] = []
+        ici_links: list[tuple[str, int]] = []
         if metrics_json is not None:
             devices = self.parse_metrics_json(metrics_json)
         elif metrics is not None:
@@ -277,8 +314,45 @@ class TPUManager:
                 return TPUFleetStatus(
                     fleet_alerts=[f"TPU runtime unavailable: {type(e).__name__}: {e}"]
                 )
+            # Live path: lay the telemetry-source overlay (libtpu SDK
+            # monitoring, engine-derived duty cycle — tpu_engine.telemetry)
+            # over the runtime's memory_stats view, then re-classify health
+            # with the merged fields. This is what makes duty/throttle
+            # alerts fire in production, not just on injected snapshots.
+            from tpu_engine import telemetry
+
+            overlay = telemetry.sample_overlay(len(devices))
+            if overlay is not None:
+                telemetry_sources = overlay.sources
+                ici_links = overlay.ici_links
+                for dev, extra in zip(devices, overlay.per_chip):
+                    for key in (
+                        "duty_cycle_pct",
+                        "tensorcore_util_pct",
+                        "throttle_score",
+                        "temperature_c",
+                        "power_draw_w",
+                        "power_limit_w",
+                    ):
+                        if getattr(dev, key) is None and extra.get(key) is not None:
+                            setattr(dev, key, extra[key])
+                    # HBM: the runtime's memory_stats is exact for this
+                    # process; the SDK fills in only when it gave nothing.
+                    if dev.hbm_used_gb == 0.0 and extra.get("hbm_used_gb"):
+                        dev.hbm_used_gb = extra["hbm_used_gb"]
+                        if extra.get("hbm_total_gb"):
+                            dev.hbm_total_gb = extra["hbm_total_gb"]
+                        if dev.hbm_total_gb > 0:
+                            dev.hbm_utilization_pct = round(
+                                dev.hbm_used_gb / dev.hbm_total_gb * 100.0, 2
+                            )
+                    self._assess_health(dev)
 
         fleet_alerts: list[str] = []
+        if ici_links:
+            from tpu_engine import telemetry
+
+            fleet_alerts.extend(telemetry.ici_link_alerts(ici_links))
         for dev in devices:
             for a in dev.alerts:
                 fleet_alerts.append(f"chip {dev.index}: {a}")
@@ -300,6 +374,8 @@ class TPUManager:
             average_temperature_c=round(sum(temps) / len(temps), 2) if temps else None,
             devices=devices,
             fleet_alerts=fleet_alerts,
+            telemetry_sources=telemetry_sources,
+            ici_links=ici_links,
         )
 
     def select_best_device(

@@ -317,8 +317,21 @@ def build_train_program(
         impl = "ulysses" if cfg.attention_impl == "ulysses" else "ring"
     elif cfg.attention_impl == "auto":
         impl = "flash" if mesh.devices.flat[0].platform == "tpu" else "xla"
+        # The pipelined step vmaps the layer body over the pipe-sharded
+        # stage dim; a shard_map built inside that vmap would mis-handle
+        # the 'pipe' axis (no spmd_axis_name) — auto falls back to XLA
+        # attention under pipeline parallelism.
+        if runtime.axis_sizes["pipe"] > 1:
+            impl = "xla"
     else:
         impl = cfg.attention_impl
+    if impl == "flash" and runtime.axis_sizes["pipe"] > 1 and mesh.size > 1:
+        raise ValueError(
+            "attention_impl='flash' is not supported with pipeline "
+            "parallelism on a multi-device mesh (the Pallas kernel's "
+            "shard_map cannot nest inside the pipeline's vmap over the "
+            "pipe-sharded stage dimension); use attention_impl='auto'/'xla'"
+        )
     if model_cfg.attention_impl != impl:
         model_cfg = model_cfg.with_(attention_impl=impl)
     if cfg.sliding_window is not None and model_cfg.sliding_window != cfg.sliding_window:
@@ -332,9 +345,15 @@ def build_train_program(
             "full-sequence context parallelism); use a mesh without a "
             "sequence axis, or set sliding_window=0"
         )
-    # Mesh is threaded into the forward pass only for sequence-parallel
-    # attention (shard_map over the 'sequence' axis).
-    attn_mesh = mesh if impl in ("ring", "ulysses") else None
+    # Mesh is threaded into the forward pass for sequence-parallel attention
+    # (shard_map over the 'sequence' axis) and for the flash kernel on
+    # multi-device meshes (Mosaic calls cannot be GSPMD-partitioned — the
+    # kernel runs under shard_map, see transformer._attention).
+    attn_mesh = (
+        mesh
+        if impl in ("ring", "ulysses") or (impl == "flash" and mesh.size > 1)
+        else None
+    )
     seq_size = runtime.axis_sizes["sequence"]
     if impl == "ulysses":
         local_heads = model_cfg.n_heads // runtime.axis_sizes["model"]
@@ -372,6 +391,33 @@ def build_train_program(
         if pipe_size > 1:
             raise ValueError("LoRA is not supported with pipeline parallelism")
 
+    # Host-offloaded params (reference ZeRO-3 param CPU offload,
+    # ``deepspeed_launcher.py:204-212``): the master params live in pinned
+    # host memory; the forward/backward streams one layer at a time to
+    # device inside the remat-wrapped scan body (weight residency stays
+    # O(one layer) in both passes), and the optimizer update's param shards
+    # transit device memory before the new params return to host via the
+    # step's out-shardings. Fail fast on unsupported combinations rather
+    # than silently ignoring the knob.
+    offload_params = cfg.param_offload == OffloadDevice.HOST
+    if offload_params and use_lora:
+        raise ValueError(
+            "param_offload is not supported with LoRA (the trainable "
+            "adapters are rank-sized; offloading them saves nothing and the "
+            "frozen base is better streamed via its own placement)"
+        )
+    if offload_params and pipe_size > 1:
+        raise ValueError(
+            "param_offload is not supported with pipeline parallelism "
+            "(pipeline stages re-enter their layer block per microbatch; "
+            "host-streaming weights per stage visit would thrash PCIe)"
+        )
+    if offload_params and not host_memory_kind_available(mesh):
+        raise ValueError(
+            "param_offload=host requires a backend with pinned_host memory "
+            "support (TPU, or the JAX CPU backend)"
+        )
+
     logical = tfm.logical_axes(model_cfg)
 
     # The *trainable* parameter space: the full model, or (LoRA) only the
@@ -381,12 +427,61 @@ def build_train_program(
     g_pspecs = grad_pspecs(train_logical, stage)
     o_pspecs = opt_state_pspecs(train_logical, stage)
 
-    param_sh = named_shardings(mesh, p_pspecs)
+    param_sh = named_shardings(
+        mesh, p_pspecs, memory_kind="pinned_host" if offload_params else None
+    )
     # Full-model sharding: for LoRA this differs from the trainable tree's
     # (frozen base + merged exports); otherwise it IS the trainable one.
     full_param_sh = (
         named_shardings(mesh, param_pspecs(logical, stage)) if use_lora else param_sh
     )
+
+    layer_stream = None
+    if offload_params:
+        # Per-layer pinned_host→device transfer + compute cast, applied
+        # inside the scan body (tfm.remat_scan_body). The slice sharding is
+        # the stacked spec minus its leading layer dimension.
+        def _slice_spec(spec: P) -> P:
+            parts = tuple(spec)
+            return P(*parts[1:]) if parts else P()
+
+        layer_slice_sh = named_shardings(
+            mesh,
+            jax.tree.map(
+                _slice_spec, p_pspecs["layers"], is_leaf=lambda x: isinstance(x, P)
+            ),
+            memory_kind="device",
+        )
+
+        def layer_stream(layer):
+            moved = jax.tree.map(jax.device_put, layer, layer_slice_sh)
+            return jax.tree.map(
+                lambda a: a.astype(compute_dtype)
+                if jnp.issubdtype(a.dtype, jnp.floating)
+                else a,
+                moved,
+            )
+
+        # Non-layer params (embeddings, final norm, head — O(vocab·d), a
+        # sliver of the total) get an explicit on-device view per loss call:
+        # XLA requires operands of one op to share a memory space, and
+        # jnp.take/einsum consume these directly. Their cotangents still
+        # accumulate in device space (device_put's transpose does not bounce
+        # them through host).
+        _nonlayer_dev_sh = {
+            k: named_shardings(mesh, v, memory_kind="device")
+            for k, v in p_pspecs.items()
+            if k != "layers"
+        }
+
+        def _device_view(params):
+            out = dict(params)
+            for k, sh in _nonlayer_dev_sh.items():
+                out[k] = jax.tree.map(jax.device_put, params[k], sh)
+            return out
+    else:
+        def _device_view(params):
+            return params
 
     if use_lora:
         if base_params is None:
@@ -454,7 +549,29 @@ def build_train_program(
         "lr_scale": replicated,
     }
 
-    jit_init = jax.jit(init_fn, out_shardings=state_shardings)
+    opt_sh_tree = state_shardings["opt_state"]
+
+    def _device_kinds(sh_tree):
+        """The same sharding specs with the default (device) memory kind."""
+        return jax.tree.map(
+            lambda sh: NamedSharding(mesh, sh.spec),
+            sh_tree,
+            is_leaf=lambda x: isinstance(x, NamedSharding),
+        )
+
+    # Initialise with device memory kinds, then place offloaded subtrees in
+    # pinned host memory with a one-time device_put outside jit: mixed-kind
+    # out-shardings on constant outputs trip the SPMD partitioner's
+    # placement-annotation handling (observed on the CPU backend), and init
+    # runs once — the transfer is free relative to compile.
+    has_host_kinds = offload_params or opt_memory_kind is not None
+    if has_host_kinds:
+        _jit_init = jax.jit(init_fn, out_shardings=_device_kinds(state_shardings))
+
+        def jit_init(rng):
+            return jax.device_put(_jit_init(rng), state_shardings)
+    else:
+        jit_init = jax.jit(init_fn, out_shardings=state_shardings)
 
     seq_ax = "sequence" if runtime.axis_sizes["sequence"] > 1 else None
     batch_sharding = NamedSharding(mesh, P(None, BATCH_AXES, seq_ax))
@@ -472,6 +589,7 @@ def build_train_program(
         """
         # In-band SFT masking: -(t+1) positions are context-only (no loss).
         tokens, loss_tokens = decode_masked_tokens(raw_tokens)
+        params = _device_view(params)  # no-op unless param_offload
         hidden, aux = tfm.forward_hidden_and_aux(
             params,
             tokens,
@@ -482,6 +600,7 @@ def build_train_program(
             mesh=attn_mesh,
             lora=lora_params,
             lora_scale=(cfg.lora_alpha / cfg.lora_rank) if use_lora else 1.0,
+            layer_stream=layer_stream,
         )
         # include_aux gates the training-only regularisers (MoE aux, z-loss)
         # so eval_step reports pure cross-entropy.
@@ -582,13 +701,53 @@ def build_train_program(
 
         pipe_grad_fn = jax.value_and_grad(pipe_loss_fn)
 
+    # Gradient collective dtype (reference ``communication_data_type``,
+    # ``deepspeed_launcher.py:60-62,167-169``). A post-hoc cast cannot move
+    # the collective's dtype — XLA inserts the grad reduction inside the
+    # backward pass, upstream of anything applied to ``grad_fn``'s result.
+    # The mechanism that works (and is what DeepSpeed's fp16-grads mode
+    # actually does) is differentiating with respect to the *compute-dtype*
+    # params: the whole cotangent chain, including the reduction point,
+    # then carries the comm dtype; the upcast to fp32 happens once, after
+    # the sharding constraint, for accumulation and the master update.
+    # Config validation guarantees comm dtype == compute dtype (or fp32).
+    comm_dtype = (
+        dtype_of(cfg.grad_allreduce_dtype)
+        if cfg.grad_allreduce_dtype is not None
+        else None
+    )
+    reduced_comm = comm_dtype is not None and comm_dtype != jnp.float32
+    if reduced_comm and offload_params:
+        raise ValueError(
+            "grad_allreduce_dtype with param_offload=host is not supported: "
+            "offloaded layers already stream in the compute dtype, and the "
+            "host-resident master tree cannot be re-cast in device code"
+        )
+
+    def _cast_for_grad(params):
+        if not reduced_comm:
+            return params
+        return jax.tree.map(
+            lambda p: p.astype(comm_dtype)
+            if jnp.issubdtype(p.dtype, jnp.floating)
+            else p,
+            params,
+        )
+
+    def _reduce_grads(grads):
+        # Grads arrive in the comm dtype (reduced_comm) or fp32; the
+        # constraint pins where XLA materialises the reduce-scatter /
+        # all-reduce (stage >= 2: sharded — ZeRO-2 semantics).
+        grads = jax.lax.with_sharding_constraint(grads, grad_sh)
+        return jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+
     def train_step(state, batch):
         params = state["params"]
+        params_g = _cast_for_grad(params)
 
         if pipe_size > 1:
-            loss, grads = pipe_grad_fn(params, batch)
-            grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
-            grads = jax.lax.with_sharding_constraint(grads, grad_sh)
+            loss, grads = pipe_grad_fn(params_g, batch)
+            grads = _reduce_grads(grads)
         else:
             accum = batch.shape[0]
             # Batch-wide valid-target count (masked SFT targets excluded):
@@ -600,12 +759,13 @@ def build_train_program(
 
             def accum_body(carry, tokens):
                 loss_acc, grad_acc = carry
-                loss, grads = grad_fn(params, tokens, True, denom=denom,
+                loss, grads = grad_fn(params_g, tokens, True, denom=denom,
                                       aux_weight=1.0 / accum)
-                grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
-                # Stage >= 2: constrain accumulated grads to fsdp shards so XLA
-                # reduce-scatters instead of all-reducing (ZeRO-2 semantics).
-                grads = jax.lax.with_sharding_constraint(grads, grad_sh)
+                # Stage >= 2: the constraint to fsdp shards makes XLA
+                # reduce-scatter instead of all-reduce (ZeRO-2 semantics);
+                # _reduce_grads routes the collective through the configured
+                # comm dtype, accumulation stays fp32.
+                grads = _reduce_grads(grads)
                 grad_acc = jax.tree.map(jnp.add, grad_acc, grads)
                 return (loss_acc + loss, grad_acc), None
 
@@ -617,10 +777,22 @@ def build_train_program(
             grads = grad_sum
         grad_norm = optax.global_norm(grads)
 
+        # Offloaded subtrees stream through device memory for the update
+        # math (the per-device transient is the 1/N shard — reference
+        # "streamed to device inside the update", ``deepspeed_launcher.py:
+        # 197-203``) and are placed back in pinned host memory explicitly,
+        # so the step's out-shardings see already-host-resident values.
+        opt_in = state["opt_state"]
+        if opt_memory_kind is not None:
+            opt_in = jax.tree.map(jax.device_put, opt_in, _device_kinds(opt_sh_tree))
+        params_upd = params
+        if offload_params:
+            params_upd = jax.tree.map(jax.device_put, params, _device_kinds(param_sh))
+
         lr = schedule(state["step"]).astype(jnp.float32) * state["lr_scale"]
-        updates, new_opt_state = tx.update(grads, state["opt_state"], params)
+        updates, new_opt_state = tx.update(grads, opt_in, params_upd)
         updates = jax.tree.map(lambda u: (-lr * u).astype(u.dtype), updates)
-        new_params = optax.apply_updates(params, updates)
+        new_params = optax.apply_updates(params_upd, updates)
         new_state = {
             "params": new_params,
             "opt_state": new_opt_state,
@@ -635,12 +807,33 @@ def build_train_program(
         }
         return new_state, metrics
 
-    jit_step = jax.jit(
-        train_step,
-        in_shardings=(state_shardings, batch_sharding),
-        out_shardings=(state_shardings, None),
-        donate_argnums=(0,),
-    )
+    # Host-kind out-shardings are the production (TPU) path: the updated
+    # offloaded subtrees materialise straight into pinned host memory. The
+    # CPU backend's SPMD partitioner cannot compile placement-annotated
+    # outputs (RET_CHECK on the annotation it puts on replicated scalars)
+    # and silently drops in-body host placements — so off-TPU the step
+    # computes with device-kind outputs and the offloaded subtrees are
+    # re-placed on host with a device_put *outside* jit. Semantically
+    # identical; the CPU path exists so the 8-virtual-device test mesh can
+    # exercise offloaded configs at all.
+    on_tpu = mesh.devices.flat[0].platform == "tpu"
+    if has_host_kinds and not on_tpu:
+        _jit_step = jax.jit(
+            train_step,
+            in_shardings=(state_shardings, batch_sharding),
+            out_shardings=None,
+        )
+
+        def jit_step(state, batch):
+            new_state, metrics = _jit_step(state, batch)
+            return jax.device_put(new_state, state_shardings), metrics
+    else:
+        jit_step = jax.jit(
+            train_step,
+            in_shardings=(state_shardings, batch_sharding),
+            out_shardings=(state_shardings, None),
+            donate_argnums=(0,),
+        )
 
     def eval_step(state, batch):
         """Held-out loss over one [accum, B, S] batch — pure cross-entropy
