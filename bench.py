@@ -48,9 +48,20 @@ def _candidates(n_dev: int, on_tpu: bool) -> list[TPUTrainConfig]:
     # micro_batch_size is per data-parallel shard (the program scales the
     # global batch by the data×fsdp extent itself).
     return [
+        # Best measured (benchmarks/mfu_sweep.py, v5e 16 GiB): micro-batch 6
+        # with bf16 Adam first moments — the halved mu buffer (~2 GiB at 1B
+        # params) buys the activation headroom that lifts MFU past the
+        # micro-batch-4 plateau. 53.4% measured.
+        TPUTrainConfig(model_name="llama-1b", micro_batch_size=6,
+                       moment_dtype="bf16",
+                       activation_checkpointing=True, **common),
         TPUTrainConfig(model_name="llama-1b", micro_batch_size=8,
+                       moment_dtype="bf16",
                        activation_checkpointing=True, **common),
         TPUTrainConfig(model_name="llama-1b", micro_batch_size=4,
+                       activation_checkpointing=True, **common),
+        TPUTrainConfig(model_name="llama-1b", micro_batch_size=4,
+                       loss_chunk_size=512,
                        activation_checkpointing=True, **common),
         TPUTrainConfig(model_name="gpt-125m", micro_batch_size=16,
                        activation_checkpointing=True, **common),

@@ -161,6 +161,21 @@ def test_derived_duty_scoped_to_job_devices():
     assert snap.per_chip[0]["duty_cycle_pct"] == 80.0
 
 
+def test_derived_duty_concurrent_jobs_do_not_blend():
+    """Two jobs on disjoint chip subsets keep separate duty readings
+    (round-2 review finding: a shared window would blend their timings)."""
+    import jax
+
+    src = DerivedDutySource()
+    ids = [int(d.id) for d in jax.devices()]
+    src.observe(device_s=0.9, wall_s=1.0, device_ids=ids[:4])   # busy job
+    src.observe(device_s=0.1, wall_s=1.0, device_ids=ids[4:8])  # idle-ish job
+    snap = src.sample(8)
+    assert [c.get("duty_cycle_pct") for c in snap.per_chip] == (
+        [90.0] * 4 + [10.0] * 4
+    )
+
+
 # -- overlay merge + live-path health ---------------------------------------
 
 

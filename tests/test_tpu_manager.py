@@ -98,3 +98,15 @@ def test_live_runtime_fleet_on_cpu_backend():
     fleet = TPUManager().get_fleet_status()
     assert fleet.total_devices == 8
     assert all(d.platform == "cpu" for d in fleet.devices)
+
+
+def test_fleet_cli_renders_table(capsys):
+    from tpu_engine.tpu_manager import main
+
+    assert main(["--mock"]) == 0
+    out = capsys.readouterr().out
+    assert "devices: 8 (7 available)" in out
+    assert "warning" in out
+    assert any(line.startswith("!") for line in out.splitlines())
+    assert main(["--mock", "--json"]) == 0
+    assert '"total_devices":8' in capsys.readouterr().out.replace(" ", "")

@@ -347,16 +347,18 @@ def main(argv: Optional[list[str]] = None) -> int:
 
     apply_comm_flags(config)
 
+    # Multi-host rendezvous FIRST: jax.distributed.initialize() refuses to
+    # run once any jax call has initialised the XLA backend — and the
+    # compile-cache enable below probes the backend platform.
+    from tpu_engine.mesh_runtime import initialize_distributed
+
+    initialize_distributed()
+
     # Persistent compilation cache: restarts of this worker (preemption,
     # elastic relaunch) warm-start their compiles (tpu_engine/compile_cache).
     from tpu_engine.compile_cache import enable_compilation_cache
 
     enable_compilation_cache(config.compilation_cache_dir)
-
-    # Multi-host rendezvous (no-op single-process; GKE env autodetected).
-    from tpu_engine.mesh_runtime import initialize_distributed
-
-    initialize_distributed()
 
     result = launcher.launch(
         config,

@@ -713,12 +713,27 @@ class TrainingJob:
             if self.program.merged_params is not None:
                 return params
             if self.program.config.param_offload == OffloadDevice.HOST:
+                # Stream + cast to the compute dtype in one compiled call:
+                # generation computes in it anyway, and the device-resident
+                # snapshot costs half the fp32 master — relevant because an
+                # offloaded job's training footprint may be tuned close to
+                # the HBM limit and training continues while we decode.
                 dev_sh = jax.tree.map(
                     lambda sh: NamedSharding(self.program.mesh, sh.spec),
                     self.program.state_shardings["params"],
                     is_leaf=lambda x: isinstance(x, NamedSharding),
                 )
-                return jax.device_put(params, dev_sh)
+                compute_dtype = self.program.config.compute_dtype()
+                cast = jax.jit(
+                    lambda t: jax.tree.map(
+                        lambda a: a.astype(compute_dtype)
+                        if jnp.issubdtype(a.dtype, jnp.floating)
+                        else a,
+                        t,
+                    ),
+                    out_shardings=dev_sh,
+                )
+                return cast(params)
             return jax.tree.map(jnp.copy, params)
 
     def export_hf_checkpoint(self, out_dir: str) -> tuple[str, int]:

@@ -226,10 +226,15 @@ class DerivedDutySource:
     name = "derived"
 
     def __init__(self, window: int = 50, max_age_s: float = 30.0):
-        self._window: deque[tuple[float, float]] = deque(maxlen=window)
+        # Observations are kept PER DEVICE SCOPE (the frozenset of chip
+        # ids a job's mesh drives; None = the whole host): two concurrent
+        # jobs on disjoint chip subsets must not blend their step timings
+        # into one meaningless ratio.
+        self._scopes: dict[
+            Optional[frozenset[int]], tuple[deque[tuple[float, float]], float]
+        ] = {}
+        self._window = window
         self._max_age_s = max_age_s
-        self._last_observed: Optional[float] = None
-        self._device_ids: Optional[frozenset[int]] = None
         self._lock = threading.Lock()
 
     def observe(
@@ -244,52 +249,56 @@ class DerivedDutySource:
         busy."""
         if wall_s <= 0:
             return
+        key = (
+            frozenset(int(i) for i in device_ids)
+            if device_ids is not None
+            else None
+        )
         with self._lock:
-            self._window.append((max(device_s, 0.0), wall_s))
-            self._last_observed = time.time()
-            self._device_ids = (
-                frozenset(int(i) for i in device_ids)
-                if device_ids is not None
-                else None
-            )
+            window, _ = self._scopes.get(key) or (deque(maxlen=self._window), 0.0)
+            window.append((max(device_s, 0.0), wall_s))
+            self._scopes[key] = (window, time.time())
 
     def reset(self) -> None:
         with self._lock:
-            self._window.clear()
-            self._last_observed = None
-            self._device_ids = None
+            self._scopes.clear()
 
     def sample(self, n_chips: int) -> Optional[TelemetrySnapshot]:
+        now = time.time()
+        duties: list[tuple[Optional[frozenset[int]], float]] = []
         with self._lock:
-            if (
-                self._last_observed is None
-                or time.time() - self._last_observed > self._max_age_s
-            ):
-                return None
-            device = sum(d for d, _ in self._window)
-            wall = sum(w for _, w in self._window)
-            ids = self._device_ids
-        if wall <= 0:
+            for key, (window, last) in list(self._scopes.items()):
+                if now - last > self._max_age_s:
+                    del self._scopes[key]  # stale scope: job gone idle
+                    continue
+                device = sum(d for d, _ in window)
+                wall = sum(w for _, w in window)
+                if wall > 0:
+                    duties.append(
+                        (key, round(min(100.0 * device / wall, 100.0), 2))
+                    )
+        if not duties:
             return None
-        duty = round(min(100.0 * device / wall, 100.0), 2)
-        covered = [True] * n_chips
-        if ids is not None:
-            try:
-                import jax
+        chip_ids: list[Optional[int]] = list(range(n_chips))
+        try:
+            import jax
 
-                covered = [
-                    getattr(d, "id", i) in ids
-                    for i, d in enumerate(jax.devices()[:n_chips])
-                ]
-                covered += [False] * (n_chips - len(covered))
-            except Exception:
-                pass
+            chip_ids = [
+                getattr(d, "id", i) for i, d in enumerate(jax.devices()[:n_chips])
+            ] + [None] * max(0, n_chips - len(jax.devices()))
+        except Exception:
+            pass
+        per_chip: list[dict[str, Any]] = []
+        for cid in chip_ids:
+            entry: dict[str, Any] = {}
+            # A scoped (per-job) reading beats the unscoped whole-host one.
+            for key, duty in sorted(duties, key=lambda kv: kv[0] is None):
+                if key is None or (cid is not None and cid in key):
+                    entry = {"duty_cycle_pct": duty}
+                    break
+            per_chip.append(entry)
         return TelemetrySnapshot(
-            source=self.name,
-            sampled_at=time.time(),
-            per_chip=[
-                {"duty_cycle_pct": duty} if c else {} for c in covered
-            ],
+            source=self.name, sampled_at=now, per_chip=per_chip
         )
 
 

@@ -435,3 +435,52 @@ class TPUManager:
             )
         fleet = mgr.get_fleet_status(metrics=metrics)
         return fleet
+
+
+# ---------------------------------------------------------------------------
+# CLI — `python -m tpu_engine.tpu_manager` (the tpu-info / nvidia-smi UX:
+# one fleet table, live sources when available).
+# ---------------------------------------------------------------------------
+
+
+def _fmt(v: Any, suffix: str = "") -> str:
+    return "-" if v is None else f"{v}{suffix}"
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="TPU fleet status")
+    parser.add_argument("--mock", action="store_true", help="show the mock fleet")
+    parser.add_argument("--json", action="store_true", help="raw JSON instead of a table")
+    args = parser.parse_args(argv)
+
+    fleet = TPUManager.get_mock_fleet() if args.mock else TPUManager().get_fleet_status()
+    if args.json:
+        print(fleet.model_dump_json(indent=2))
+        return 0
+
+    src = ",".join(fleet.telemetry_sources) or "runtime"
+    print(
+        f"devices: {fleet.total_devices} ({fleet.available_devices} available)"
+        f"   HBM: {fleet.used_hbm_gb:.1f}/{fleet.total_hbm_gb:.1f} GiB"
+        f"   telemetry: {src}"
+    )
+    header = f"{'idx':>3} {'kind':<14} {'hbm':>13} {'duty%':>6} {'mxu%':>6} {'thr':>4} {'temp':>5} {'health':<8}"
+    print(header)
+    print("-" * len(header))
+    for d in fleet.devices:
+        print(
+            f"{d.index:>3} {d.device_kind:<14} "
+            f"{d.hbm_used_gb:>5.1f}/{d.hbm_total_gb:<5.1f}G "
+            f"{_fmt(d.duty_cycle_pct):>6} {_fmt(d.tensorcore_util_pct):>6} "
+            f"{_fmt(d.throttle_score):>4} {_fmt(d.temperature_c):>5} "
+            f"{d.health_status.value:<8}"
+        )
+    for a in fleet.fleet_alerts:
+        print(f"! {a}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
