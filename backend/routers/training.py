@@ -289,6 +289,9 @@ class GenerateRequest(BaseModel):
     top_k: Optional[int] = Field(default=None, ge=1)
     top_p: Optional[float] = Field(default=None, gt=0.0, le=1.0)
     seed: int = 0
+    # KV-cache precision: "int8" stores keys/values quantised with
+    # per-(position, head) scales — half the decode HBM of bf16.
+    kv_cache: Literal["bf16", "int8"] = "bf16"
     # Speculative decoding: a local HF checkpoint directory holding a small
     # draft model (same tokenizer/vocab). Greedy only, single prompt row.
     draft_hf_checkpoint: Optional[str] = None
@@ -387,6 +390,12 @@ async def generate_from_job(request: web.Request) -> web.Response:
             raise ApiError(422, "speculative decoding is greedy (temperature=0)")
         if req.prompt_tokens is None or len(req.prompt_tokens) != 1:
             raise ApiError(422, "speculative decoding takes one prompt_tokens row")
+        if req.kv_cache != "bf16":
+            # No silent no-ops: the speculative path runs full-precision
+            # caches (draft + target) today.
+            raise ApiError(
+                422, "kv_cache='int8' is not supported with speculative decoding"
+            )
 
         try:
             tokens, rounds = await asyncio.to_thread(
@@ -415,6 +424,7 @@ async def generate_from_job(request: web.Request) -> web.Response:
             top_k=req.top_k,
             top_p=req.top_p,
             seed=req.seed,
+            kv_quant=req.kv_cache == "int8",
         )
 
     def text_work():
@@ -431,6 +441,7 @@ async def generate_from_job(request: web.Request) -> web.Response:
             top_k=req.top_k,
             top_p=req.top_p,
             seed=req.seed,
+            kv_quant=req.kv_cache == "int8",
         )
         texts = [tok.decode(row[len(ids):]) for ids, row in zip(prompts, rows)]
         return rows, texts

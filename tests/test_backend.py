@@ -374,6 +374,27 @@ def test_generate_from_job(client):
     b = client.post(f"/api/v1/training/jobs/{job_id}/generate", json=j).json()
     assert a["tokens"] == b["tokens"]
 
+    # int8 KV cache over HTTP: greedy output matches the bf16 cache (the
+    # quantisation error is far below random-init logit gaps).
+    g = {"prompt_tokens": [[1, 2, 3, 4]], "max_new_tokens": 5}
+    full = client.post(f"/api/v1/training/jobs/{job_id}/generate", json=g).json()
+    q = client.post(
+        f"/api/v1/training/jobs/{job_id}/generate", json={**g, "kv_cache": "int8"}
+    ).json()
+    assert q["tokens"] == full["tokens"]
+    # Unknown kv_cache values are a 422.
+    r = client.post(
+        f"/api/v1/training/jobs/{job_id}/generate",
+        json={**g, "kv_cache": "int4"},
+    )
+    assert r.status_code == 422
+    # int8 + speculative is rejected (no silent full-precision fallback).
+    r = client.post(
+        f"/api/v1/training/jobs/{job_id}/generate",
+        json={**g, "kv_cache": "int8", "draft_hf_checkpoint": "/nope"},
+    )
+    assert r.status_code == 422 and "speculative" in r.text
+
     # Ragged prompts are a 422, not a crash.
     r = client.post(
         f"/api/v1/training/jobs/{job_id}/generate",

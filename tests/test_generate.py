@@ -338,5 +338,46 @@ def test_gpt2_position_table_bounds():
                  compute_dtype=jnp.float32)
 
 
+def test_int8_kv_cache_close_to_full_precision():
+    """Quantised (int8 + per-(position, head) scales) cache: logits within
+    ~1% of the full-precision cache, half the storage."""
+    cfg, params, tokens = _setup()
+    B, S = tokens.shape
+    c_full = init_cache(cfg, B, S, dtype=jnp.float32)
+    c_q = init_cache(cfg, B, S, dtype=jnp.float32, kv_quant=True)
+    assert c_q.k.dtype == jnp.int8 and c_q.quantized
+    assert c_q.k_scale.shape == c_q.k.shape[:-1] + (1,)
+    l_full, _ = forward_with_cache(params, tokens, c_full, cfg, jnp.float32)
+    l_q, _ = forward_with_cache(params, tokens, c_q, cfg, jnp.float32)
+    scale = float(jnp.max(jnp.abs(l_full)))
+    assert float(jnp.max(jnp.abs(l_full - l_q))) < 0.02 * scale
+
+
+def test_int8_kv_cache_greedy_generation_matches():
+    cfg, params, _ = _setup()
+    prompt = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+    full = generate(params, prompt, cfg, max_new_tokens=10,
+                    compute_dtype=jnp.float32)
+    q = generate(params, prompt, cfg, max_new_tokens=10,
+                 compute_dtype=jnp.float32, kv_quant=True)
+    # Random-init logit gaps dwarf the ~1% quantisation error, so greedy
+    # decode must agree exactly here.
+    assert np.array_equal(np.asarray(full), np.asarray(q))
+
+
+def test_int8_kv_cache_windowed_ring():
+    """Quantised cache composes with the sliding-window ring buffer: the
+    scale rows wrap with the code rows."""
+    cfg, params, _ = _setup()
+    cfgw = cfg.with_(sliding_window=6)
+    prompt = jnp.asarray([[3, 1, 4, 1, 5, 9, 2, 6]], jnp.int32)
+    full = generate(params, prompt, cfgw, max_new_tokens=12,
+                    compute_dtype=jnp.float32)
+    q = generate(params, prompt, cfgw, max_new_tokens=12,
+                 compute_dtype=jnp.float32, kv_quant=True)
+    assert np.asarray(q).shape == np.asarray(full).shape
+    assert (np.asarray(q) == np.asarray(full)).mean() > 0.9
+
+
 # Compile-heavy module: excluded from the fast core run (pytest -m "not slow").
 pytestmark = pytest.mark.slow

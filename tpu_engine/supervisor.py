@@ -545,6 +545,7 @@ class TrainingJob:
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
         seed: int = 0,
+        kv_quant: bool = False,
     ) -> list[list[int]]:
         """Sample continuations from the job's *current* weights.
 
@@ -581,6 +582,7 @@ class TrainingJob:
                 top_k=top_k,
                 top_p=top_p,
                 compute_dtype=self.program.config.compute_dtype(),
+                kv_quant=kv_quant,
             )
         return [[int(t) for t in row] for row in jax.device_get(out)]
 
@@ -592,6 +594,7 @@ class TrainingJob:
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
         seed: int = 0,
+        kv_quant: bool = False,
     ) -> list[list[int]]:
         """Sample continuations for rows of *different* lengths — each row
         decodes separately (no padding mask exists), but every dispatch
@@ -627,6 +630,7 @@ class TrainingJob:
                     top_k=top_k,
                     top_p=top_p,
                     compute_dtype=self.program.config.compute_dtype(),
+                    kv_quant=kv_quant,
                 )
             )
         return [[int(t) for t in jax.device_get(o)[0]] for o in outs]
