@@ -140,6 +140,13 @@ def test_unknown_remat_policy_rejected():
         build_train_program(tiny_config(remat_policy="attn_out"))  # typo
 
 
+def test_offload_dots_policy_rejected_off_tpu():
+    # The activation-offload policy exists (TPU-only); off-TPU it is a
+    # clear build-time error, not a partitioner crash at first step.
+    with pytest.raises(ValueError, match="offload_dots"):
+        build_train_program(tiny_config(remat_policy="offload_dots"))
+
+
 def test_moment_dtype_halves_mu_buffer():
     """moment_dtype=BF16 stores Adam mu in bf16; nu stays at master dtype."""
     _, state, losses = run_steps(tiny_config(moment_dtype=Precision.BF16))

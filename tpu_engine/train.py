@@ -382,6 +382,15 @@ def build_train_program(
             f"loss_chunk_size={cfg.loss_chunk_size} must divide seq_len={cfg.seq_len}"
         )
     tfm.resolve_remat_policy(cfg.remat_policy)  # fail fast on typos
+    if (
+        cfg.remat_policy == "offload_dots"
+        and mesh.devices.flat[0].platform != "tpu"
+    ):
+        raise ValueError(
+            "remat_policy='offload_dots' requires TPU (the CPU SPMD "
+            "partitioner cannot compile the policy's host-placement "
+            "annotations)"
+        )
 
     use_lora = cfg.lora_rank is not None
     if use_lora:
