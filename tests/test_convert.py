@@ -19,6 +19,7 @@ import jax.numpy as jnp  # noqa: E402
 from tpu_engine.models import transformer as tfm  # noqa: E402
 from tpu_engine.models.convert import (  # noqa: E402
     config_from_hf,
+    from_hf,
     from_hf_llama,
     to_hf_llama,
 )
@@ -268,6 +269,74 @@ def test_gpt2_unsupported_variants_rejected():
         config_from_hf(GPT2Config(activation_function="relu"))
     with pytest.raises(ValueError, match="scale_attn_by_inverse_layer_idx"):
         config_from_hf(GPT2Config(scale_attn_by_inverse_layer_idx=True))
+
+
+# ---------------------------------------------------------------------------
+# Gemma family
+# ---------------------------------------------------------------------------
+
+
+def _tiny_gemma(seed=0, n_kv=1):
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    torch.manual_seed(seed)
+    hf_cfg = GemmaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=n_kv,
+        head_dim=32, max_position_embeddings=128, rms_norm_eps=1e-6,
+        rope_theta=10_000.0, attn_implementation="eager",
+    )
+    return hf_cfg, GemmaForCausalLM(hf_cfg).eval()
+
+
+@pytest.mark.parametrize("n_kv", [1, 4])  # MQA (gemma-2b) and MHA (gemma-7b)
+def test_gemma_to_ours_logit_parity(n_kv):
+    """Pins the whole Gemma recipe against transformers: sqrt(d)-scaled
+    embeddings, zero-centred RMSNorm, GeGLU, decoupled head_dim=32
+    (!= 64/4 = 16), tied head, MQA grouping."""
+    hf_cfg, model = _tiny_gemma(n_kv=n_kv)
+    cfg = config_from_hf(hf_cfg)
+    assert cfg.arch == "gemma" and cfg.head_dim == 32 and cfg.n_kv_heads == n_kv
+    params = from_hf(model.state_dict(), cfg)
+    assert "lm_head" not in params  # tied
+
+    tokens = np.random.default_rng(8).integers(0, 256, (2, 16))
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(
+        tfm.forward(params, jnp.asarray(tokens, jnp.int32), cfg, compute_dtype=jnp.float32)
+    )
+    np.testing.assert_allclose(ours, hf_logits, atol=2e-3, rtol=2e-3)
+
+
+def test_gemma_export_roundtrip(tmp_path):
+    from transformers import GemmaForCausalLM
+
+    from tpu_engine.models.convert import save_hf_checkpoint
+
+    cfg = tfm.MODEL_CONFIGS["gemma-tiny"]
+    params = tfm.init_params(jax.random.PRNGKey(13), cfg)
+    out = save_hf_checkpoint(params, cfg, str(tmp_path / "gemma-export"))
+    reloaded = GemmaForCausalLM.from_pretrained(out, attn_implementation="eager").eval()
+    assert reloaded.config.head_dim == 32
+    tokens = np.random.default_rng(9).integers(0, cfg.vocab_size, (1, 24))
+    with torch.no_grad():
+        hf_logits = reloaded(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(
+        tfm.forward(params, jnp.asarray(tokens, jnp.int32), cfg, compute_dtype=jnp.float32)
+    )
+    np.testing.assert_allclose(ours, hf_logits, atol=2e-3, rtol=2e-3)
+
+
+def test_gemma2_features_rejected():
+    from transformers import GemmaConfig
+
+    cfg = GemmaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=1, num_attention_heads=2,
+                      num_key_value_heads=1, head_dim=16)
+    cfg.final_logit_softcapping = 30.0
+    with pytest.raises(ValueError, match="softcapping"):
+        config_from_hf(cfg)
 
 
 # Compile-heavy module: excluded from the fast core run (pytest -m "not slow").

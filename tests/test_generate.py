@@ -325,6 +325,25 @@ def test_gpt2_decode_matches_forward():
                                    atol=2e-4, rtol=2e-4)
 
 
+def test_gemma_decode_matches_forward():
+    """Gemma decode (sqrt(d)-scaled embeddings, zero-centred RMSNorm,
+    GeGLU, decoupled head_dim, MQA, tied head) must match the training
+    forward position-for-position."""
+    cfg, params, tokens = _setup(name="gemma-tiny")
+    B, S = tokens.shape
+    full = tfm.forward(params, tokens, cfg, compute_dtype=jnp.float32)
+    cache = init_cache(cfg, B, S, dtype=jnp.float32)
+    logits, cache = forward_with_cache(params, tokens[:, :5], cache, cfg,
+                                       compute_dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(full[:, :5]),
+                               atol=2e-4, rtol=2e-4)
+    for t in range(5, 9):
+        logits, cache = forward_with_cache(params, tokens[:, t:t+1], cache, cfg,
+                                           compute_dtype=jnp.float32)
+        np.testing.assert_allclose(np.asarray(logits[:, 0]), np.asarray(full[:, t]),
+                                   atol=2e-4, rtol=2e-4)
+
+
 def test_gpt2_position_table_bounds():
     """Out-of-table positions must raise, not silently clamp."""
     cfg, params, _ = _setup(name="gpt2-tiny")

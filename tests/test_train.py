@@ -223,6 +223,16 @@ def test_gpt2_arch_trains():
     assert losses[-1] < losses[0] * 0.7, losses
 
 
+def test_gemma_arch_trains():
+    """Gemma family (zero-centred RMSNorm, GeGLU, sqrt(d)-scaled embeddings,
+    decoupled head_dim, MQA, tied head) trains end-to-end on a sharded mesh
+    with tensor parallelism; loss decreases."""
+    cfg = tiny_config(model_name="gemma-tiny",
+                      mesh=MeshConfig(data=2, fsdp=2, model=2))
+    _, _, losses = run_steps(cfg, n=8)
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
 # -- SFT loss masking --------------------------------------------------------
 
 

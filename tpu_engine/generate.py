@@ -302,7 +302,7 @@ def forward_with_cache(
                 cache_arr, rows.astype(cache_arr.dtype), (0, offset, 0, 0)
             )
 
-    x = embed_tokens(params, tokens, compute_dtype, positions=positions)
+    x = embed_tokens(params, tokens, compute_dtype, positions=positions, cfg=cfg)
     layer_stack = cast_layer_stack(params, compute_dtype)
 
     # One scan body serves both cache precisions: the scale stacks simply

@@ -45,8 +45,19 @@ def config_from_hf(hf_config: Any) -> ModelConfig:
     ``rope_scaling``) and a ``head_dim`` decoupled from
     ``hidden_size // num_attention_heads`` are rejected.
     """
-    if getattr(hf_config, "model_type", "") == "gpt2":
+    model_type = getattr(hf_config, "model_type", "")
+    if model_type == "gpt2":
         return config_from_hf_gpt2(hf_config)
+    if model_type == "gemma":
+        return config_from_hf_gemma(hf_config)
+    if model_type in ("gemma2", "gemma3", "gemma3_text"):
+        # Route real Gemma-2/3 configs to an honest rejection, not the
+        # Llama branch's misleading head_dim error.
+        raise ValueError(
+            f"model_type={model_type!r} (logit softcapping / alternating "
+            "local attention / pre-post norms) is not implemented; only "
+            "Gemma-1 ('gemma') converts"
+        )
     scaling = getattr(hf_config, "rope_scaling", None)
     if scaling:
         raise ValueError(
@@ -106,9 +117,6 @@ def from_hf_llama(
         ])
 
     p = "model.layers.{i}."
-    lm_head_name = (
-        "lm_head.weight" if "lm_head.weight" in sd else "model.embed_tokens.weight"
-    )
     params = {
         "embed": {"embedding": leaf("model.embed_tokens.weight")},
         "layers": {
@@ -123,8 +131,36 @@ def from_hf_llama(
             "down": {"kernel": stacked(p + "mlp.down_proj.weight", True)},
         },
         "final_norm": {"scale": leaf("model.norm.weight")},
-        "lm_head": {"kernel": leaf(lm_head_name, transpose=True)},
     }
+    if cfg.arch == "gemma":
+        # Gemma ties the head to the embedding; state dicts may still carry
+        # the tied tensor as its own entry — consume it after checking it
+        # really is the tie (an untied variant would silently change the
+        # model if dropped).
+        if "lm_head.weight" in sd:
+            head_t, embed_t = sd["lm_head.weight"], sd["model.embed_tokens.weight"]
+            # Tied torch tensors share storage — compare pointers first so
+            # the usual case costs nothing; only genuinely separate tensors
+            # pay the full value comparison (keeps this function's
+            # one-layer peak-host-memory property for real checkpoints).
+            ptr = getattr(head_t, "data_ptr", None)
+            same = (
+                ptr is not None
+                and head_t.data_ptr() == embed_t.data_ptr()  # type: ignore[union-attr]
+            ) or head_t is embed_t
+            if not same and not np.array_equal(_np(head_t), _np(embed_t)):
+                raise ValueError(
+                    "gemma checkpoint has an UNTIED lm_head.weight; this "
+                    "architecture ties the head to the embedding"
+                )
+            consumed.add("lm_head.weight")
+    else:
+        # Everyone else gets an explicit head, falling back to the tied
+        # weight when the export omitted it.
+        lm_head_name = (
+            "lm_head.weight" if "lm_head.weight" in sd else "model.embed_tokens.weight"
+        )
+        params["lm_head"] = {"kernel": leaf(lm_head_name, transpose=True)}
     # Anything unconsumed (other than derived rotary buffers) would change
     # the model's function — refuse rather than silently drop it.
     leftover = [
@@ -170,6 +206,15 @@ def hf_config_from(cfg: ModelConfig) -> Any:
         rms_norm_eps=cfg.norm_eps,
         tie_word_embeddings=False,
     )
+    if cfg.arch == "gemma":
+        from transformers import GemmaConfig
+
+        common.update(
+            head_dim=cfg.head_dim,
+            tie_word_embeddings=True,
+            hidden_activation="gelu_pytorch_tanh",
+        )
+        return GemmaConfig(**common)
     if cfg.sliding_window:
         # Sliding-window models round-trip as Mistral (same tensor layout,
         # windowed attention carried in the config).
@@ -187,11 +232,18 @@ def save_hf_checkpoint(params: dict[str, Any], cfg: ModelConfig, out_dir: str) -
     sliding-window models, or ``GPT2LMHeadModel`` for the GPT-2 family.
     Returns ``out_dir``."""
     import torch
-    from transformers import GPT2LMHeadModel, LlamaForCausalLM, MistralForCausalLM
+    from transformers import (
+        GemmaForCausalLM,
+        GPT2LMHeadModel,
+        LlamaForCausalLM,
+        MistralForCausalLM,
+    )
 
     hf_cfg = hf_config_from(cfg)
     if cfg.arch == "gpt2":
         model_cls, to_hf = GPT2LMHeadModel, to_hf_gpt2
+    elif cfg.arch == "gemma":
+        model_cls, to_hf = GemmaForCausalLM, to_hf_llama
     elif cfg.sliding_window:
         model_cls, to_hf = MistralForCausalLM, to_hf_llama
     else:
@@ -202,8 +254,17 @@ def save_hf_checkpoint(params: dict[str, Any], cfg: ModelConfig, out_dir: str) -
     with torch.device("meta"):
         model = model_cls(hf_cfg)
     missing, unexpected = model.load_state_dict(sd, strict=False, assign=True)
-    if unexpected or any("rotary" not in m and "inv_freq" not in m for m in missing):
+    # Tied weights (gemma/gpt2 lm_head) legitimately have no tensor of
+    # their own; tie_weights() re-points them at the embedding after the
+    # assign-load.
+    tied = set(getattr(model_cls, "_tied_weights_keys", None) or [])
+    bad = [
+        m for m in missing
+        if "rotary" not in m and "inv_freq" not in m and m not in tied
+    ]
+    if unexpected or bad:
         raise ValueError(f"export mismatch: missing={missing} unexpected={unexpected}")
+    model.tie_weights()
     model.save_pretrained(out_dir)
     return out_dir
 
@@ -220,8 +281,9 @@ def to_hf_llama(params: dict[str, Any], cfg: ModelConfig) -> dict[str, np.ndarra
     sd: dict[str, np.ndarray] = {
         "model.embed_tokens.weight": np.asarray(host["embed"]["embedding"], np.float32),
         "model.norm.weight": np.asarray(host["final_norm"]["scale"], np.float32),
-        "lm_head.weight": np.asarray(host["lm_head"]["kernel"], np.float32).T,
     }
+    if "lm_head" in host:  # gemma ties the head; no separate tensor
+        sd["lm_head.weight"] = np.asarray(host["lm_head"]["kernel"], np.float32).T
     L = cfg.n_layers
     layer_map = [
         ("input_layernorm.weight", host["layers"]["attn_norm"]["scale"], False),
@@ -244,6 +306,38 @@ def to_hf_llama(params: dict[str, Any], cfg: ModelConfig) -> dict[str, np.ndarra
 # ---------------------------------------------------------------------------
 # GPT-2 family (tied embeddings, fused c_attn, Conv1D [in, out] weights)
 # ---------------------------------------------------------------------------
+
+
+def config_from_hf_gemma(hf_config: Any) -> ModelConfig:
+    """Map a ``transformers.GemmaConfig`` onto :class:`ModelConfig`
+    (arch="gemma"): decoupled head_dim, tied head, GeGLU, zero-centred
+    RMSNorm — the Llama tensor layout otherwise. Gemma-2+ features
+    (softcapping, alternating local attention) are rejected rather than
+    silently dropped."""
+    for attr in ("final_logit_softcapping", "attn_logit_softcapping"):
+        if getattr(hf_config, attr, None):
+            raise ValueError(
+                f"{attr} is a Gemma-2 feature this architecture does not "
+                "implement; refusing a silently-different model"
+            )
+    act = getattr(hf_config, "hidden_activation", None) or "gelu_pytorch_tanh"
+    if act not in ("gelu_pytorch_tanh", "gelu"):
+        raise ValueError(f"hidden_activation={act!r} unsupported for gemma")
+    return ModelConfig(
+        name=getattr(hf_config, "name_or_path", "") or "hf-gemma",
+        arch="gemma",
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(hf_config, "num_key_value_heads", None)
+        or hf_config.num_attention_heads,
+        d_ff=hf_config.intermediate_size,
+        max_seq_len=getattr(hf_config, "max_position_embeddings", 8192),
+        rope_theta=getattr(hf_config, "rope_theta", 10_000.0),
+        norm_eps=getattr(hf_config, "rms_norm_eps", 1e-6),
+        head_dim_override=getattr(hf_config, "head_dim", 0) or 0,
+    )
 
 
 def config_from_hf_gpt2(hf_config: Any) -> ModelConfig:
@@ -373,7 +467,8 @@ def to_hf_gpt2(params: dict[str, Any], cfg: ModelConfig) -> dict[str, np.ndarray
 
 def from_hf(state_dict: Mapping[str, Any], cfg: ModelConfig, dtype=jnp.float32) -> dict[str, Any]:
     """Arch-dispatching import: GPT-2 state dicts for ``arch="gpt2"``
-    configs, Llama/Mistral layout otherwise."""
+    configs; the Llama tensor layout otherwise (Llama/Mistral, and Gemma —
+    whose tied head is handled inside :func:`from_hf_llama`)."""
     if cfg.arch == "gpt2":
         return from_hf_gpt2(state_dict, cfg, dtype)
     return from_hf_llama(state_dict, cfg, dtype)

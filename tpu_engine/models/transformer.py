@@ -36,8 +36,13 @@ from jax.ad_checkpoint import checkpoint_name
 @dataclass(frozen=True)
 class ModelConfig:
     name: str = "gpt-125m"
-    # Architecture family: "llama" (RMSNorm, RoPE, SwiGLU, untied head) or
-    # "gpt2" (LayerNorm+bias, learned positions, GELU, biases, tied head).
+    # Architecture family:
+    #   "llama" — RMSNorm, RoPE, SwiGLU, untied head (also Mistral via
+    #             sliding_window + GQA);
+    #   "gpt2"  — LayerNorm+bias, learned positions, GELU, biases, tied head;
+    #   "gemma" — zero-centred RMSNorm (output = x·(1+w)), RoPE, GeGLU,
+    #             sqrt(d_model)-scaled embeddings, tied head, decoupled
+    #             head_dim (256), MQA/GQA.
     arch: str = "llama"
     vocab_size: int = 32_000
     d_model: int = 768
@@ -62,9 +67,12 @@ class ModelConfig:
     capacity_factor: float = 1.25
     router_aux_coef: float = 0.01
 
+    # Per-head dim decoupled from d_model // n_heads (Gemma: 256). 0 = derived.
+    head_dim_override: int = 0
+
     @property
     def head_dim(self) -> int:
-        return self.d_model // self.n_heads
+        return self.head_dim_override or self.d_model // self.n_heads
 
     @property
     def is_moe(self) -> bool:
@@ -124,6 +132,23 @@ MODEL_CONFIGS: dict[str, ModelConfig] = {
         name="gpt2-xl", arch="gpt2", vocab_size=50_257, d_model=1600, n_layers=48,
         n_heads=25, n_kv_heads=25, d_ff=6400, max_seq_len=1024,
     ),
+    # Gemma family: zero-centred RMSNorm, GeGLU, scaled embeddings, tied
+    # head, decoupled head_dim, MQA (2b) / MHA (7b).
+    "gemma-tiny": ModelConfig(
+        name="gemma-tiny", arch="gemma", vocab_size=512, d_model=64, n_layers=2,
+        n_heads=4, n_kv_heads=1, d_ff=256, max_seq_len=256,
+        head_dim_override=32, norm_eps=1e-6,
+    ),
+    "gemma-2b": ModelConfig(
+        name="gemma-2b", arch="gemma", vocab_size=256_000, d_model=2048,
+        n_layers=18, n_heads=8, n_kv_heads=1, d_ff=16_384, max_seq_len=8192,
+        head_dim_override=256, norm_eps=1e-6,
+    ),
+    "gemma-7b": ModelConfig(
+        name="gemma-7b", arch="gemma", vocab_size=256_000, d_model=3072,
+        n_layers=28, n_heads=16, n_kv_heads=16, d_ff=24_576, max_seq_len=8192,
+        head_dim_override=256, norm_eps=1e-6,
+    ),
     # Mixture-of-Experts family (expert parallelism over the "model" axis).
     "moe-tiny": ModelConfig(
         name="moe-tiny", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
@@ -180,13 +205,17 @@ def init_params(rng: jax.Array, cfg: ModelConfig, dtype=jnp.float32) -> dict[str
             # LM head is tied to the token embedding (no separate weight).
         }
 
+    # Gemma stores norm scales as offsets from 1 (zero init = identity) and
+    # ties the LM head to the token embedding.
+    gemma = cfg.arch == "gemma"
+    norm_init = jnp.zeros if gemma else jnp.ones
     layers: dict[str, Any] = {
-        "attn_norm": {"scale": jnp.ones((L, D), dtype)},
+        "attn_norm": {"scale": norm_init((L, D), dtype)},
         "q": {"kernel": norm(k_q, (L, D, H * HD), std)},
         "k": {"kernel": norm(k_k, (L, D, KV * HD), std)},
         "v": {"kernel": norm(k_v, (L, D, KV * HD), std)},
         "o": {"kernel": norm(k_o, (L, H * HD, D), res_std)},
-        "mlp_norm": {"scale": jnp.ones((L, D), dtype)},
+        "mlp_norm": {"scale": norm_init((L, D), dtype)},
     }
     if cfg.is_moe:
         E = cfg.n_experts
@@ -200,12 +229,14 @@ def init_params(rng: jax.Array, cfg: ModelConfig, dtype=jnp.float32) -> dict[str
         layers["up"] = {"kernel": norm(k_up, (L, D, F), std)}
         layers["down"] = {"kernel": norm(k_down, (L, F, D), res_std)}
 
-    return {
+    out = {
         "embed": {"embedding": norm(k_embed, (V, D), std)},
         "layers": layers,
-        "final_norm": {"scale": jnp.ones((D,), dtype)},
-        "lm_head": {"kernel": norm(k_head, (D, V), std)},
+        "final_norm": {"scale": norm_init((D,), dtype)},
     }
+    if not gemma:
+        out["lm_head"] = {"kernel": norm(k_head, (D, V), std)}
+    return out
 
 
 def logical_axes(cfg: ModelConfig) -> dict[str, Any]:
@@ -251,12 +282,14 @@ def logical_axes(cfg: ModelConfig) -> dict[str, Any]:
         layers["gate"] = {"kernel": ("layers", "embed", "mlp")}
         layers["up"] = {"kernel": ("layers", "embed", "mlp")}
         layers["down"] = {"kernel": ("layers", "mlp", "embed")}
-    return {
+    out = {
         "embed": {"embedding": ("vocab", "embed")},
         "layers": layers,
         "final_norm": {"scale": ("embed",)},
-        "lm_head": {"kernel": ("embed", "vocab")},
     }
+    if cfg.arch != "gemma":  # gemma ties the head to the embedding
+        out["lm_head"] = {"kernel": ("embed", "vocab")}
+    return out
 
 
 def param_count(cfg: ModelConfig) -> int:
@@ -270,7 +303,8 @@ def param_count(cfg: ModelConfig) -> int:
     mlp = 3 * D * F * (cfg.n_experts if cfg.is_moe else 1)
     router = D * cfg.n_experts if cfg.is_moe else 0
     per_layer = D * H * HD + 2 * D * KV * HD + H * HD * D + mlp + router + 2 * D
-    return V * D + L * per_layer + D + D * V
+    head = 0 if cfg.arch == "gemma" else D * V  # gemma: tied
+    return V * D + L * per_layer + D + head
 
 
 def active_param_count(cfg: ModelConfig) -> int:
@@ -292,6 +326,10 @@ def train_flops_per_token(cfg: ModelConfig, seq_len: int) -> float:
         # Tied head: the V·D weight is a real matmul at the head; only the
         # positional-embedding lookup is not.
         n = active_param_count(cfg) - cfg.max_seq_len * cfg.d_model
+    elif cfg.arch == "gemma":
+        # Tied head: the embedding's V·D is counted once and spent on the
+        # head matmul; the lookup itself is free.
+        n = active_param_count(cfg)
     else:
         n = active_param_count(cfg) - cfg.vocab_size * cfg.d_model  # embedding lookup is not a matmul
     attn_ctx = min(seq_len, cfg.sliding_window) if cfg.sliding_window else seq_len
@@ -320,9 +358,13 @@ def _layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float) -> 
 
 
 def _norm(x: jax.Array, p: dict, cfg: "ModelConfig") -> jax.Array:
-    """Arch-dispatching norm: RMSNorm (llama) or LayerNorm+bias (gpt2)."""
+    """Arch-dispatching norm: RMSNorm (llama), LayerNorm+bias (gpt2), or
+    zero-centred RMSNorm (gemma: the stored scale is an offset from 1, so a
+    zero-initialised checkpoint is the identity scale)."""
     if cfg.arch == "gpt2":
         return _layer_norm(x, p["scale"], p["bias"], cfg.norm_eps)
+    if cfg.arch == "gemma":
+        return _rms_norm(x, p["scale"].astype(jnp.float32) + 1.0, cfg.norm_eps)
     return _rms_norm(x, p["scale"], cfg.norm_eps)
 
 
@@ -475,12 +517,13 @@ def _proj(h, kernel, lora_ab=None, lora_scale=1.0, bias=None):
     return out
 
 
-def _dense_mlp(h, layer_params, lora=None, lora_scale=1.0, cfg=None):
+def _dense_mlp(h, layer_params, lora=None, lora_scale=1.0, *, cfg: ModelConfig):
     """MLP shared by the training block and the decode block: SwiGLU
-    (llama) or biased GELU-tanh fc/proj (gpt2).
-    h: [B, S, D] (already normed) → [B, S, D]."""
+    (llama), biased GELU-tanh fc/proj (gpt2), or GeGLU (gemma).
+    h: [B, S, D] (already normed) → [B, S, D]. ``cfg`` is REQUIRED — see
+    :func:`embed_tokens`."""
     lora = lora or {}
-    if cfg is not None and cfg.arch == "gpt2":
+    if cfg.arch == "gpt2":
         h = jax.nn.gelu(
             _proj(h, layer_params["fc"]["kernel"], lora.get("fc"), lora_scale,
                   bias=layer_params["fc"]["bias"]),
@@ -489,7 +532,11 @@ def _dense_mlp(h, layer_params, lora=None, lora_scale=1.0, cfg=None):
                      lora_scale, bias=layer_params["proj"]["bias"])
     gate = _proj(h, layer_params["gate"]["kernel"], lora.get("gate"), lora_scale)
     up = _proj(h, layer_params["up"]["kernel"], lora.get("up"), lora_scale)
-    return _proj(jax.nn.silu(gate) * up, layer_params["down"]["kernel"],
+    if cfg.arch == "gemma":
+        act = jax.nn.gelu(gate, approximate=True)  # GeGLU
+    else:
+        act = jax.nn.silu(gate)  # SwiGLU
+    return _proj(act * up, layer_params["down"]["kernel"],
                  lora.get("down"), lora_scale)
 
 
@@ -536,7 +583,7 @@ def _block(
         mlp_out, aux = _moe_mlp(h, layer_params, cfg)
         x = x + mlp_out
         return x, aux
-    return x + _dense_mlp(h, layer_params, lora, lora_scale, cfg), jnp.zeros((), jnp.float32)
+    return x + _dense_mlp(h, layer_params, lora, lora_scale, cfg=cfg), jnp.zeros((), jnp.float32)
 
 
 _REMAT_POLICIES = {
@@ -623,13 +670,19 @@ def remat_scan_body(
 
 
 def embed_tokens(params: dict[str, Any], tokens: jax.Array, compute_dtype=jnp.bfloat16,
-                 positions: Optional[jax.Array] = None) -> jax.Array:
+                 positions: Optional[jax.Array] = None, *,
+                 cfg: ModelConfig) -> jax.Array:
     """Embedding lookup: tokens [..., S] int32 → activations [..., S, D].
     GPT-2-family params (a ``pos_embed`` table is present) add learned
     absolute position embeddings — pass ``positions`` for decode offsets
-    (defaults to 0..S-1)."""
+    (defaults to 0..S-1). Gemma-family models (``cfg.arch == "gemma"``)
+    scale the looked-up embeddings by sqrt(d_model). ``cfg`` is REQUIRED:
+    arch-dependent math behind an optional parameter turns a forgotten
+    argument into a silently different model."""
     embed = params["embed"]["embedding"].astype(compute_dtype)
     x = jnp.take(embed, tokens, axis=0)
+    if cfg.arch == "gemma":
+        x = x * jnp.asarray(cfg.d_model ** 0.5, compute_dtype)
     if "pos_embed" in params:
         if positions is None:
             positions = jnp.arange(tokens.shape[-1], dtype=jnp.int32)
@@ -642,7 +695,7 @@ def unembed(params: dict[str, Any], x: jax.Array, cfg: ModelConfig) -> jax.Array
     """Final norm + LM head: activations [..., S, D] → logits [..., S, V]
     fp32. GPT-2-family models tie the head to the token embedding."""
     x = _norm(x, jax.tree.map(lambda a: a.astype(x.dtype), params["final_norm"]), cfg)
-    head = (params["embed"]["embedding"].T if cfg.arch == "gpt2"
+    head = (params["embed"]["embedding"].T if cfg.arch in ("gpt2", "gemma")
             else params["lm_head"]["kernel"])
     return jnp.einsum(
         "...sd,dv->...sv", x, head.astype(x.dtype),
@@ -700,7 +753,8 @@ def forward_hidden_and_aux(
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
 
-    x = embed_tokens(params, tokens, compute_dtype, positions=positions)  # [B, S, D]
+    x = embed_tokens(params, tokens, compute_dtype, positions=positions,
+                     cfg=cfg)  # [B, S, D]
     if layer_stream is None:
         layer_stack = cast_layer_stack(params, compute_dtype)
     else:

@@ -668,7 +668,8 @@ def build_train_program(
             positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
             # positions also feed learned absolute embeddings (gpt2 family).
             x_mb = tfm.embed_tokens(params, batch, compute_dtype,
-                                    positions=positions)  # [M, B, S, D]
+                                    positions=positions,
+                                    cfg=model_cfg)  # [M, B, S, D]
             staged = stage_layer_stack(
                 tfm.cast_layer_stack(params, compute_dtype), pipe_size, model_cfg.n_layers
             )
