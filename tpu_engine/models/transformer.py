@@ -426,14 +426,31 @@ def _attention(q, k, v, impl: str, mesh=None, window: int = 0):
         H, KV = q.shape[2], k.shape[2]
         if H % model_size == 0 and KV % model_size == 0:
             spec = P(("data", "fsdp"), None, "model", None)
+            sh = jax.sharding.NamedSharding(mesh, spec)
+            # Pin the boundary on BOTH sides of the manual region. shard_map
+            # reshards implicitly, but the explicit constraints also pin the
+            # *cotangents* in the backward pass (with_sharding_constraint is
+            # its own transpose) — without them, GSPMD sharding propagation
+            # around the manual region is ambiguous and the partitioner's
+            # dot-strategy estimator probes layouts it can only reach by
+            # involuntary full rematerialization (MULTICHIP_r02 tail).
+            q, k, v = (jax.lax.with_sharding_constraint(t, sh) for t in (q, k, v))
+            # Decide interpret mode from the MESH's devices, not the default
+            # backend: an AOT compile for a described TPU topology may run
+            # under a CPU-forced process (tests), and the CPU dry-run mesh
+            # must exercise the kernel's real custom_vjp wrapping (interpret
+            # mode) rather than silently testing the XLA fallback — that
+            # would be a *different* backward graph than the one that ships.
+            interpret = mesh.devices.flat[0].platform != "tpu"
             fn = shard_map(
-                partial(flash_attention.mha, causal=True, window=window),
+                partial(flash_attention.mha, causal=True, window=window,
+                        interpret=interpret),
                 mesh=mesh,
                 in_specs=(spec, spec, spec),
                 out_specs=spec,
                 check_vma=False,
             )
-            return fn(q, k, v)
+            return jax.lax.with_sharding_constraint(fn(q, k, v), sh)
         # GQA ratio would change per-shard (wrong kv mapping) — XLA path.
         return flash_attention.mha(q, k, v, causal=True, force_xla=True,
                                    window=window)
@@ -640,6 +657,7 @@ def remat_scan_body(
     remat_policy: str,
     lora_scale: float = 1.0,
     layer_stream=None,
+    layer_constraint=None,
 ):
     """The (optionally remat-wrapped) per-layer scan body shared by the
     plain forward and the pipelined forward.
@@ -652,13 +670,24 @@ def remat_scan_body(
     pinned_host→device transfer + compute-dtype cast. Placing it inside the
     checkpointed body means the backward pass re-streams each layer from
     host instead of keeping a device-resident copy alive, so weight
-    residency stays O(one layer) in both passes."""
+    residency stays O(one layer) in both passes.
+
+    ``layer_constraint`` pins each layer's sliced weights (and, via the
+    constraint's transpose, their cotangents) to their canonical shardings
+    *inside* the body. Without the anchor, GSPMD sharding propagation
+    through the remat-wrapped backward scan can lose the weight layout once
+    manual (shard_map) regions interrupt propagation, and the partitioner
+    falls back to "involuntary full rematerialization" — a per-layer
+    all-gather of weights that should stay sharded (observed on the
+    multi-chip flash-attention path, MULTICHIP_r02)."""
     policy, tag_names = (None, False) if not remat else resolve_remat_policy(remat_policy)
 
     def scan_body(carry, xs):
         layer_params, lora_layer = xs if isinstance(xs, tuple) else (xs, None)
         if layer_stream is not None:
             layer_params = layer_stream(layer_params)
+        elif layer_constraint is not None:
+            layer_params = layer_constraint(layer_params)
         return _block(
             carry, layer_params, cfg, positions, mesh=mesh, tag_names=tag_names,
             lora=lora_layer, lora_scale=lora_scale,
@@ -723,6 +752,7 @@ def forward_hidden_and_aux(
     lora: Optional[dict[str, Any]] = None,
     lora_scale: float = 1.0,
     layer_stream=None,
+    layer_constraint=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Decoder stack only: tokens [B, S] int32 → (hidden [B, S, D] in the
     compute dtype — final norm / LM head NOT applied, see :func:`unembed` —
@@ -760,7 +790,8 @@ def forward_hidden_and_aux(
     else:
         layer_stack = params["layers"]
     body = remat_scan_body(cfg, positions, mesh, remat, remat_policy, lora_scale,
-                           layer_stream=layer_stream)
+                           layer_stream=layer_stream,
+                           layer_constraint=layer_constraint)
     xs = (layer_stack, lora["layers"]) if lora is not None else layer_stack
     x, aux_per_layer = lax.scan(body, x, xs)
     return x, jnp.mean(aux_per_layer)

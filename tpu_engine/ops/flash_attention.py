@@ -31,14 +31,20 @@ def _xla_mha(q, k, v, causal: bool = True, window: int = 0):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def mha(q, k, v, causal: bool = True, force_xla: bool = False, window: int = 0):
+def mha(q, k, v, causal: bool = True, force_xla: bool = False, window: int = 0,
+        interpret: bool | None = None):
     """Multi-head attention dispatch.
 
     ``window > 0`` is sliding-window (Mistral-style) attention: each query
     sees only the trailing ``window`` keys. ``force_xla=True`` (or an
     untileable shape) → the XLA implementation; otherwise the first-party
-    Pallas flash kernel (interpret mode off-TPU, so the kernel logic is
-    exercisable on the CPU test mesh).
+    Pallas flash kernel.
+
+    ``interpret=True`` forces the Pallas kernel in interpret mode off-TPU
+    (slow — the multi-device shard_map path uses it so the CPU dry-run
+    exercises the kernel's real custom_vjp wrapping rather than silently
+    testing the XLA fallback); ``None`` lets ``flash_mha`` fall back to XLA
+    when no TPU is attached.
     """
     if window < 0:
         raise ValueError(f"window must be >= 0, got {window}")
@@ -49,6 +55,7 @@ def mha(q, k, v, causal: bool = True, force_xla: bool = False, window: int = 0):
     from tpu_engine.ops._flash_pallas import FlashUnsupported, flash_mha
 
     try:
-        return flash_mha(q, k, v, causal=causal, window=window)
+        return flash_mha(q, k, v, causal=causal, window=window,
+                         interpret=interpret)
     except FlashUnsupported:
         return _xla_mha(q, k, v, causal=causal, window=window)

@@ -66,6 +66,7 @@ def pipeline_apply(
     remat: bool = False,
     remat_policy: str = "nothing_saveable",
     buf_sharding=None,
+    layer_constraint=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Run M microbatches through the pipelined decoder stack.
 
@@ -91,7 +92,8 @@ def pipeline_apply(
     ticks = M + n_stages - 1
     stage_ids = jnp.arange(n_stages)
 
-    body = tfm.remat_scan_body(cfg, positions, mesh, remat, remat_policy)
+    body = tfm.remat_scan_body(cfg, positions, mesh, remat, remat_policy,
+                               layer_constraint=layer_constraint)
 
     def stage_fn(x, stage_layers):
         # One pipeline stage: scan its block of L/P layers.

@@ -43,7 +43,7 @@ def _ulysses_local(
     v: jax.Array,
     axis_name: str,
     causal: bool,
-    force_xla: bool,
+    interpret: bool,
 ) -> jax.Array:
     """Per-shard body (runs inside shard_map).
 
@@ -67,7 +67,7 @@ def _ulysses_local(
     k = a2a(k, split_axis=2, concat_axis=1)
     v = a2a(v, split_axis=2, concat_axis=1)
 
-    out = flash_attention.mha(q, k, v, causal=causal, force_xla=force_xla)
+    out = flash_attention.mha(q, k, v, causal=causal, interpret=interpret)
 
     # Swap back: head-sharded → sequence-sharded.
     return a2a(out, split_axis=1, concat_axis=2)
@@ -88,6 +88,9 @@ def ulysses_mha(
     ``axis_name``, heads over ``model``. The per-device head count (after
     any tensor-parallel split) must be divisible by the sequence axis size.
     """
+    # Off-TPU (CPU dry-run/test meshes) the kernel runs in interpret mode so
+    # the same custom_vjp wrapping that ships on TPU is what gets exercised
+    # — not the XLA fallback's different backward graph.
     on_tpu = mesh.devices.flat[0].platform == "tpu"
     spec = P(BATCH_AXES, axis_name, "model", None)
     f = jax.shard_map(
@@ -95,7 +98,7 @@ def ulysses_mha(
             _ulysses_local,
             axis_name=axis_name,
             causal=causal,
-            force_xla=not on_tpu,
+            interpret=not on_tpu,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -445,15 +445,41 @@ def build_train_program(
         named_shardings(mesh, param_pspecs(logical, stage)) if use_lora else param_sh
     )
 
+    # The per-layer slice sharding: the stacked spec minus its leading layer
+    # dimension. Used by the offload streaming hook and by the in-body
+    # sharding anchor below.
+    def _slice_spec(spec: P) -> P:
+        parts = tuple(spec)
+        return P(*parts[1:]) if parts else P()
+
+    # Anchor each layer's sliced weights (and, through the constraint's
+    # transpose, their cotangents) to their canonical shardings inside the
+    # scan body. GSPMD sharding propagation through the remat-wrapped
+    # backward loses the weight layout once manual (shard_map) regions —
+    # the Pallas flash kernel — interrupt propagation, and the partitioner
+    # then fully rematerialises (all-gathers) per-layer weights that should
+    # stay sharded. One explicit constraint per slice removes the ambiguity
+    # at zero cost when the layout already matches.
+    layer_constraint = None
+    if mesh.size > 1:
+        _full_layer_pspecs = (
+            param_pspecs(logical, stage)["layers"] if use_lora
+            else p_pspecs["layers"]
+        )
+        _layer_anchor_sh = named_shardings(
+            mesh,
+            jax.tree.map(
+                _slice_spec, _full_layer_pspecs, is_leaf=lambda x: isinstance(x, P)
+            ),
+        )
+
+        def layer_constraint(layer):
+            return jax.lax.with_sharding_constraint(layer, _layer_anchor_sh)
+
     layer_stream = None
     if offload_params:
         # Per-layer pinned_host→device transfer + compute cast, applied
-        # inside the scan body (tfm.remat_scan_body). The slice sharding is
-        # the stacked spec minus its leading layer dimension.
-        def _slice_spec(spec: P) -> P:
-            parts = tuple(spec)
-            return P(*parts[1:]) if parts else P()
-
+        # inside the scan body (tfm.remat_scan_body).
         layer_slice_sh = named_shardings(
             mesh,
             jax.tree.map(
@@ -610,6 +636,7 @@ def build_train_program(
             lora=lora_params,
             lora_scale=(cfg.lora_alpha / cfg.lora_rank) if use_lora else 1.0,
             layer_stream=layer_stream,
+            layer_constraint=layer_constraint,
         )
         # include_aux gates the training-only regularisers (MoE aux, z-loss)
         # so eval_step reports pure cross-entropy.
@@ -683,6 +710,7 @@ def build_train_program(
                 remat=cfg.activation_checkpointing,
                 remat_policy=cfg.remat_policy,
                 buf_sharding=buf_sh,
+                layer_constraint=layer_constraint,
             )
 
             z_coef = cfg.z_loss_coef if include_aux else 0.0
