@@ -1,0 +1,74 @@
+"""Shared AOT-compile plumbing for benchmarks and tpu_aot tests.
+
+One canonical way to build the sharded train program against a described
+TPU topology (libtpu compile-only — no chips needed) so the per-site
+boilerplate (topology → MeshRuntime → build_train_program → eval_shape →
+lower) doesn't drift across benchmarks/ and tests/.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def build_program(
+    model: str,
+    mesh_axes: dict[str, int],
+    micro: int = 1,
+    accum: int = 1,
+    seq: int = 4096,
+    overrides: Optional[dict[str, Any]] = None,
+    devices=None,
+):
+    """The sharded train program for ``model`` on ``mesh_axes``.
+
+    ``devices``: topology or runtime devices (defaults to the current
+    backend's). ``overrides`` may carry any extra ``TPUTrainConfig``
+    fields, plus ``sharding_stage``.
+    """
+    from tpu_engine.mesh_runtime import MeshConfig, MeshRuntime
+    from tpu_engine.sharding import ShardingStage, TPUTrainConfig
+    from tpu_engine.train import build_train_program
+
+    overrides = dict(overrides or {})
+    stage = overrides.pop("sharding_stage", ShardingStage.FULL_PARTITIONING)
+    cfg = TPUTrainConfig(
+        model_name=model,
+        sharding_stage=stage,
+        mesh=MeshConfig(**mesh_axes),
+        micro_batch_size=micro,
+        gradient_accumulation_steps=accum,
+        seq_len=seq,
+        **overrides,
+    )
+    runtime = MeshRuntime(cfg.mesh, devices=devices) if devices is not None else None
+    return build_train_program(cfg, runtime=runtime)
+
+
+def aot_lowered(
+    model: str,
+    topo_name: str,
+    mesh_axes: dict[str, int],
+    micro: int = 1,
+    accum: int = 1,
+    seq: int = 4096,
+    overrides: Optional[dict[str, Any]] = None,
+):
+    """Lower the train step against a described TPU topology.
+
+    Returns the ``Lowered`` step — call ``.compile()`` (optionally with
+    ``compiler_options``) to get memory/cost analyses and HLO text.
+    Raises whatever ``get_topology_desc`` raises when no libtpu is
+    available; tests wrap this in a skip.
+    """
+    from jax.experimental import topologies
+
+    topo = topologies.get_topology_desc(topo_name, platform="tpu")
+    prog = build_program(model, mesh_axes, micro, accum, seq, overrides,
+                         devices=topo.devices)
+    state_shape = jax.eval_shape(prog.init, jax.random.PRNGKey(0))
+    batch = jax.ShapeDtypeStruct(prog.global_batch_shape(), jnp.int32)
+    return prog.step.lower(state_shape, batch)
