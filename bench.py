@@ -48,10 +48,13 @@ def _candidates(n_dev: int, on_tpu: bool) -> list[TPUTrainConfig]:
     # micro_batch_size is per data-parallel shard (the program scales the
     # global batch by the data×fsdp extent itself).
     return [
-        # Best measured (benchmarks/mfu_sweep.py, v5e 16 GiB): micro-batch 6
-        # with bf16 Adam first moments — the halved mu buffer (~2 GiB at 1B
-        # params) buys the activation headroom that lifts MFU past the
-        # micro-batch-4 plateau. 53.4% measured.
+        # Best measured (benchmarks/mfu_sweep.py + round-3 trace probes,
+        # v5e 16 GiB): micro-batch 6 with bf16 Adam first moments — the
+        # halved mu buffer (~2 GiB at 1B params) buys the activation
+        # headroom that lifts MFU past the micro-batch-4 plateau. 53.4%
+        # measured, reproducible to ±0.05. mb7 fits too but is no better
+        # (53.44–53.59 probe vs 52.14 full-bench — run-to-run noise), and
+        # mb8 OOMs by ~270 MB.
         TPUTrainConfig(model_name="llama-1b", micro_batch_size=6,
                        moment_dtype="bf16",
                        activation_checkpointing=True, **common),

@@ -14,6 +14,23 @@ import jax
 import jax.numpy as jnp
 
 
+class TopologyUnavailable(Exception):
+    """No libtpu / described-topology support in this environment.
+
+    Tests catch THIS (and only this) to skip — so a real lowering or
+    config regression still fails instead of silently skipping."""
+
+
+def topology(topo_name: str):
+    """Resolve a described TPU topology, or raise :class:`TopologyUnavailable`."""
+    from jax.experimental import topologies
+
+    try:
+        return topologies.get_topology_desc(topo_name, platform="tpu")
+    except Exception as e:
+        raise TopologyUnavailable(f"{topo_name}: {e}") from e
+
+
 def build_program(
     model: str,
     mesh_axes: dict[str, int],
@@ -61,12 +78,11 @@ def aot_lowered(
 
     Returns the ``Lowered`` step — call ``.compile()`` (optionally with
     ``compiler_options``) to get memory/cost analyses and HLO text.
-    Raises whatever ``get_topology_desc`` raises when no libtpu is
-    available; tests wrap this in a skip.
+    Raises :class:`TopologyUnavailable` when no libtpu is available —
+    tests catch exactly that for their skip, so build/lowering failures
+    still fail loudly.
     """
-    from jax.experimental import topologies
-
-    topo = topologies.get_topology_desc(topo_name, platform="tpu")
+    topo = topology(topo_name)
     prog = build_program(model, mesh_axes, micro, accum, seq, overrides,
                          devices=topo.devices)
     state_shape = jax.eval_shape(prog.init, jax.random.PRNGKey(0))

@@ -1,0 +1,115 @@
+"""Trace-driven MFU breakdown of the headline bench config (VERDICT r2 #3).
+
+Captures a ``jax.profiler`` device trace of the exact ``bench.py`` headline
+config (llama-1b, micro-batch 6, bf16 Adam mu, full remat, Pallas flash) on
+the real chip, converts the xplane with ``xprof`` (the tensorboard profiler
+backend, present in the image), and prints:
+
+- per-HLO-category self-time split (matmul fusions, Pallas custom-calls,
+  elementwise loop fusions, data formatting, …);
+- per-category achieved FLOP rates / memory bandwidth / roofline bound as
+  measured by the profiler itself;
+- device-busy vs host gap (device self-time vs wall step time).
+
+Run: ``python benchmarks/trace_breakdown.py``  (real TPU required)
+Prints one JSON line per category plus a summary; paste into RESULTS.md.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import shutil
+import time
+from collections import defaultdict
+
+import jax
+
+
+def capture(logdir: str = "/tmp/tpu_engine_trace", steps: int = 3):
+    """Build the headline config, warm up, trace ``steps`` steps.
+
+    Returns (wall seconds per step, xplane path).
+    """
+    from benchmarks.aot import build_program
+    from tpu_engine.sharding import ShardingStage
+
+    # The exact bench.py headline config (keep in lockstep).
+    program = build_program(
+        "llama-1b", {"data": 1}, micro=6, seq=2048,
+        overrides={
+            "moment_dtype": "bf16", "activation_checkpointing": True,
+            "sharding_stage": ShardingStage.DISABLED,
+            "attention_impl": "auto", "precision": "bf16",
+        },
+    )
+    state = program.init(jax.random.PRNGKey(0))
+    batch = program.synthetic_batch(seed=0)
+    for _ in range(3):
+        state, m = program.step(state, batch)
+    float(m["loss"])  # sync
+
+    shutil.rmtree(logdir, ignore_errors=True)
+    t0 = time.perf_counter()
+    with jax.profiler.trace(logdir):
+        for _ in range(steps):
+            state, m = program.step(state, batch)
+        float(m["loss"])
+    wall = (time.perf_counter() - t0) / steps
+    (xplane,) = glob.glob(os.path.join(logdir, "plugins/profile/*/*.xplane.pb"))
+    return wall, xplane
+
+
+def hlo_category_split(xplane: str) -> tuple[list[dict], float]:
+    """(per-category rows, total device self-time seconds per capture)."""
+    from xprof.convert import raw_to_tool_data
+
+    data, _ = raw_to_tool_data.xspace_to_tool_data([xplane], "hlo_stats", {})
+    table = json.loads(data if isinstance(data, str) else data.decode())
+    cols = [c["id"] for c in table["cols"]]
+
+    def get(row, key):
+        return row["c"][cols.index(key)].get("v")
+
+    agg = defaultdict(lambda: {"self_us": 0.0, "flops": 0.0, "bw": 0.0, "n": 0})
+    for r in table["rows"]:
+        cat = get(r, "category")
+        a = agg[cat]
+        t = float(get(r, "total_self_time") or 0)
+        a["self_us"] += t
+        # time-weighted achieved rates (profiler-measured, per op)
+        a["flops"] += t * float(get(r, "model_flop_rate") or 0)
+        a["bw"] += t * float(get(r, "measured_memory_bw") or 0)
+        a["n"] += 1
+    total = sum(a["self_us"] for a in agg.values())
+    rows = []
+    for cat, a in sorted(agg.items(), key=lambda kv: -kv[1]["self_us"]):
+        rows.append({
+            "category": cat,
+            "self_time_pct": round(100 * a["self_us"] / total, 1),
+            "achieved_gflops": round(a["flops"] / a["self_us"]) if a["self_us"] else 0,
+            "achieved_gbps": round(a["bw"] / a["self_us"], 1) if a["self_us"] else 0,
+            "ops": a["n"],
+        })
+    return rows, total / 1e6
+
+
+def main() -> None:
+    steps = 3
+    wall, xplane = capture(steps=steps)
+    rows, device_s = hlo_category_split(xplane)
+    device_per_step = device_s / steps
+    for r in rows:
+        if r["self_time_pct"] >= 0.3:
+            print(json.dumps(r))
+    print(json.dumps({
+        "summary": True,
+        "wall_ms_per_step": round(wall * 1e3, 1),
+        "device_ms_per_step": round(device_per_step * 1e3, 1),
+        "device_busy_pct": round(100 * device_per_step / wall, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -112,12 +112,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
 
     @pl.when(active)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)        # [BQ, D]
-        k_blk = k_ref[0].astype(jnp.float32)    # [BK, D]
-        v_blk = v_ref[0].astype(jnp.float32)    # [BK, D]
+        # Feed the MXU its native input dtype: bf16 operands with fp32
+        # accumulation (preferred_element_type). Upcasting q/k/v to fp32
+        # before the dots quarters MXU throughput for zero accuracy gain —
+        # the accumulator is fp32 either way, and softmax stays fp32 below.
+        q = q_ref[0]                            # [BQ, D] storage dtype
+        k_blk = k_ref[0]                        # [BK, D]
+        v_blk = v_ref[0]                        # [BK, D]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [BQ, BK]
+        ) * scale  # [BQ, BK] fp32
         q_pos = q_idx * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
         k_pos = k_idx * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         s = jnp.where(_visible(q_pos, k_pos, window), s, _NEG_INF)
@@ -128,8 +132,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         corr = jnp.exp(m - m_new)
         m_scr[...] = m_new
         l_scr[...] = l_scr[...] * corr + jnp.sum(p, axis=-1)
+        # p rounds to the storage dtype for the second MXU dot (standard
+        # flash practice); l/m/acc stay fp32 so the normalisation is exact.
         acc_scr[...] = acc_scr[...] * corr[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     @pl.when(j == n_j - 1)
@@ -207,16 +214,21 @@ def _recompute_p(q, k, lse_row, q_idx, k_idx, block_q, block_k, scale, window):
 def _p_ds_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                q_idx, k_idx, block_q, block_k, scale, window):
     """Shared gradient-tile math for both backward kernels: load the four
-    blocks and return (p, ds, q, k, do) — ds = p ∘ (dO·Vᵀ − Δ) · scale."""
-    q = q_ref[0].astype(jnp.float32)            # [BQ, D]
-    k_blk = k_ref[0].astype(jnp.float32)        # [BK, D]
-    v_blk = v_ref[0].astype(jnp.float32)        # [BK, D]
-    do = do_ref[0].astype(jnp.float32)          # [BQ, D]
+    blocks and return (p, ds, q, k, do) — ds = p ∘ (dO·Vᵀ − Δ) · scale.
+
+    Blocks stay in their storage dtype (bf16) so every dot feeds the MXU
+    its native input width; products/softmax math accumulate in fp32 via
+    ``preferred_element_type``. ``p``/``ds`` are returned fp32 — callers
+    round them to the storage dtype at their own MXU dots."""
+    q = q_ref[0]                                # [BQ, D] storage dtype
+    k_blk = k_ref[0]                            # [BK, D]
+    v_blk = v_ref[0]                            # [BK, D]
+    do = do_ref[0]                              # [BQ, D]
     p = _recompute_p(q, k_blk, lse_ref[0, 0], q_idx, k_idx,
                      block_q, block_k, scale, window)
     dp = jax.lax.dot_general(
         do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )                                           # [BQ, BK]
+    )                                           # [BQ, BK] fp32
     ds = p * (dp - delta_ref[0, 0][:, None]) * scale
     return p, ds, q, k_blk, do
 
@@ -239,7 +251,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                                         lse_ref, delta_ref, q_idx, k_idx,
                                         block_q, block_k, scale, window)
         dq_scr[...] += jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     @pl.when(j == n_j - 1)
@@ -278,10 +291,12 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
                                      lse_ref, delta_ref, q_idx, k_idx,
                                      block_q, block_k, scale, window)
         dv_scr[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )                                           # [BK, D]
         dk_scr[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     @pl.when(j == n_j - 1)
