@@ -28,6 +28,7 @@ _FAMILIES = {
     "device_throttle_score": ("gauge", "libtpu throttle score: 0 none, 1-10 = throttled by 10-100%"),
     "device_temperature_celsius": ("gauge", "Chip temperature when a telemetry source reports it"),
     "device_power_draw_watts": ("gauge", "Chip power draw when a telemetry source reports it"),
+    "device_job_info": ("gauge", "Supervised job holding this chip (job/status/process as labels)"),
     "ici_link_health_score": ("gauge", "ICI link health: 0 healthy, 1-5 transient, 6-9 persistent, 10 unusable"),
     "job_info": ("gauge", "Training job presence; status carried as a label"),
     "job_step": ("gauge", "Current training step"),
@@ -94,6 +95,14 @@ def render_metrics() -> str:
             exp.add("device_temperature_celsius", d.temperature_c, lab)
         if d.power_draw_w is not None:
             exp.add("device_power_draw_watts", d.power_draw_w, lab)
+        # Per-chip job attribution (reference per-GPU process table):
+        # one info-style series per (device, supervised job) holding it.
+        for ref in d.jobs:
+            exp.add(
+                "device_job_info", 1,
+                {**lab, "job_id": ref.job_id, "status": ref.status,
+                 "process_index": ref.process_index},
+            )
     for loc, score in fleet.ici_links:
         exp.add("ici_link_health_score", score, {"link": loc})
 

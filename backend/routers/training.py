@@ -261,6 +261,21 @@ async def eval_job_now(request: web.Request) -> web.Response:
     return json_response({"job_id": job_id, **result})
 
 
+async def get_job_eval_history(request: web.Request) -> web.Response:
+    """The bounded held-out-eval history the supervisor keeps (latest point
+    + full recorded series; empty history → 200 with ``history: []`` so a
+    dashboard can poll before the first interval fires)."""
+    job_id = request.match_info["job_id"]
+    job = state.launcher.get_job(job_id)
+    if job is None:
+        raise ApiError(404, f"job '{job_id}' not found")
+    summary = job.eval_summary()
+    return json_response({
+        "job_id": job_id,
+        **(summary if summary is not None else {"history": []}),
+    })
+
+
 async def delete_job(request: web.Request) -> web.Response:
     """Drop a terminal job from the registry (disk checkpoints untouched)."""
     job_id = request.match_info["job_id"]
@@ -484,3 +499,4 @@ def setup(app: web.Application, prefix: str = "/api/v1/training") -> None:
     app.router.add_get(f"{prefix}/jobs/{{job_id}}/checkpoints", list_job_checkpoints)
     app.router.add_delete(f"{prefix}/jobs/{{job_id}}", delete_job)
     app.router.add_post(f"{prefix}/jobs/{{job_id}}/eval", eval_job_now)
+    app.router.add_get(f"{prefix}/jobs/{{job_id}}/eval", get_job_eval_history)

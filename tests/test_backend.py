@@ -464,6 +464,15 @@ def test_loss_curve_includes_eval(client):
     assert curve["eval_steps"] == [2, 4]
     assert len(curve["eval_losses"]) == 2
 
+    # GET mirror of the supervisor's bounded eval history (VERDICT r2 #9).
+    hist = client.get(f"/api/v1/training/jobs/{job_id}/eval")
+    assert hist.status_code == 200
+    body = hist.json()
+    assert [p["step"] for p in body["history"]] == [2, 4]
+    assert body["latest_step"] == 4
+    assert body["latest_perplexity"] > 0
+    assert client.get("/api/v1/training/jobs/nope/eval").status_code == 404
+
 
 def test_job_checkpoints_listing(client, tmp_path_factory):
     ckpt_dir = str(tmp_path_factory.mktemp("api_ckpt"))

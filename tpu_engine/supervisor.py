@@ -227,6 +227,22 @@ class TrainingJob:
                 self.program = self._build_program()
             prog = self.program
 
+            # Per-chip attribution: claim this job's chips in the fleet view
+            # (reference per-GPU process table, ``gpu_manager.py:174-184``)
+            # as soon as the mesh exists — the compile/restore/init window
+            # holds the chips too, and shows as status "compiling".
+            # Released in the outer finally. The same ids scope the derived
+            # duty-cycle telemetry below.
+            local_device_ids = [
+                int(d.id)
+                for d in prog.runtime.mesh.devices.flat
+                if d.process_index == jax.process_index()
+            ]
+            telemetry.register_job_devices(
+                self.job_id, local_device_ids, jax.process_index(),
+                lambda: self.status.value,
+            )
+
             # Resume if checkpoints exist (auto-resume; MTTR path).
             start_step = 0
             if self.ckpt is not None and self.ckpt.latest_step() is not None:
@@ -309,14 +325,6 @@ class TrainingJob:
                 flops_per_token=tfm.train_flops_per_token(prog.model_config, self.config.seq_len),
                 n_devices=prog.runtime.n_devices,
             )
-            # Derived-telemetry scope: only the chips this job's mesh
-            # drives on this host report its duty cycle.
-            local_device_ids = [
-                int(d.id)
-                for d in prog.runtime.mesh.devices.flat
-                if d.process_index == jax.process_index()
-            ]
-
             step = start_step
             while step < self.max_steps and not self._stop.is_set():
                 self.profiler.begin_step()
@@ -405,6 +413,7 @@ class TrainingJob:
             self.status = JobStatus.FAILED
         finally:
             self.finished_at = time.time()
+            telemetry.unregister_job_devices(self.job_id)
             for ds in (self._dataset, self._eval_dataset):
                 if ds is not None:
                     try:
@@ -782,10 +791,10 @@ class TrainingJob:
             "tokens_per_sec": self.tokens_per_sec,
             "monitor": self.monitor.get_summary(),
             "profile": self.profiler.summary() if self.profiler is not None else None,
-            "eval": self._eval_summary(),
+            "eval": self.eval_summary(),
         }
 
-    def _eval_summary(self) -> Optional[dict[str, Any]]:
+    def eval_summary(self) -> Optional[dict[str, Any]]:
         if not self.eval_history:
             return None
         step, loss = self.eval_history[-1]

@@ -337,6 +337,74 @@ def observe_step(
     _derived.observe(device_s, wall_s, device_ids=device_ids)
 
 
+# ---------------------------------------------------------------------------
+# Per-chip job attribution
+# ---------------------------------------------------------------------------
+#
+# The reference fleet view reports, per GPU, the live process table — pid,
+# name, memory (``gpu_manager.py:27-33``, populated ``:174-184``) — so an
+# operator can see WHAT occupies a device. TPU runtimes expose no foreign
+# process table, but this control plane *owns* its supervised jobs: each
+# supervisor registers the chip ids its mesh drives on this host while the
+# job runs, and the fleet snapshot lays the claims over the device table.
+
+_claims: dict[str, "JobDeviceClaim"] = {}
+_claims_lock = threading.Lock()
+
+
+@dataclass
+class JobDeviceClaim:
+    """One running job's hold on a set of local chips."""
+
+    job_id: str
+    device_ids: frozenset[int]
+    process_index: int
+    # Live status read (e.g. ``lambda: job.status.value``) so the fleet
+    # shows compiling/running without the registry chasing transitions.
+    status_fn: Any
+
+
+def register_job_devices(
+    job_id: str,
+    device_ids: Sequence[int],
+    process_index: int,
+    status_fn,
+) -> None:
+    """Claim ``device_ids`` for ``job_id`` until :func:`unregister_job_devices`."""
+    with _claims_lock:
+        _claims[job_id] = JobDeviceClaim(
+            job_id=job_id,
+            device_ids=frozenset(int(i) for i in device_ids),
+            process_index=int(process_index),
+            status_fn=status_fn,
+        )
+
+
+def unregister_job_devices(job_id: str) -> None:
+    with _claims_lock:
+        _claims.pop(job_id, None)
+
+
+def job_attribution() -> dict[int, list[dict[str, Any]]]:
+    """device id → jobs holding it, each ``{job_id, status, process_index}``."""
+    with _claims_lock:
+        claims = list(_claims.values())
+    out: dict[int, list[dict[str, Any]]] = {}
+    for c in claims:
+        try:
+            status = str(c.status_fn())
+        except Exception:
+            status = "unknown"
+        ref = {
+            "job_id": c.job_id,
+            "status": status,
+            "process_index": c.process_index,
+        }
+        for did in c.device_ids:
+            out.setdefault(did, []).append(ref)
+    return out
+
+
 def sources() -> list[TelemetrySource]:
     global _sources
     with _sources_lock:

@@ -50,6 +50,18 @@ class TPUHealthStatus(str, Enum):
     UNKNOWN = "unknown"
 
 
+class TPUJobRef(BaseModel):
+    """A supervised job holding this chip — the TPU analogue of the
+    reference's per-GPU process table (``gpu_manager.py:27-33``, populated
+    ``:174-184``). TPU runtimes expose no foreign-process table, so the
+    entries are the control plane's OWN jobs, registered by their
+    supervisors (``tpu_engine.telemetry.register_job_devices``)."""
+
+    job_id: str
+    status: str
+    process_index: int = 0
+
+
 class TPUDevice(BaseModel):
     """One TPU chip/core. Reference analogue: ``GPUDevice`` (``gpu_manager.py:35-62``)."""
 
@@ -71,12 +83,21 @@ class TPUDevice(BaseModel):
     # TPU metrics expose *throttling* rather than raw die temperature — this
     # is the hardware-honest signal behind the reference's temp/power alerts.
     throttle_score: Optional[int] = None
+    # INJECTION-ONLY fields: no TPU telemetry source reports die temperature
+    # or power (the libtpu SDK has no such metrics — throttle_score is the
+    # thermal signal), so on the LIVE path these stay null. They exist, with
+    # their reference-parity health thresholds, for injected snapshots
+    # (``metrics=``/``parse_metrics_json`` — external collectors, tests,
+    # the mock fleet).
     temperature_c: Optional[float] = None
     power_draw_w: Optional[float] = None
     power_limit_w: Optional[float] = None
 
     health_status: TPUHealthStatus = TPUHealthStatus.UNKNOWN
     alerts: list[str] = Field(default_factory=list)
+    # Supervised jobs whose mesh holds this chip (live snapshots only;
+    # injected/mock fleets have no job registry to consult).
+    jobs: list[TPUJobRef] = Field(default_factory=list)
 
     @property
     def hbm_free_gb(self) -> float:
@@ -307,8 +328,9 @@ class TPUManager:
             devices = self.parse_metrics(metrics)
         else:
             try:
+                runtime_devs = self._runtime_devices()
                 devices = [
-                    self._device_from_runtime(i, d) for i, d in enumerate(self._runtime_devices())
+                    self._device_from_runtime(i, d) for i, d in enumerate(runtime_devs)
                 ]
             except Exception as e:  # runtime unavailable
                 return TPUFleetStatus(
@@ -347,6 +369,17 @@ class TPUManager:
                                 dev.hbm_used_gb / dev.hbm_total_gb * 100.0, 2
                             )
                     self._assess_health(dev)
+
+            # Per-chip job attribution: lay the supervised-job claims
+            # (tpu_engine.telemetry.register_job_devices) over the device
+            # table, matched by runtime device id — the TPU answer to the
+            # reference's per-GPU process table (``gpu_manager.py:174-184``).
+            attribution = telemetry.job_attribution()
+            if attribution:
+                for dev, d in zip(devices, runtime_devs):
+                    refs = attribution.get(int(getattr(d, "id", dev.index)))
+                    if refs:
+                        dev.jobs = [TPUJobRef(**r) for r in refs]
 
         fleet_alerts: list[str] = []
         if ici_links:
