@@ -60,6 +60,11 @@ class TrainingLaunchRequest(BaseModel):
         description="sliding-window attention: None = model preset's window, "
         "0 = full causal, N = window of N keys")
     activation_checkpointing: bool = True
+    elastic_min_devices: Optional[int] = Field(
+        default=None, ge=1,
+        description="admissible device-count lower bound: a resume on a "
+        "mismatched slice auto-selects the largest admissible mesh")
+    elastic_max_devices: Optional[int] = Field(default=None, ge=1)
     dataset_path: Optional[str] = None  # flat binary token file; None = synthetic
     dataset_dtype: Literal["uint16", "int32"] = "uint16"
     eval_interval_steps: Optional[int] = Field(default=None, ge=1)
@@ -141,6 +146,8 @@ def _to_config(req: TrainingLaunchRequest) -> TPUTrainConfig:
             attention_impl=req.attention_impl,
             sliding_window=req.sliding_window,
             activation_checkpointing=req.activation_checkpointing,
+            elastic_min_devices=req.elastic_min_devices,
+            elastic_max_devices=req.elastic_max_devices,
             dataset_path=req.dataset_path,
             dataset_dtype=req.dataset_dtype,
             eval_interval_steps=req.eval_interval_steps,

@@ -239,3 +239,109 @@ def test_elastic_resume_across_mesh_shapes(tmp_path):
 
 # Compile-heavy module: excluded from the fast core run (pytest -m "not slow").
 pytestmark = pytest.mark.slow
+
+
+def test_elastic_bounds_auto_resume_on_smaller_slice(tmp_path):
+    """Reference elasticity bounds (``deepspeed_launcher.py:226-238``), TPU
+    reading: a job declares it may run between 2 and 8 chips; preempted on
+    8 and resumed where only 4 are visible, the supervisor auto-selects the
+    largest admissible mesh (data halves, fsdp kept) and cross-mesh
+    restores — loss/param continuity intact."""
+    ck = tmp_path / "ckpt"
+    cfg = tiny_config(
+        ck, total_steps=6, elastic_min_devices=2, elastic_max_devices=8,
+    )  # mesh (data=2, fsdp=4) = 8 devices
+    job1 = TrainingJob("job-el1", cfg)
+    job1.start()
+    job1.join(timeout=300)
+    assert job1.status == JobStatus.COMPLETED, job1.error
+    assert job1.elastic_mesh is None  # exact fit: no resize
+    q_before = jax.device_get(job1._state["params"]["layers"]["q"]["kernel"])
+
+    # "Resume" with only 4 visible devices: the configured 8-device mesh
+    # cannot fit; the bounds admit 4 → (data=1, fsdp=4).
+    job2 = TrainingJob(
+        "job-el2", cfg.model_copy(update={"total_steps": 9}),
+        devices=jax.devices()[:4],
+    )
+    job2.start()
+    job2.join(timeout=300)
+    assert job2.status == JobStatus.COMPLETED, job2.error
+    assert job2.elastic_mesh == {
+        "data": 1, "fsdp": 4, "pipe": 1, "sequence": 1, "model": 1,
+        "dcn_data": 1,
+    }
+    assert job2.resumed_from_step == 6
+    assert job2.current_step == 9
+    assert job2.describe()["elastic_mesh"]["data"] == 1
+    # The program really runs on the 4-device mesh.
+    assert job2.program.runtime.n_devices == 4
+
+    # Param continuity: a fresh restore of step 6 on the NEW mesh matches
+    # what the 8-device run trained.
+    from tpu_engine.checkpoint import abstract_state_like
+
+    step, restored = job2.ckpt.restore(
+        abstract_state_like(
+            job2.program.state_shardings,
+            jax.eval_shape(lambda: job2.program.init(jax.random.PRNGKey(0))),
+        ),
+        step=6,
+    )
+    assert step == 6
+    q_after = jax.device_get(restored["params"]["layers"]["q"]["kernel"])
+    assert (q_before == q_after).all()
+
+
+def test_elastic_bounds_reject_below_minimum(tmp_path):
+    """Fewer visible chips than elastic_min_devices is an admission error,
+    not a silent tiny-mesh run."""
+    cfg = tiny_config(
+        tmp_path / "ck2", total_steps=4, elastic_min_devices=8,
+    )
+    job = TrainingJob("job-el3", cfg, devices=jax.devices()[:4])
+    job.start()
+    job.join(timeout=120)
+    assert job.status == JobStatus.FAILED
+    assert "no admissible mesh" in (job.error or "")
+
+
+def test_no_bounds_means_exact_fit_only(tmp_path):
+    cfg = tiny_config(tmp_path / "ck3", total_steps=4)
+    job = TrainingJob("job-el4", cfg, devices=jax.devices()[:4])
+    job.start()
+    job.join(timeout=120)
+    assert job.status == JobStatus.FAILED
+    assert "needs" in (job.error or "")
+
+
+def test_elastic_min_enforced_even_when_mesh_would_fit(tmp_path):
+    """data=-1 absorbs any device count, so a fitting mesh must STILL
+    respect the declared minimum — below it is an admission error."""
+    cfg = tiny_config(
+        tmp_path / "ck4", total_steps=4, mesh=MeshConfig(data=-1, fsdp=1),
+        elastic_min_devices=8,
+    )
+    job = TrainingJob("job-el5", cfg, devices=jax.devices()[:4])
+    job.start()
+    job.join(timeout=120)
+    assert job.status == JobStatus.FAILED
+    assert "no admissible mesh" in (job.error or "")
+
+
+def test_elastic_max_caps_to_device_subset(tmp_path):
+    """max_devices below the visible count: the job runs on a SUBSET of the
+    host (derived mesh paired with concrete devices), not on all chips."""
+    cfg = tiny_config(
+        tmp_path / "ck5", total_steps=4, mesh=MeshConfig(data=-1, fsdp=2),
+        elastic_min_devices=2, elastic_max_devices=4,
+    )
+    job = TrainingJob("job-el6", cfg)  # 8 visible
+    job.start()
+    job.join(timeout=300)
+    assert job.status == JobStatus.COMPLETED, job.error
+    assert job.program.runtime.n_devices == 4
+    assert job.elastic_mesh == {
+        "data": 2, "fsdp": 2, "pipe": 1, "sequence": 1, "model": 1,
+        "dcn_data": 1,
+    }

@@ -248,3 +248,86 @@ def test_injected_metrics_bypass_overlay():
     )
     assert fleet.telemetry_sources == []
     assert fleet.devices[0].duty_cycle_pct is None
+
+
+# -- tpu-info CLI fallback source (SURVEY §2.2; reference nvidia-smi parse
+# seam, gpu_manager.py:100-117) ---------------------------------------------
+
+_TPU_INFO_OUTPUT = """\
+TPU Chips
+┏━━━━━━━━━━━━━┳━━━━━━━━━━━━━┳━━━━━━━━━┳━━━━━┓
+┃ Chip        ┃ Type        ┃ Devices ┃ PID ┃
+┡━━━━━━━━━━━━━╇━━━━━━━━━━━━━╇━━━━━━━━━╇━━━━━┩
+│ /dev/accel0 │ TPU v5 lite │ 1       │ 777 │
+│ /dev/accel1 │ TPU v5 lite │ 1       │ 777 │
+└─────────────┴─────────────┴─────────┴─────┘
+TPU Runtime Utilization
+┏━━━━━━━━┳━━━━━━━━━━━━━━━━━━━━━━━┳━━━━━━━━━━━━┓
+┃ Device ┃ Memory usage          ┃ Duty cycle ┃
+┡━━━━━━━━╇━━━━━━━━━━━━━━━━━━━━━━━╇━━━━━━━━━━━━┩
+│ 0      │ 1.50 GiB / 15.75 GiB  │     12.00% │
+│ 1      │ 14.20 GiB / 15.75 GiB │     97.50% │
+└────────┴───────────────────────┴────────────┘
+TensorCore Utilization
+┏━━━━━━━━━┳━━━━━━━━━━━━━━━━━━━━━━━━┓
+┃ Chip ID ┃ TensorCore Utilization ┃
+┡━━━━━━━━━╇━━━━━━━━━━━━━━━━━━━━━━━━┩
+│ 0       │ 34.20%                 │
+│ 1       │ 88.00%                 │
+└─────────┴────────────────────────┘
+"""
+
+
+def test_tpu_info_cli_source_parses_canned_output():
+    src = telemetry.TpuInfoCliSource(runner=lambda: _TPU_INFO_OUTPUT)
+    snap = src.sample(2)
+    assert snap is not None and snap.source == "tpu_info_cli"
+    assert snap.per_chip[0] == {
+        "hbm_used_gb": 1.5, "hbm_total_gb": 15.75,
+        "duty_cycle_pct": 12.0, "tensorcore_util_pct": 34.2,
+    }
+    assert snap.per_chip[1]["duty_cycle_pct"] == 97.5
+    assert snap.per_chip[1]["hbm_used_gb"] == 14.2
+
+
+def test_tpu_info_cli_source_degrades_to_none():
+    assert telemetry.TpuInfoCliSource(runner=lambda: "").sample(2) is None
+    assert telemetry.TpuInfoCliSource(runner=lambda: "no tables here").sample(2) is None
+
+    def boom():
+        raise RuntimeError("binary exploded")
+
+    assert telemetry.TpuInfoCliSource(runner=boom).sample(2) is None
+    # No runner + no binary on PATH → None, never an exception.
+    assert telemetry.TpuInfoCliSource(binary="definitely-not-a-binary").sample(2) is None
+
+
+def test_tpu_info_cli_registered_between_sdk_and_derived():
+    names = [type(s).__name__ for s in telemetry.sources()]
+    assert names == ["LibtpuSdkSource", "TpuInfoCliSource", "DerivedDutySource"]
+
+
+def test_overlay_sdk_beats_cli_beats_derived():
+    sdk = LibtpuSdkSource(monitoring=FakeMonitoring({"duty_cycle_pct": ["50.00", "60.00"]}))
+    cli = telemetry.TpuInfoCliSource(runner=lambda: _TPU_INFO_OUTPUT)
+    telemetry.set_sources([sdk, cli, telemetry.derived_duty()])
+    overlay = telemetry.sample_overlay(2)
+    # SDK wins on duty; CLI fills what the SDK lacks (HBM, tensorcore).
+    assert overlay.per_chip[0]["duty_cycle_pct"] == 50.0
+    assert overlay.per_chip[0]["tensorcore_util_pct"] == 34.2
+    assert overlay.per_chip[0]["hbm_total_gb"] == 15.75
+    assert overlay.sources == ["libtpu_sdk", "tpu_info_cli"]
+
+
+def test_tpu_info_cli_rate_limits_subprocess_invocations(monkeypatch):
+    src = telemetry.TpuInfoCliSource(min_interval_s=60.0)
+    calls = []
+
+    def fake_invoke():
+        calls.append(1)
+        return _TPU_INFO_OUTPUT
+
+    monkeypatch.setattr(src, "_invoke", fake_invoke)
+    for _ in range(5):
+        assert src.sample(2) is not None
+    assert len(calls) == 1  # one fork per interval, cached in between

@@ -166,6 +166,12 @@ class TPULauncher:
                 "mode": "relaunch-at-new-mesh-shape + resume-from-checkpoint"
                 if config.elastic_resume
                 else "disabled",
+                # Declared admissible device-count bounds: with min set, a
+                # resume on a mismatched slice auto-selects the largest
+                # admissible mesh (supervisor._elastic_config) instead of
+                # failing; None = exact-fit only.
+                "min_devices": config.elastic_min_devices,
+                "max_devices": config.elastic_max_devices,
                 "note": "TPU slices are fixed-shape; live resize is not a TPU concept "
                 "(reference elasticity block: deepspeed_launcher.py:226-238)",
             },

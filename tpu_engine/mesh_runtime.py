@@ -96,6 +96,51 @@ class MeshConfig(BaseModel):
         return (data, self.fsdp, self.pipe, self.sequence, self.model)
 
 
+def derive_elastic_mesh(
+    mesh: "MeshConfig",
+    n_visible: int,
+    min_devices: int,
+    max_devices: Optional[int] = None,
+) -> "MeshConfig":
+    """The largest admissible mesh for ``n_visible`` devices.
+
+    The TPU reading of the reference's elasticity bounds
+    (``deepspeed_launcher.py:226-238``: min/max GPU counts a job may run
+    at): when a preempted job resumes on a different-sized slice, pick the
+    biggest shape within [``min_devices``, ``max_devices``] that the
+    visible devices support, preserving the configured model/pipe/sequence
+    axes (their sizes encode model-dimension divisibility) and shrinking
+    ZeRO/data parallelism — halving fsdp only when even that cannot fit.
+    Raises ValueError when nothing admissible exists (fewer chips than
+    ``min_devices``, or the fixed axes alone exceed the slice).
+    """
+    if min_devices < 1:
+        raise ValueError(f"min_devices must be >= 1, got {min_devices}")
+    cap = min(n_visible, max_devices if max_devices is not None else n_visible)
+    fsdp = mesh.fsdp
+    while True:
+        fixed = fsdp * mesh.pipe * mesh.sequence * mesh.model
+        n = (cap // fixed) * fixed if fixed else 0
+        while n >= max(min_devices, fixed):
+            data = n // fixed
+            if data % mesh.dcn_data == 0:
+                return MeshConfig(
+                    data=data, fsdp=fsdp, pipe=mesh.pipe,
+                    sequence=mesh.sequence, model=mesh.model,
+                    dcn_data=mesh.dcn_data,
+                )
+            n -= fixed
+        if fsdp > 1 and fsdp % 2 == 0:
+            fsdp //= 2
+            continue
+        raise ValueError(
+            f"no admissible mesh for {n_visible} visible device(s) within "
+            f"[{min_devices}, {max_devices if max_devices is not None else n_visible}] "
+            f"with fixed axes pipe={mesh.pipe} sequence={mesh.sequence} "
+            f"model={mesh.model} (fsdp tried down from {mesh.fsdp})"
+        )
+
+
 def detect_topology(devices: Optional[Sequence[jax.Device]] = None) -> dict[str, Any]:
     """Describe the physical device topology (real data, not a canned matrix).
 

@@ -340,6 +340,14 @@ class TPUTrainConfig(BaseModel):
     # Elasticity (reference :78,226-238): TPU slices are fixed-shape, so
     # elasticity means re-launch at a new mesh shape + resume from checkpoint.
     elastic_resume: bool = True
+    # Admissible device-count bounds (reference elasticity min/max GPUs,
+    # ``deepspeed_launcher.py:229-233``). When ``elastic_min_devices`` is
+    # set and the configured mesh does not fit the visible devices at
+    # launch/resume, the supervisor auto-selects the largest admissible
+    # shape via ``mesh_runtime.derive_elastic_mesh`` and cross-mesh-restores
+    # from checkpoint. None = exact-fit only (mismatch is an error).
+    elastic_min_devices: Optional[int] = Field(default=None, ge=1)
+    elastic_max_devices: Optional[int] = Field(default=None, ge=1)
 
     # Persistent XLA compilation cache directory (None = env
     # JAX_COMPILATION_CACHE_DIR, else ~/.cache/tpu_engine/xla-cache): warm
@@ -370,6 +378,24 @@ class TPUTrainConfig(BaseModel):
     # (the reference's only logging is bare print()s in a stub —
     # ``spot_resiliency.py:22,35``; SURVEY.md §5 "no structured logging").
     metrics_log_path: Optional[str] = None
+
+    @model_validator(mode="after")
+    def _validate_elastic_bounds(self) -> "TPUTrainConfig":
+        if (
+            self.elastic_min_devices is not None
+            and self.elastic_max_devices is not None
+            and self.elastic_max_devices < self.elastic_min_devices
+        ):
+            raise ValueError(
+                f"elastic_max_devices={self.elastic_max_devices} < "
+                f"elastic_min_devices={self.elastic_min_devices}"
+            )
+        if self.elastic_max_devices is not None and self.elastic_min_devices is None:
+            raise ValueError(
+                "elastic_max_devices requires elastic_min_devices (the bounds "
+                "are one declaration: 'this job may run between X and Y chips')"
+            )
+        return self
 
     @model_validator(mode="after")
     def _validate_grad_allreduce_dtype(self) -> "TPUTrainConfig":

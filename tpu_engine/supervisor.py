@@ -28,7 +28,7 @@ import threading
 import time
 import traceback
 from enum import Enum
-from typing import Any, Callable, Iterator, Optional
+from typing import Any, Callable, Iterator, Optional, Sequence
 
 import jax
 
@@ -81,6 +81,7 @@ class TrainingJob:
         watch_preemption: bool = False,
         install_signal_handlers: bool = False,
         simulate_preemption_check: Optional[Callable[[], bool]] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
     ):
         self.job_id = job_id
         self.config = config
@@ -92,6 +93,12 @@ class TrainingJob:
         self.lr_cut_on_rollback = lr_cut_on_rollback
         self.max_rollbacks = max_rollbacks
         self.stable_margin_steps = stable_margin_steps
+
+        # Device pinning / elastic seam: None = all visible devices. A job
+        # resumed on a different-sized slice records the auto-selected
+        # shape in ``elastic_mesh`` (None = ran at the configured mesh).
+        self._devices = list(devices) if devices is not None else None
+        self.elastic_mesh: Optional[dict[str, int]] = None
 
         self.status = JobStatus.PENDING
         self.error: Optional[str] = None
@@ -176,10 +183,56 @@ class TrainingJob:
 
     # -- training loop -------------------------------------------------------
 
+    def _elastic_config(self) -> TPUTrainConfig:
+        """The config to build with: when the declared elastic bounds allow
+        and the configured mesh does not fit the visible devices, swap in
+        the largest admissible mesh (reference elasticity min/max bounds,
+        ``deepspeed_launcher.py:226-238``). Cross-mesh restore then loads
+        the checkpoint onto the new shardings as usual."""
+        cfg = self.config
+        devices = list(self._devices) if self._devices is not None else list(jax.devices())
+        n_visible = len(devices)
+        if not (cfg.elastic_resume and cfg.elastic_min_devices is not None):
+            cfg.mesh.resolved_shape(n_visible)  # exact fit or raise
+            return cfg
+        from tpu_engine.mesh_runtime import derive_elastic_mesh
+
+        # Bounds declared → they govern UNCONDITIONALLY: even a mesh that
+        # "fits" (data=-1 absorbs anything) must land inside
+        # [min_devices, max_devices], so always derive, then compare.
+        new_mesh = derive_elastic_mesh(
+            cfg.mesh, n_visible, cfg.elastic_min_devices,
+            cfg.elastic_max_devices,
+        )
+        # derive_elastic_mesh returns explicit axis sizes (no -1).
+        n_use = (new_mesh.data * new_mesh.fsdp * new_mesh.pipe
+                 * new_mesh.sequence * new_mesh.model)
+        if n_use < n_visible:
+            # The derived mesh is smaller than the host (max_devices cap, or
+            # divisibility): pair it with a concrete device subset — a mesh
+            # must cover its runtime's devices exactly.
+            self._devices = devices[:n_use]
+        try:
+            same = cfg.mesh.resolved_shape(n_visible) == new_mesh.resolved_shape(n_use)
+        except ValueError:
+            same = False
+        if same:
+            return cfg
+        self.elastic_mesh = new_mesh.model_dump()
+        log.warning(
+            "job %s: configured mesh %s vs %d visible device(s); elastic "
+            "bounds [%s, %s] admit %s on %d device(s) — relaunching at that "
+            "shape",
+            self.job_id, cfg.mesh.model_dump(), n_visible,
+            cfg.elastic_min_devices, cfg.elastic_max_devices,
+            self.elastic_mesh, n_use,
+        )
+        return cfg.model_copy(update={"mesh": new_mesh})
+
     def _build_program(self):
         """Build the train program; for LoRA, load the frozen base weights
         from the configured HF checkpoint directory."""
-        cfg = self.config
+        cfg = self._elastic_config()
         # Comm-tuning flags: in the worker CLI these were applied before the
         # backend initialised; in a long-lived server this warns that the
         # per-job knobs cannot take effect (never a silent no-op).
@@ -199,14 +252,26 @@ class TrainingJob:
                 "job %s: LoRA base loaded from %s (%s)",
                 self.job_id, cfg.lora_base_hf_checkpoint, model_cfg.name,
             )
-            return build_train_program(cfg, model_cfg=model_cfg, base_params=base)
+            return build_train_program(
+                cfg, model_cfg=model_cfg, base_params=base,
+                runtime=self._runtime_for(cfg),
+            )
         if cfg.lora_rank:
             log.warning(
                 "job %s: lora_rank set without lora_base_hf_checkpoint — "
                 "adapting a randomly initialised base model (only meaningful "
                 "for tests and benchmarks)", self.job_id,
             )
-        return build_train_program(cfg)
+        return build_train_program(cfg, runtime=self._runtime_for(cfg))
+
+    def _runtime_for(self, cfg: TPUTrainConfig):
+        """A pinned-device MeshRuntime when this job was given an explicit
+        device subset; None lets build_train_program use all visible."""
+        if self._devices is None:
+            return None
+        from tpu_engine.mesh_runtime import MeshRuntime
+
+        return MeshRuntime(cfg.mesh, devices=self._devices)
 
     def _abstract_state(self):
         prog = self.program
@@ -784,6 +849,7 @@ class TrainingJob:
             "current_step": self.current_step,
             "rollback_count": self.rollback_count,
             "resumed_from_step": self.resumed_from_step,
+            "elastic_mesh": self.elastic_mesh,
             "preemption_reason": self.preemption_reason,
             "started_at": self.started_at,
             "finished_at": self.finished_at,
