@@ -208,9 +208,21 @@ class TrainingJob:
         n_use = (new_mesh.data * new_mesh.fsdp * new_mesh.pipe
                  * new_mesh.sequence * new_mesh.model)
         if n_use < n_visible:
-            # The derived mesh is smaller than the host (max_devices cap, or
-            # divisibility): pair it with a concrete device subset — a mesh
-            # must cover its runtime's devices exactly.
+            # The derived mesh is smaller than the visible world
+            # (max_devices cap, or divisibility): pair it with a concrete
+            # device subset — a mesh must cover its runtime's devices
+            # exactly. Auto-subset is SINGLE-CONTROLLER only: in a
+            # multi-process run, jax.devices()[:n] spans host 0's chips and
+            # would strand the other hosts mid-collective; cross-host
+            # shrink means relaunching with fewer processes (the JobSet
+            # respawns at the new world size and THIS path then sees a
+            # single consistent process world again).
+            if jax.process_count() > 1:
+                raise ValueError(
+                    f"elastic bounds admit {n_use} of {n_visible} visible "
+                    "devices, but auto-subset cannot span a multi-process "
+                    "world — relaunch with fewer processes instead"
+                )
             self._devices = devices[:n_use]
         try:
             same = cfg.mesh.resolved_shape(n_visible) == new_mesh.resolved_shape(n_use)
@@ -479,6 +491,14 @@ class TrainingJob:
         finally:
             self.finished_at = time.time()
             telemetry.unregister_job_devices(self.job_id)
+            # Stop a sharded-read prefetch thread with the job (make_data_fn
+            # attaches close when it owns a stream).
+            close_fn = getattr(self.data_fn, "close", None)
+            if callable(close_fn):
+                try:
+                    close_fn()
+                except Exception:
+                    pass
             for ds in (self._dataset, self._eval_dataset):
                 if ds is not None:
                     try:
