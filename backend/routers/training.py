@@ -55,6 +55,7 @@ class TrainingLaunchRequest(BaseModel):
     param_offload: str = "none"
     grad_allreduce_dtype: Optional[str] = None
     attention_impl: Literal["auto", "xla", "flash", "ring", "ulysses"] = "auto"
+    pipeline_schedule: Literal["gpipe", "1f1b"] = "gpipe"
     sliding_window: Optional[int] = Field(
         default=None, ge=0,
         description="sliding-window attention: None = model preset's window, "
@@ -144,6 +145,7 @@ def _to_config(req: TrainingLaunchRequest) -> TPUTrainConfig:
                 else None
             ),
             attention_impl=req.attention_impl,
+            pipeline_schedule=req.pipeline_schedule,
             sliding_window=req.sliding_window,
             activation_checkpointing=req.activation_checkpointing,
             elastic_min_devices=req.elastic_min_devices,

@@ -1,0 +1,95 @@
+"""GPipe vs 1F1B pipeline schedules: memory and step-time A/B.
+
+Two measurement planes (numbers in RESULTS.md):
+
+- ``--aot``: libtpu AOT compile of llama-7b (pipe=4, fsdp=4, v5e:4x4,
+  seq 4096, flash, full remat) at growing microbatch counts;
+  ``memory_analysis()`` reports the per-device temp memory each schedule
+  actually needs. This is where 1F1B's O(P) in-flight activation bound
+  shows up against GPipe-by-autodiff's O(M + P) saved stage buffers.
+- ``--wall``: wall-clock per optimizer step on the 8-virtual-device CPU
+  mesh (gpt-tiny). In the masked-SPMD formulation the 1F1B warmup/drain
+  lanes burn compute rather than idling, so at equal M it is slightly
+  SLOWER — the schedule's value is spending the saved memory on more
+  microbatches (amortising the (P-1)/M bubble) or bigger ones.
+
+Run: ``python benchmarks/pipeline_schedule.py --aot|--wall``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def run_aot() -> None:
+    from benchmarks.aot import aot_lowered
+
+    for M in (8, 16):
+        for sched in ("gpipe", "1f1b"):
+            t0 = time.time()
+            try:
+                comp = aot_lowered(
+                    "llama-7b", "v5e:4x4", dict(data=1, fsdp=4, pipe=4),
+                    micro=1, accum=M, seq=4096,
+                    overrides={
+                        "attention_impl": "flash",
+                        "pipeline_schedule": sched,
+                        "activation_checkpointing": True,
+                    },
+                ).compile()
+                ma = comp.memory_analysis()
+                print(json.dumps({
+                    "schedule": sched, "microbatches": M,
+                    "device_args_gib": round(ma.argument_size_in_bytes / 2**30, 2),
+                    "device_temp_gib": round(ma.temp_size_in_bytes / 2**30, 2),
+                    "compile_s": round(time.time() - t0, 1),
+                }))
+            except Exception as e:  # OOM is a *result* here, not a failure
+                print(json.dumps({
+                    "schedule": sched, "microbatches": M,
+                    "error": str(e)[:200],
+                }))
+
+
+def run_wall() -> None:
+    import jax
+
+    from benchmarks.aot import build_program
+
+    for sched in ("gpipe", "1f1b"):
+        prog = build_program(
+            "gpt-tiny", dict(data=1, fsdp=2, model=2, pipe=2),
+            micro=2, accum=8, seq=128,
+            overrides={
+                "attention_impl": "xla", "pipeline_schedule": sched,
+                "activation_checkpointing": True,
+            },
+            devices=jax.devices()[:8],
+        )
+        state = prog.init(jax.random.PRNGKey(0))
+        batch = prog.synthetic_batch(seed=0)
+        for _ in range(2):
+            state, m = prog.step(state, batch)
+        float(m["loss"])
+        t0 = time.perf_counter()
+        n = 10
+        for _ in range(n):
+            state, m = prog.step(state, batch)
+        float(m["loss"])
+        print(json.dumps({
+            "schedule": sched,
+            "step_ms": round((time.perf_counter() - t0) / n * 1e3, 1),
+        }))
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--aot", action="store_true")
+    ap.add_argument("--wall", action="store_true")
+    args = ap.parse_args()
+    if args.aot:
+        run_aot()
+    if args.wall:
+        run_wall()

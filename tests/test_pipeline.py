@@ -129,3 +129,75 @@ def test_pipeline_gpt2_arch():
 
 # Compile-heavy module: excluded from the fast core run (pytest -m "not slow").
 pytestmark = pytest.mark.slow
+
+
+# -- 1F1B schedule + flash under PP (round 3, VERDICT r2 item 5) -------------
+
+
+def test_1f1b_matches_gpipe_and_accumulation_exactly():
+    """The 1F1B schedule is the same math as GPipe on a different timetable:
+    losses and grad norms must agree with BOTH the GPipe pipeline and the
+    non-pipelined accumulation path across multiple optimizer steps."""
+    _, fb = _run(_cfg(MeshConfig(data=2, fsdp=2, pipe=2),
+                      pipeline_schedule="1f1b"))
+    _, gp = _run(_cfg(MeshConfig(data=2, fsdp=2, pipe=2)))
+    _, ref = _run(_cfg(MeshConfig(data=2, fsdp=2, model=2)))
+    np.testing.assert_allclose([l for l, _ in fb], [l for l, _ in gp], rtol=1e-6)
+    np.testing.assert_allclose([g for _, g in fb], [g for _, g in gp], rtol=2e-5)
+    np.testing.assert_allclose([l for l, _ in fb], [l for l, _ in ref], rtol=2e-5)
+    np.testing.assert_allclose([g for _, g in fb], [g for _, g in ref], rtol=2e-4)
+
+
+def test_1f1b_trains_and_loss_decreases():
+    cfg = _cfg(MeshConfig(data=1, fsdp=2, model=2, pipe=2),
+               pipeline_schedule="1f1b", learning_rate=1e-2, warmup_steps=1)
+    prog = build_train_program(cfg)
+    state = prog.init(jax.random.PRNGKey(0))
+    batch = prog.synthetic_batch(seed=0)  # fixed batch → loss must drop
+    losses = []
+    for _ in range(6):
+        state, m = prog.step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] - 0.05, losses
+
+
+def test_1f1b_moe_aux_gradients_match_gpipe():
+    """MoE under 1F1B: the router aux-loss cotangent is threaded manually
+    (aux_cotangent); grads must match GPipe's autodiff."""
+    _, fb = _run(_cfg(MeshConfig(data=1, fsdp=2, model=2, pipe=2),
+                      model_name="moe-tiny", pipeline_schedule="1f1b"),
+                 n_steps=2)
+    _, gp = _run(_cfg(MeshConfig(data=1, fsdp=2, model=2, pipe=2),
+                      model_name="moe-tiny"), n_steps=2)
+    np.testing.assert_allclose([l for l, _ in fb], [l for l, _ in gp], rtol=1e-5)
+    np.testing.assert_allclose([g for _, g in fb], [g for _, g in gp], rtol=5e-5)
+
+
+def test_flash_attention_under_pipeline():
+    """The Pallas kernel (interpret off-TPU) under the pipe-vmapped stage:
+    spmd_axis_name threads the pipe axis into the kernel's shard_map specs.
+    Numerics must match the XLA-attention pipeline."""
+    _, fl = _run(_cfg(MeshConfig(data=1, fsdp=2, model=2, pipe=2),
+                      seq_len=128, attention_impl="flash",
+                      precision=Precision.BF16), n_steps=2)
+    _, xl = _run(_cfg(MeshConfig(data=1, fsdp=2, model=2, pipe=2),
+                      seq_len=128, attention_impl="xla",
+                      precision=Precision.BF16), n_steps=2)
+    np.testing.assert_allclose([l for l, _ in fl], [l for l, _ in xl],
+                               rtol=2e-3)
+
+
+def test_1f1b_rejects_loss_chunking():
+    with pytest.raises(ValueError, match="loss_chunk_size"):
+        build_train_program(_cfg(MeshConfig(data=2, fsdp=2, pipe=2),
+                                 pipeline_schedule="1f1b",
+                                 loss_chunk_size=32))
+
+
+def test_1f1b_rejects_reduced_comm_dtype():
+    with pytest.raises(ValueError, match="grad_allreduce_dtype"):
+        build_train_program(_cfg(MeshConfig(data=2, fsdp=2, pipe=2),
+                                 pipeline_schedule="1f1b",
+                                 precision=Precision.BF16,
+                                 param_dtype=Precision.FP32,
+                                 grad_allreduce_dtype="bf16"))

@@ -119,6 +119,7 @@ class TPULauncher:
             },
             "mesh": {"shape": mesh_shape, "note": mesh_note, "axes_order_note":
                      "outer→inner = DCN-most→ICI-most: " + str(MESH_AXES)},
+            "pipeline_schedule": config.pipeline_schedule,
             "sharding": {
                 "stage": int(stage),
                 "stage_name": ShardingStage(stage).name,

@@ -100,7 +100,12 @@ def pipeline_apply(
         y, aux = lax.scan(body, x, stage_layers)
         return y, jnp.sum(aux)
 
-    vstage = jax.vmap(stage_fn)  # over the (pipe-sharded) stage dimension
+    # vmap over the (pipe-sharded) stage dimension. ``spmd_axis_name``
+    # threads the pipe axis into sharding constraints AND shard_map specs
+    # inside the stage body — this is what lets the Pallas flash kernel's
+    # shard_map nest under the stage vmap (its batching rule inserts "pipe"
+    # into the in/out specs at the mapped dim).
+    vstage = jax.vmap(stage_fn, spmd_axis_name="pipe")
 
     def constrain(buf):
         if buf_sharding is not None:

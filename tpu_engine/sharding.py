@@ -337,6 +337,14 @@ class TPUTrainConfig(BaseModel):
     # bf16 stabiliser; 0 disables. Training loss only (eval stays pure CE).
     z_loss_coef: float = Field(default=0.0, ge=0)
 
+    # Pipeline schedule (pipe axis > 1): "gpipe" = forward all microbatches
+    # then autodiff's reverse pipeline (activation residency O(M + P) stage
+    # buffers); "1f1b" = interleaved one-forward-one-backward with manual
+    # per-stage vjp — activation residency O(P) ring slots per stage, the
+    # schedule that lets microbatch counts grow without activation blowup
+    # (tpu_engine/parallel/pipeline_1f1b.py).
+    pipeline_schedule: Literal["gpipe", "1f1b"] = "gpipe"
+
     # Elasticity (reference :78,226-238): TPU slices are fixed-shape, so
     # elasticity means re-launch at a new mesh shape + resume from checkpoint.
     elastic_resume: bool = True

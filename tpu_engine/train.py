@@ -317,21 +317,13 @@ def build_train_program(
         impl = "ulysses" if cfg.attention_impl == "ulysses" else "ring"
     elif cfg.attention_impl == "auto":
         impl = "flash" if mesh.devices.flat[0].platform == "tpu" else "xla"
-        # The pipelined step vmaps the layer body over the pipe-sharded
-        # stage dim; a shard_map built inside that vmap would mis-handle
-        # the 'pipe' axis (no spmd_axis_name) — auto falls back to XLA
-        # attention under pipeline parallelism.
-        if runtime.axis_sizes["pipe"] > 1:
-            impl = "xla"
     else:
         impl = cfg.attention_impl
-    if impl == "flash" and runtime.axis_sizes["pipe"] > 1 and mesh.size > 1:
-        raise ValueError(
-            "attention_impl='flash' is not supported with pipeline "
-            "parallelism on a multi-device mesh (the Pallas kernel's "
-            "shard_map cannot nest inside the pipeline's vmap over the "
-            "pipe-sharded stage dimension); use attention_impl='auto'/'xla'"
-        )
+    # Flash under pipeline parallelism: the stage vmap runs with
+    # spmd_axis_name="pipe" (tpu_engine/parallel/pipeline.py), whose
+    # shard_map batching rule threads the pipe axis into the kernel's
+    # in/out specs — the round-2 "cannot nest inside the pipeline's vmap"
+    # restriction is gone.
     if model_cfg.attention_impl != impl:
         model_cfg = model_cfg.with_(attention_impl=impl)
     if cfg.sliding_window is not None and model_cfg.sliding_window != cfg.sliding_window:
@@ -687,20 +679,39 @@ def build_train_program(
         )
         buf_sh = NamedSharding(mesh, P("pipe", BATCH_AXES, seq_ax))
 
-        def pipe_loss_fn(params, raw_batch, include_aux: bool = True):
-            # In-band SFT masking, as in loss_fn.
+        def _pipe_prologue(params, raw_batch):
+            """Shared GPipe/1F1B front half: in-band SFT mask decode,
+            positions, staged (cast, pipe-sharded) layer stack, and the
+            batch-wide valid-target denominator — ONE place so the two
+            schedules' objectives cannot silently diverge. Returns
+            (batch, loss_batch, positions, staged_builder, denom)."""
             batch, loss_batch = decode_masked_tokens(raw_batch)
-            accum = batch.shape[0]
             B, S = batch.shape[1], batch.shape[2]
-            positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+            positions = jnp.broadcast_to(
+                jnp.arange(S, dtype=jnp.int32)[None, :], (B, S)
+            )
+
+            def staged_of(p):
+                staged = stage_layer_stack(
+                    tfm.cast_layer_stack(p, compute_dtype), pipe_size,
+                    model_cfg.n_layers,
+                )
+                return jax.lax.with_sharding_constraint(staged, staged_sh)
+
+            denom = jnp.maximum(
+                jnp.sum((loss_batch[:, :, 1:] >= 0).astype(jnp.float32)), 1.0
+            )
+            return batch, loss_batch, positions, staged_of, denom
+
+        def pipe_loss_fn(params, raw_batch, include_aux: bool = True):
+            batch, loss_batch, positions, staged_of, denom = _pipe_prologue(
+                params, raw_batch
+            )
             # positions also feed learned absolute embeddings (gpt2 family).
             x_mb = tfm.embed_tokens(params, batch, compute_dtype,
                                     positions=positions,
                                     cfg=model_cfg)  # [M, B, S, D]
-            staged = stage_layer_stack(
-                tfm.cast_layer_stack(params, compute_dtype), pipe_size, model_cfg.n_layers
-            )
-            staged = jax.lax.with_sharding_constraint(staged, staged_sh)
+            staged = staged_of(params)
             outputs, aux_mean = pipeline_apply(
                 staged,
                 x_mb,
@@ -714,11 +725,6 @@ def build_train_program(
             )
 
             z_coef = cfg.z_loss_coef if include_aux else 0.0
-            # Batch-wide valid-target count: one division at the end, so the
-            # objective is the global masked mean (see loss_fn).
-            denom = jnp.maximum(
-                jnp.sum((loss_batch[:, :, 1:] >= 0).astype(jnp.float32)), 1.0
-            )
 
             def loss_body(acc, xs):
                 out, toks = xs
@@ -739,6 +745,86 @@ def build_train_program(
 
         pipe_grad_fn = jax.value_and_grad(pipe_loss_fn)
 
+        if cfg.pipeline_schedule == "1f1b":
+            # Interleaved 1F1B with manual per-stage vjp: O(P) in-flight
+            # stage inputs instead of GPipe-by-autodiff's O(M + P) saved
+            # boundary buffers (tpu_engine/parallel/pipeline_1f1b.py).
+            # Gradients are assembled manually — no jax.grad above this.
+            if cfg.loss_chunk_size:
+                raise ValueError(
+                    "loss_chunk_size is not supported with "
+                    "pipeline_schedule='1f1b' (the exit loss runs inside "
+                    "the schedule's scan)"
+                )
+            from tpu_engine.parallel.pipeline_1f1b import pipeline_1f1b_grads
+
+            def pipe_grad_fn(params, raw_batch):  # noqa: F811 — 1f1b override
+                batch, loss_batch, positions, staged_of, denom = (
+                    _pipe_prologue(params, raw_batch)
+                )
+                accum = batch.shape[0]
+                x_mb, embed_vjp = jax.vjp(
+                    lambda p: tfm.embed_tokens(
+                        p, batch, compute_dtype, positions=positions,
+                        cfg=model_cfg,
+                    ),
+                    params,
+                )
+                staged = staged_of(params)
+                z_coef = cfg.z_loss_coef
+                outer_sub = {k: v for k, v in params.items() if k != "layers"}
+
+                def exit_scalar(outer, y, toks):
+                    ll, zz, _ = _ce_sums(tfm.unembed(outer, y, model_cfg), toks)
+                    return (-ll + z_coef * zz) / denom
+
+                def exit_fn(y, toks):
+                    val, vjp = jax.vjp(
+                        lambda o, yy: exit_scalar(o, yy, toks), outer_sub, y
+                    )
+                    d_outer, dy = vjp(jnp.ones((), jnp.float32))
+                    return val, dy, d_outer
+
+                outer_zero = jax.tree.map(
+                    lambda a: jnp.zeros(a.shape, jnp.float32), outer_sub
+                )
+                aux_cot = (
+                    model_cfg.router_aux_coef / (model_cfg.n_layers * accum)
+                    if model_cfg.is_moe else 0.0
+                )
+                loss_sum, aux_sum, dstaged, d_outer, dx_mb = pipeline_1f1b_grads(
+                    staged, x_mb, loss_batch, model_cfg,
+                    positions=positions, exit_fn=exit_fn,
+                    outer_grad_zero=outer_zero, mesh=attn_mesh,
+                    remat=cfg.activation_checkpointing,
+                    remat_policy=cfg.remat_policy,
+                    buf_sharding=buf_sh, aux_cotangent=aux_cot,
+                    layer_constraint=layer_constraint,
+                )
+                # Assemble the full gradient tree: embedding cotangent from
+                # dx_mb, stage grads reshaped back to the [L, ...] stack
+                # (the bf16 cast's vjp is the cast back), and the exit-side
+                # outer grads (final norm, head, tied embedding).
+                (grads,) = embed_vjp(dx_mb)
+                grads = jax.tree.map(lambda a: a.astype(jnp.float32), grads)
+                L = model_cfg.n_layers
+                d_layers = jax.tree.map(
+                    lambda a: a.reshape((L,) + a.shape[2:]), dstaged
+                )
+                grads["layers"] = jax.tree.map(
+                    lambda a, b: a + b, grads["layers"], d_layers
+                )
+                for k, v in d_outer.items():
+                    grads[k] = jax.tree.map(
+                        lambda a, b: a + b.astype(jnp.float32), grads[k], v
+                    )
+                loss = loss_sum
+                if model_cfg.is_moe:
+                    loss = loss + model_cfg.router_aux_coef * aux_sum / (
+                        model_cfg.n_layers * accum
+                    )
+                return loss, grads
+
     # Gradient collective dtype (reference ``communication_data_type``,
     # ``deepspeed_launcher.py:60-62,167-169``). A post-hoc cast cannot move
     # the collective's dtype — XLA inserts the grad reduction inside the
@@ -755,6 +841,14 @@ def build_train_program(
         else None
     )
     reduced_comm = comm_dtype is not None and comm_dtype != jnp.float32
+    if reduced_comm and pipe_size > 1 and cfg.pipeline_schedule == "1f1b":
+        raise ValueError(
+            "grad_allreduce_dtype with pipeline_schedule='1f1b' is not "
+            "supported: the manual-vjp schedule accumulates gradients in "
+            "fp32 inside its scan, so the reduced-dtype collective the "
+            "option exists for would never materialise (use 'gpipe', or "
+            "drop grad_allreduce_dtype)"
+        )
     if reduced_comm and offload_params:
         raise ValueError(
             "grad_allreduce_dtype with param_offload=host is not supported: "
