@@ -89,6 +89,8 @@ if __name__ == "__main__":
     ap.add_argument("--aot", action="store_true")
     ap.add_argument("--wall", action="store_true")
     args = ap.parse_args()
+    if not (args.aot or args.wall):
+        ap.error("pass --aot and/or --wall")
     if args.aot:
         run_aot()
     if args.wall:

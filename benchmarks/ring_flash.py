@@ -1,0 +1,55 @@
+"""Ring attention: Pallas-kernel hops vs dense-einsum hops (AOT memory A/B).
+
+Round-2 carry-over: ring attention computed each visiting K/V block with a
+dense fp32 einsum — materialising a [B, H, S_local, S_local] score tensor
+per hop. Round 3 routes every hop through the Pallas flash kernel
+(``ops._flash_pallas.flash_fwd_lse``: the kernel's log-sum-exp output
+merges hops online, differentiably), so no score tensor exists at any
+scale. This benchmark AOT-compiles a long-context training step both ways
+and lets ``memory_analysis`` (or the OOM) tell the story.
+
+Run: ``python benchmarks/ring_flash.py``   (results in RESULTS.md)
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main() -> None:
+    import tpu_engine.parallel.ring_attention as ra
+    from benchmarks.aot import aot_lowered
+
+    orig = ra._ring_attention_local
+
+    def dense_local(q, k, v, axis_name, causal=True, interpret=False,
+                    use_flash=True):
+        return orig(q, k, v, axis_name, causal=causal, interpret=interpret,
+                    use_flash=False)
+
+    for mode in ("flash", "dense"):
+        ra._ring_attention_local = orig if mode == "flash" else dense_local
+        t0 = time.time()
+        try:
+            comp = aot_lowered(
+                "llama-1b", "v5e:4x4", dict(data=1, fsdp=4, sequence=4),
+                micro=1, accum=1, seq=32768,
+                overrides={"activation_checkpointing": True},
+            ).compile()
+            ma = comp.memory_analysis()
+            print(json.dumps({
+                "ring_hops": mode, "seq": 32768,
+                "device_args_gib": round(ma.argument_size_in_bytes / 2**30, 2),
+                "device_temp_gib": round(ma.temp_size_in_bytes / 2**30, 2),
+                "compile_s": round(time.time() - t0, 1),
+            }))
+        except Exception as e:  # OOM is the result, not a failure
+            print(json.dumps({
+                "ring_hops": mode, "seq": 32768, "error": str(e)[:200],
+            }))
+    ra._ring_attention_local = orig
+
+
+if __name__ == "__main__":
+    main()

@@ -93,11 +93,12 @@ def _k_index(q_idx, j, block: int, window: int):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
-                block_q: int, block_k: int, scale: float, window: int):
+                block_q: int, block_k: int, scale: float, window: int,
+                causal: bool = True):
     q_idx = pl.program_id(1)
     j = pl.program_id(2)
     n_j = pl.num_programs(2)
-    k_idx = _k_index(q_idx, j, block_q, window)
+    k_idx = _k_index(q_idx, j, block_q, window) if causal else j
 
     @pl.when(j == 0)
     def _init():
@@ -108,7 +109,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
     # Causal with BLOCK_Q == BLOCK_K: only K blocks with k_idx <= q_idx
     # contribute; the rest are skipped entirely. (The windowed lower bound
     # is built into the grid offset — k_idx never starts below it.)
-    active = k_idx <= q_idx
+    # Non-causal (ring attention's fully-visible hops): every block is
+    # active and no visibility mask is computed at all.
+    active = (k_idx <= q_idx) if causal else (j >= 0)
 
     @pl.when(active)
     def _compute():
@@ -122,13 +125,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [BQ, BK] fp32
-        q_pos = q_idx * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-        k_pos = k_idx * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(_visible(q_pos, k_pos, window), s, _NEG_INF)
+        if causal:
+            q_pos = q_idx * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_idx * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(_visible(q_pos, k_pos, window), s, _NEG_INF)
         m = m_scr[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
-        p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
+        if causal:
+            p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
         corr = jnp.exp(m - m_new)
         m_scr[...] = m_new
         l_scr[...] = l_scr[...] * corr + jnp.sum(p, axis=-1)
@@ -146,31 +153,35 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         lse_ref[0, 0] = m_scr[...] + jnp.log(l_safe)
 
 
-def _kv_clamp(block: int, window: int):
+def _kv_clamp(block: int, window: int, causal: bool = True):
     """Index map for K/V blocks in Q-major grids: map the inner coordinate
     to the actual K-block, clamped into the active range so causally-masked
     iterations repeat an index the pipeline has already fetched — no
-    bandwidth is spent on blocks the kernel won't read."""
+    bandwidth is spent on blocks the kernel won't read. Non-causal grids
+    visit every block, so the coordinate maps straight through."""
+    if not causal:
+        return lambda bh, i, j: (bh, j, 0)
     return lambda bh, i, j: (bh, jnp.minimum(_k_index(i, j, block, window), i), 0)
 
 
-def _flash_fwd(q, k, v, block: int, interpret: bool, window: int):
+def _flash_fwd(q, k, v, block: int, interpret: bool, window: int,
+               causal: bool = True):
     """q/k/v: [BH, S, D] → (o [BH, S, D], lse [BH, S])."""
     BH, S, D = q.shape
     n_blk = S // block
     scale = 1.0 / (D ** 0.5)
     # Inner dim = K blocks (sequential); with a window it is shortened to
     # the max number of visible K-blocks per Q-block.
-    grid = (BH, n_blk, _n_kv_blocks(n_blk, block, window))
+    grid = (BH, n_blk, _n_kv_blocks(n_blk, block, window) if causal else n_blk)
     kernel = partial(_fwd_kernel, block_q=block, block_k=block, scale=scale,
-                     window=window)
+                     window=window, causal=causal)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block, D), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block, D), _kv_clamp(block, window)),
-            pl.BlockSpec((1, block, D), _kv_clamp(block, window)),
+            pl.BlockSpec((1, block, D), _kv_clamp(block, window, causal)),
+            pl.BlockSpec((1, block, D), _kv_clamp(block, window, causal)),
         ],
         out_specs=[
             pl.BlockSpec((1, block, D), lambda bh, i, j: (bh, i, 0)),
@@ -200,11 +211,14 @@ def _flash_fwd(q, k, v, block: int, interpret: bool, window: int):
 # ---------------------------------------------------------------------------
 
 
-def _recompute_p(q, k, lse_row, q_idx, k_idx, block_q, block_k, scale, window):
+def _recompute_p(q, k, lse_row, q_idx, k_idx, block_q, block_k, scale, window,
+                 causal=True):
     """Rebuild one [BQ, BK] tile of attention probabilities from saved lse."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
+    if not causal:
+        return jnp.exp(s - lse_row[:, None])
     q_pos = q_idx * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
     k_pos = k_idx * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
     mask = _visible(q_pos, k_pos, window)
@@ -212,7 +226,7 @@ def _recompute_p(q, k, lse_row, q_idx, k_idx, block_q, block_k, scale, window):
 
 
 def _p_ds_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               q_idx, k_idx, block_q, block_k, scale, window):
+               q_idx, k_idx, block_q, block_k, scale, window, causal=True):
     """Shared gradient-tile math for both backward kernels: load the four
     blocks and return (p, ds, q, k, do) — ds = p ∘ (dO·Vᵀ − Δ) · scale.
 
@@ -225,7 +239,7 @@ def _p_ds_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     v_blk = v_ref[0]                            # [BK, D]
     do = do_ref[0]                              # [BQ, D]
     p = _recompute_p(q, k_blk, lse_ref[0, 0], q_idx, k_idx,
-                     block_q, block_k, scale, window)
+                     block_q, block_k, scale, window, causal)
     dp = jax.lax.dot_general(
         do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )                                           # [BQ, BK] fp32
@@ -235,21 +249,22 @@ def _p_ds_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    dq_scr, *, block_q: int, block_k: int, scale: float,
-                   window: int):
+                   window: int, causal: bool = True):
     q_idx = pl.program_id(1)
     j = pl.program_id(2)
     n_j = pl.num_programs(2)
-    k_idx = _k_index(q_idx, j, block_q, window)
+    k_idx = _k_index(q_idx, j, block_q, window) if causal else j
 
     @pl.when(j == 0)
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    @pl.when(k_idx <= q_idx)
+    @pl.when((k_idx <= q_idx) if causal else (j >= 0))
     def _compute():
         _, ds, _, k_blk, _ = _p_ds_tile(q_ref, k_ref, v_ref, do_ref,
                                         lse_ref, delta_ref, q_idx, k_idx,
-                                        block_q, block_k, scale, window)
+                                        block_q, block_k, scale, window,
+                                        causal)
         dq_scr[...] += jax.lax.dot_general(
             ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -270,26 +285,29 @@ def _q_index(k_idx, j, window: int):
 def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *,
                     block_q: int, block_k: int, scale: float, window: int,
-                    n_blk: int):
+                    n_blk: int, causal: bool = True):
     k_idx = pl.program_id(1)
     j = pl.program_id(2)
     n_j = pl.num_programs(2)
-    q_idx = _q_index(k_idx, j, window)
+    q_idx = _q_index(k_idx, j, window) if causal else j
 
     @pl.when(j == 0)
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    active = q_idx >= k_idx
-    if window:
-        active &= q_idx < n_blk  # offset grid can run past the last Q-block
+    if causal:
+        active = q_idx >= k_idx
+        if window:
+            active &= q_idx < n_blk  # offset grid can run past the last Q-block
+    else:
+        active = j >= 0
 
     @pl.when(active)
     def _compute():
         p, ds, q, _, do = _p_ds_tile(q_ref, k_ref, v_ref, do_ref,
                                      lse_ref, delta_ref, q_idx, k_idx,
-                                     block_q, block_k, scale, window)
+                                     block_q, block_k, scale, window, causal)
         dv_scr[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -305,7 +323,14 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd(block: int, interpret: bool, window: int, res, do):
+def _flash_bwd(block: int, interpret: bool, window: int, res, do,
+               causal: bool = True, dlse=None):
+    """dq/dk/dv from the output cotangent ``do`` and, optionally, an LSE
+    cotangent ``dlse`` [BH, S] (ring attention's hop merge differentiates
+    through the returned lse). The kernels need no change for it: with
+    cotangents (dO, dlse), the score gradient is
+    ds = p ∘ (dO·Vᵀ − Δ + dlse), i.e. exactly the standard form with
+    Δ' = rowsum(dO ∘ O) − dlse substituted for Δ."""
     q, k, v, o, lse = res  # q/k/v/o: [BH, S, D]; lse: [BH, S]
     BH, S, D = q.shape
     scale = 1.0 / (D ** 0.5)
@@ -318,6 +343,8 @@ def _flash_bwd(block: int, interpret: bool, window: int, res, do):
     do32 = do.astype(jnp.float32)
     # D_i = rowsum(dO ∘ O) — the softmax-jacobian diagonal term.
     delta = jnp.sum(do32 * o.astype(jnp.float32), axis=-1)  # [BH, S]
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
     lse3 = lse.reshape(BH, 1, S)
     delta3 = delta.reshape(BH, 1, S)
 
@@ -329,13 +356,13 @@ def _flash_bwd(block: int, interpret: bool, window: int, res, do):
     # so the pipeline elides the DMA.
     dq = pl.pallas_call(
         partial(_bwd_dq_kernel, block_q=bb, block_k=bb, scale=scale,
-                window=window),
+                window=window, causal=causal),
         # (bh, q-block, k-block innermost) — inner dim shortened by a window
-        grid=(BH, n_blk, _n_kv_blocks(n_blk, bb, window)),
+        grid=(BH, n_blk, _n_kv_blocks(n_blk, bb, window) if causal else n_blk),
         in_specs=[
             qkv_spec,  # q
-            pl.BlockSpec((1, bb, D), _kv_clamp(bb, window)),  # k
-            pl.BlockSpec((1, bb, D), _kv_clamp(bb, window)),  # v
+            pl.BlockSpec((1, bb, D), _kv_clamp(bb, window, causal)),  # k
+            pl.BlockSpec((1, bb, D), _kv_clamp(bb, window, causal)),  # v
             qkv_spec,  # do
             row_spec,  # lse
             row_spec,  # delta
@@ -349,7 +376,10 @@ def _flash_bwd(block: int, interpret: bool, window: int, res, do):
         interpret=interpret,
     )(q, k, v, do, lse3, delta3)
 
-    if window:
+    if not causal:
+        def _q_blk(i, j):
+            return j
+    elif window:
         # Offset inner grid: q-block = i + j, clamped to the last real block
         # for the tail iterations past the end of the sequence.
         def _q_blk(i, j):
@@ -362,9 +392,9 @@ def _flash_bwd(block: int, interpret: bool, window: int, res, do):
     moving_row = pl.BlockSpec((1, 1, bb), lambda bh, i, j: (bh, 0, _q_blk(i, j)))
     dk, dv = pl.pallas_call(
         partial(_bwd_dkv_kernel, block_q=bb, block_k=bb, scale=scale,
-                window=window, n_blk=n_blk),
+                window=window, n_blk=n_blk, causal=causal),
         # (bh, k-block, q-block innermost) — inner dim shortened by a window
-        grid=(BH, n_blk, _n_q_blocks(n_blk, bb, window)),
+        grid=(BH, n_blk, _n_q_blocks(n_blk, bb, window) if causal else n_blk),
         in_specs=[
             qkv_spec,    # k
             qkv_spec,    # v
@@ -411,6 +441,34 @@ def _flash_bhsd_bwd(block, interpret, window, res, do):
 
 
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
+
+
+# ---------------------------------------------------------------------------
+# (o, lse) entry for ring attention's per-hop blocks
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_fwd_lse(q, k, v, block: int, interpret: bool, causal: bool):
+    """Flash attention on [BH, S, D] returning ``(o, lse)`` — the entry ring
+    attention calls per K/V hop. ``lse`` is differentiable: its cotangent
+    from the hop merge folds into the standard backward via the Δ' trick
+    (see :func:`_flash_bwd`). ``causal=False`` runs the unmasked kernels
+    (a ring hop strictly in the past is fully visible)."""
+    return _flash_fwd(q, k, v, block, interpret, 0, causal=causal)
+
+
+def _flash_fwd_lse_fwd(q, k, v, block, interpret, causal):
+    o, lse = _flash_fwd(q, k, v, block, interpret, 0, causal=causal)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_fwd_lse_bwd(block, interpret, causal, res, cts):
+    do, dlse = cts
+    return _flash_bwd(block, interpret, 0, res, do, causal=causal, dlse=dlse)
+
+
+flash_fwd_lse.defvjp(_flash_fwd_lse_fwd, _flash_fwd_lse_bwd)
 
 
 def flash_mha(q, k, v, causal: bool = True, interpret: bool | None = None,

@@ -29,8 +29,91 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_engine.mesh_runtime import BATCH_AXES
+from tpu_engine.ops._flash_pallas import _pick_block, flash_fwd_lse
 
 _NEG_INF = -1e30
+
+
+def _ring_flash_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool,
+    interpret: bool,
+    block: int,
+) -> jax.Array:
+    """Flash-kernel ring body: each hop's K/V block goes through the Pallas
+    kernel (``flash_fwd_lse``), and hops merge via their log-sum-exps —
+    no [Sq, Sk] score tensor is ever materialised, per hop or in total.
+
+    Hop cases under causality (kv_idx = global block index held this hop):
+    strictly-future blocks are SKIPPED entirely (``lax.switch`` runs one
+    branch — no wasted kernel launch), the diagonal block runs the causal
+    kernel, and strictly-past blocks run the unmasked kernel. The merge
+    differentiates end-to-end: the kernel's lse output is a custom_vjp
+    primal whose cotangent folds into the standard backward
+    (``_flash_bwd``'s Δ' substitution).
+    """
+    B, Sq, H, D = q.shape
+    ring = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+
+    def to_bhsd(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, Sq, D)
+
+    qb = to_bhsd(q)
+    BH = B * H
+
+    m0 = jnp.full((BH, Sq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((BH, Sq), jnp.float32)
+    o0 = jnp.zeros((BH, Sq, D), jnp.float32)
+
+    perm = [(j, (j + 1) % ring) for j in range(ring)]
+
+    def skip(qb, kb, vb):
+        return (jnp.zeros((BH, Sq, D), qb.dtype),
+                jnp.full((BH, Sq), -jnp.inf, jnp.float32))
+
+    def diag(qb, kb, vb):
+        return flash_fwd_lse(qb, kb, vb, block, interpret, True)
+
+    def full_blk(qb, kb, vb):
+        return flash_fwd_lse(qb, kb, vb, block, interpret, False)
+
+    def attend(m, l, o, k_blk, v_blk, i):
+        kv_idx = (my_idx - i) % ring
+        kb, vb = to_bhsd(k_blk), to_bhsd(v_blk)
+        if causal:
+            case = jnp.where(kv_idx > my_idx, 0,
+                             jnp.where(kv_idx == my_idx, 1, 2))
+            o_i, lse_i = lax.switch(case, (skip, diag, full_blk), qb, kb, vb)
+        else:
+            o_i, lse_i = full_blk(qb, kb, vb)
+        # LSE merge: out = Σ_i exp(lse_i)·o_i / Σ_i exp(lse_i), online with
+        # a running max. Skipped hops carry lse = -inf and contribute 0
+        # (guarded — exp(-inf - -inf) would be NaN before any real hop).
+        m_new = jnp.maximum(m, lse_i)
+        c_old = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new), 0.0)
+        c_new = jnp.where(jnp.isfinite(lse_i), jnp.exp(lse_i - m_new), 0.0)
+        l = l * c_old + c_new
+        o = o * c_old[..., None] + o_i.astype(jnp.float32) * c_new[..., None]
+        return m_new, l, o
+
+    def hop(carry, i):
+        m, l, o, k_blk, v_blk = carry
+        m, l, o = attend(m, l, o, k_blk, v_blk, i)
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return (m, l, o, k_next, v_next), None
+
+    (m, l, o, k_last, v_last), _ = lax.scan(
+        hop, (m0, l0, o0, k, v), jnp.arange(ring - 1)
+    )
+    m, l, o = attend(m, l, o, k_last, v_last, ring - 1)
+
+    out = o / jnp.maximum(l, 1e-30)[..., None]          # [BH, Sq, D]
+    return out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3).astype(q.dtype)
 
 
 def _ring_attention_local(
@@ -39,17 +122,25 @@ def _ring_attention_local(
     v: jax.Array,
     axis_name: str,
     causal: bool = True,
+    interpret: bool = False,
+    use_flash: bool = True,
 ) -> jax.Array:
     """Per-shard ring attention body (runs inside shard_map).
 
     q: [B, Sq, H, D] local query shard; k/v: [B, Sk, KV, D] local shards.
-    Returns [B, Sq, H, D].
+    Returns [B, Sq, H, D]. Tileable shards route per-hop blocks through the
+    Pallas flash kernel (``_ring_flash_local``); anything else falls back
+    to the dense einsum body below.
     """
     B, Sq, H, D = q.shape
     Sk, KV = k.shape[1], k.shape[2]
-    if KV != H:  # GQA: expand before the ring so every hop is one einsum
+    if KV != H:  # GQA: expand before the ring so every hop is one block
         k = jnp.repeat(k, H // KV, axis=2)
         v = jnp.repeat(v, H // KV, axis=2)
+
+    block = _pick_block(Sq) if use_flash else 0
+    if block and Sq >= 64 and Sk == Sq:
+        return _ring_flash_local(q, k, v, axis_name, causal, interpret, block)
 
     ring = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
@@ -117,9 +208,13 @@ def ring_mha(
     shard_map distributes: batch over (data, fsdp), sequence over
     ``sequence``, heads over ``model``.
     """
+    # Off-TPU (CPU dry-run/test meshes) the kernel runs in interpret mode —
+    # same custom_vjp wrapping as the TPU build (cf. ulysses/flash paths).
+    interpret = mesh.devices.flat[0].platform != "tpu"
     spec = P(BATCH_AXES, axis_name, "model", None)
     f = jax.shard_map(
-        partial(_ring_attention_local, axis_name=axis_name, causal=causal),
+        partial(_ring_attention_local, axis_name=axis_name, causal=causal,
+                interpret=interpret),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

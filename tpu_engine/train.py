@@ -679,7 +679,7 @@ def build_train_program(
         )
         buf_sh = NamedSharding(mesh, P("pipe", BATCH_AXES, seq_ax))
 
-        def _pipe_prologue(params, raw_batch):
+        def _pipe_prologue(raw_batch):
             """Shared GPipe/1F1B front half: in-band SFT mask decode,
             positions, staged (cast, pipe-sharded) layer stack, and the
             batch-wide valid-target denominator — ONE place so the two
@@ -705,7 +705,7 @@ def build_train_program(
 
         def pipe_loss_fn(params, raw_batch, include_aux: bool = True):
             batch, loss_batch, positions, staged_of, denom = _pipe_prologue(
-                params, raw_batch
+                raw_batch
             )
             # positions also feed learned absolute embeddings (gpt2 family).
             x_mb = tfm.embed_tokens(params, batch, compute_dtype,
@@ -760,7 +760,7 @@ def build_train_program(
 
             def pipe_grad_fn(params, raw_batch):  # noqa: F811 — 1f1b override
                 batch, loss_batch, positions, staged_of, denom = (
-                    _pipe_prologue(params, raw_batch)
+                    _pipe_prologue(raw_batch)
                 )
                 accum = batch.shape[0]
                 x_mb, embed_vjp = jax.vjp(
