@@ -341,3 +341,73 @@ def test_gemma2_features_rejected():
 
 # Compile-heavy module: excluded from the fast core run (pytest -m "not slow").
 pytestmark = pytest.mark.slow
+
+
+# ---------------------------------------------------------------------------
+# Qwen3 family (per-head qk-norm, decoupled head_dim)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_qwen3(seed=0, n_kv=2, tied=False):
+    from transformers import Qwen3Config, Qwen3ForCausalLM
+
+    torch.manual_seed(seed)
+    hf_cfg = Qwen3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=n_kv,
+        head_dim=32, max_position_embeddings=128, rms_norm_eps=1e-6,
+        rope_theta=1_000_000.0, tie_word_embeddings=tied,
+        attn_implementation="eager",
+    )
+    return hf_cfg, Qwen3ForCausalLM(hf_cfg).eval()
+
+
+@pytest.mark.parametrize("n_kv,tied", [(2, False), (4, True)])
+def test_qwen3_to_ours_logit_parity(n_kv, tied):
+    """Pins the whole Qwen3 recipe against transformers: per-head qk-norm
+    before RoPE, decoupled head_dim=32 (!= 64/4 = 16), GQA grouping, and
+    the tied-embedding import (0.6B–4B variants materialise the tie)."""
+    hf_cfg, model = _tiny_qwen3(n_kv=n_kv, tied=tied)
+    cfg = config_from_hf(hf_cfg)
+    assert cfg.arch == "qwen" and cfg.head_dim == 32 and cfg.n_kv_heads == n_kv
+    params = from_hf(model.state_dict(), cfg)
+    assert params["layers"]["q_norm"]["scale"].shape == (2, 32)
+    assert "lm_head" in params  # tied variants materialise the tie
+
+    tokens = np.random.default_rng(11).integers(0, 256, (2, 16))
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(
+        tfm.forward(params, jnp.asarray(tokens, jnp.int32), cfg, compute_dtype=jnp.float32)
+    )
+    np.testing.assert_allclose(ours, hf_logits, atol=2e-3, rtol=2e-3)
+
+
+def test_qwen3_export_roundtrip(tmp_path):
+    from transformers import Qwen3ForCausalLM
+
+    from tpu_engine.models.convert import save_hf_checkpoint
+
+    cfg = tfm.MODEL_CONFIGS["qwen-tiny"]
+    params = tfm.init_params(jax.random.PRNGKey(17), cfg)
+    out = save_hf_checkpoint(params, cfg, str(tmp_path / "qwen-export"))
+    reloaded = Qwen3ForCausalLM.from_pretrained(
+        out, attn_implementation="eager"
+    ).eval()
+    assert reloaded.config.head_dim == 32
+    tokens = np.random.default_rng(12).integers(0, cfg.vocab_size, (1, 24))
+    with torch.no_grad():
+        hf_logits = reloaded(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(
+        tfm.forward(params, jnp.asarray(tokens, jnp.int32), cfg, compute_dtype=jnp.float32)
+    )
+    np.testing.assert_allclose(ours, hf_logits, atol=2e-3, rtol=2e-3)
+
+
+def test_qwen2_rejected():
+    from transformers import Qwen2Config
+
+    with pytest.raises(ValueError, match="qwen2"):
+        config_from_hf(Qwen2Config(vocab_size=64, hidden_size=32,
+                                   intermediate_size=64, num_hidden_layers=1,
+                                   num_attention_heads=2))

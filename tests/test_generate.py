@@ -400,3 +400,21 @@ def test_int8_kv_cache_windowed_ring():
 
 # Compile-heavy module: excluded from the fast core run (pytest -m "not slow").
 pytestmark = pytest.mark.slow
+
+
+def test_qwen_decode_matches_forward():
+    """Qwen3 decode (per-head qk-norm before RoPE, decoupled head_dim, GQA)
+    must match the training forward position-for-position."""
+    cfg, params, tokens = _setup(name="qwen-tiny")
+    B, S = tokens.shape
+    full = tfm.forward(params, tokens, cfg, compute_dtype=jnp.float32)
+    cache = init_cache(cfg, B, S, dtype=jnp.float32)
+    logits, cache = forward_with_cache(params, tokens[:, :5], cache, cfg,
+                                       compute_dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(full[:, :5]),
+                               atol=2e-4, rtol=2e-4)
+    for t in range(5, 9):
+        logits, cache = forward_with_cache(params, tokens[:, t:t+1], cache, cfg,
+                                           compute_dtype=jnp.float32)
+        np.testing.assert_allclose(np.asarray(logits[:, 0]), np.asarray(full[:, t]),
+                                   atol=2e-4, rtol=2e-4)

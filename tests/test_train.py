@@ -324,3 +324,13 @@ def test_sft_training_learns_completions_only():
 
 # Compile-heavy module: excluded from the fast core run (pytest -m "not slow").
 pytestmark = pytest.mark.slow
+
+
+def test_qwen_arch_trains():
+    """Qwen3 family (per-head qk-norm before RoPE, decoupled head_dim, GQA,
+    untied head) trains end-to-end on a sharded mesh with tensor
+    parallelism; loss decreases."""
+    cfg = tiny_config(model_name="qwen-tiny",
+                      mesh=MeshConfig(data=2, fsdp=2, model=2))
+    _, _, losses = run_steps(cfg, n=8)
+    assert losses[-1] < losses[0] * 0.7, losses

@@ -40,6 +40,7 @@ from tpu_engine.models.transformer import (
     _dense_mlp,
     _norm,
     _proj,
+    _rms_norm,
     _rope,
     cast_layer_stack,
     embed_tokens,
@@ -182,6 +183,9 @@ def _decode_block(x, layer_params, k_cache, v_cache, write, slot_pos, positions,
     q = proj(h, "q").reshape(B, T, H, HD)
     k = proj(h, "k").reshape(B, T, KV, HD)
     v = proj(h, "v").reshape(B, T, KV, HD)
+    if cfg.arch == "qwen":  # per-head qk-norm, before RoPE (as in training)
+        q = _rms_norm(q, layer_params["q_norm"]["scale"], cfg.norm_eps)
+        k = _rms_norm(k, layer_params["k_norm"]["scale"], cfg.norm_eps)
     if not gpt2:  # gpt2 adds learned positions at embed time instead
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)

@@ -58,6 +58,13 @@ def config_from_hf(hf_config: Any) -> ModelConfig:
             "local attention / pre-post norms) is not implemented; only "
             "Gemma-1 ('gemma') converts"
         )
+    if model_type == "qwen3":
+        return config_from_hf_qwen3(hf_config)
+    if model_type == "qwen2":
+        raise ValueError(
+            "model_type='qwen2' (attention qkv biases, no qk-norm) is not "
+            "implemented; the Qwen3 family ('qwen3') converts"
+        )
     scaling = getattr(hf_config, "rope_scaling", None)
     if scaling:
         raise ValueError(
@@ -86,6 +93,45 @@ def config_from_hf(hf_config: Any) -> ModelConfig:
         # MistralConfig carries sliding_window (None = disabled); Llama has
         # no such attribute. Tensor layouts are otherwise identical.
         sliding_window=getattr(hf_config, "sliding_window", None) or 0,
+    )
+
+
+def config_from_hf_qwen3(hf_config: Any) -> ModelConfig:
+    """Map a ``transformers.Qwen3Config`` onto :class:`ModelConfig`
+    (arch="qwen"): the llama recipe plus per-head qk-norm and a decoupled
+    head_dim. Tied-embedding variants (0.6B–4B) import by materialising
+    the tie into the explicit head (``from_hf_llama``'s fallback)."""
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling:
+        raise ValueError(
+            f"rope_scaling={scaling!r} is not supported: converted weights "
+            "would compute different RoPE frequencies than transformers"
+        )
+    if getattr(hf_config, "use_sliding_window", False):
+        # HF Qwen windows only layers >= max_window_layers; a single global
+        # window field cannot represent that — converting would be silently
+        # wrong on the non-windowed layers. (Released Qwen3 dense models
+        # ship with use_sliding_window=False.)
+        raise ValueError(
+            "use_sliding_window=True (layered windows via max_window_layers) "
+            "is not representable; only full-attention Qwen3 converts"
+        )
+    derived_hd = hf_config.hidden_size // hf_config.num_attention_heads
+    hd = getattr(hf_config, "head_dim", None) or derived_hd
+    return ModelConfig(
+        name=getattr(hf_config, "name_or_path", "") or "hf-qwen3",
+        arch="qwen",
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(hf_config, "num_key_value_heads", None)
+        or hf_config.num_attention_heads,
+        head_dim_override=0 if hd == derived_hd else hd,
+        d_ff=hf_config.intermediate_size,
+        max_seq_len=getattr(hf_config, "max_position_embeddings", 32_768),
+        rope_theta=getattr(hf_config, "rope_theta", 1_000_000.0),
+        norm_eps=getattr(hf_config, "rms_norm_eps", 1e-6),
     )
 
 
@@ -132,6 +178,14 @@ def from_hf_llama(
         },
         "final_norm": {"scale": leaf("model.norm.weight")},
     }
+    if cfg.arch == "qwen":
+        # Qwen3 per-head qk-norm scales [head_dim] per layer.
+        params["layers"]["q_norm"] = {
+            "scale": stacked(p + "self_attn.q_norm.weight")
+        }
+        params["layers"]["k_norm"] = {
+            "scale": stacked(p + "self_attn.k_norm.weight")
+        }
     if cfg.arch == "gemma":
         # Gemma ties the head to the embedding; state dicts may still carry
         # the tied tensor as its own entry — consume it after checking it
@@ -215,6 +269,16 @@ def hf_config_from(cfg: ModelConfig) -> Any:
             hidden_activation="gelu_pytorch_tanh",
         )
         return GemmaConfig(**common)
+    if cfg.arch == "qwen":
+        if cfg.sliding_window:
+            raise ValueError(
+                "a globally-windowed qwen model has no faithful Qwen3Config "
+                "representation (HF windows only layers >= max_window_layers)"
+            )
+        from transformers import Qwen3Config
+
+        common.update(head_dim=cfg.head_dim, attention_bias=False)
+        return Qwen3Config(**common)
     if cfg.sliding_window:
         # Sliding-window models round-trip as Mistral (same tensor layout,
         # windowed attention carried in the config).
@@ -244,6 +308,10 @@ def save_hf_checkpoint(params: dict[str, Any], cfg: ModelConfig, out_dir: str) -
         model_cls, to_hf = GPT2LMHeadModel, to_hf_gpt2
     elif cfg.arch == "gemma":
         model_cls, to_hf = GemmaForCausalLM, to_hf_llama
+    elif cfg.arch == "qwen":
+        from transformers import Qwen3ForCausalLM
+
+        model_cls, to_hf = Qwen3ForCausalLM, to_hf_llama
     elif cfg.sliding_window:
         model_cls, to_hf = MistralForCausalLM, to_hf_llama
     else:
@@ -296,6 +364,11 @@ def to_hf_llama(params: dict[str, Any], cfg: ModelConfig) -> dict[str, np.ndarra
         ("mlp.up_proj.weight", host["layers"]["up"]["kernel"], True),
         ("mlp.down_proj.weight", host["layers"]["down"]["kernel"], True),
     ]
+    if cfg.arch == "qwen":
+        layer_map += [
+            ("self_attn.q_norm.weight", host["layers"]["q_norm"]["scale"], False),
+            ("self_attn.k_norm.weight", host["layers"]["k_norm"]["scale"], False),
+        ]
     for i in range(L):
         for suffix, stacked, transpose in layer_map:
             w = np.asarray(stacked[i], np.float32)

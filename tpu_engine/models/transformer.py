@@ -42,7 +42,10 @@ class ModelConfig:
     #   "gpt2"  — LayerNorm+bias, learned positions, GELU, biases, tied head;
     #   "gemma" — zero-centred RMSNorm (output = x·(1+w)), RoPE, GeGLU,
     #             sqrt(d_model)-scaled embeddings, tied head, decoupled
-    #             head_dim (256), MQA/GQA.
+    #             head_dim (256), MQA/GQA;
+    #   "qwen"  — Qwen3 family: the llama recipe plus per-head RMSNorm on
+    #             q and k before RoPE (qk-norm — the bf16 attention-logit
+    #             stabiliser), decoupled head_dim, untied head.
     arch: str = "llama"
     vocab_size: int = 32_000
     d_model: int = 768
@@ -93,6 +96,17 @@ MODEL_CONFIGS: dict[str, ModelConfig] = {
     "gpt-tiny": ModelConfig(
         name="gpt-tiny", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
         n_kv_heads=4, d_ff=128, max_seq_len=256,
+    ),
+    "qwen-tiny": ModelConfig(
+        # Decoupled head_dim (32 != 64/4) exercises the Qwen3 layout.
+        name="qwen-tiny", arch="qwen", vocab_size=512, d_model=64, n_layers=2,
+        n_heads=4, n_kv_heads=2, head_dim_override=32, d_ff=128, max_seq_len=256,
+        rope_theta=1_000_000.0,
+    ),
+    "qwen3-4b": ModelConfig(
+        name="qwen3-4b", arch="qwen", vocab_size=151_936, d_model=2560,
+        n_layers=36, n_heads=32, n_kv_heads=8, head_dim_override=128, d_ff=9728,
+        max_seq_len=32_768, rope_theta=1_000_000.0, norm_eps=1e-6,
     ),
     "gpt-125m": ModelConfig(
         name="gpt-125m", vocab_size=32_000, d_model=768, n_layers=12, n_heads=12,
@@ -217,6 +231,10 @@ def init_params(rng: jax.Array, cfg: ModelConfig, dtype=jnp.float32) -> dict[str
         "o": {"kernel": norm(k_o, (L, H * HD, D), res_std)},
         "mlp_norm": {"scale": norm_init((L, D), dtype)},
     }
+    if cfg.arch == "qwen":
+        # Per-head q/k RMSNorm scales, applied before RoPE.
+        layers["q_norm"] = {"scale": jnp.ones((L, HD), dtype)}
+        layers["k_norm"] = {"scale": jnp.ones((L, HD), dtype)}
     if cfg.is_moe:
         E = cfg.n_experts
         k_router = jax.random.fold_in(k_gate, 1)
@@ -273,6 +291,9 @@ def logical_axes(cfg: ModelConfig) -> dict[str, Any]:
         "o": {"kernel": ("layers", "heads", "embed")},
         "mlp_norm": {"scale": ("layers", "embed")},
     }
+    if cfg.arch == "qwen":
+        layers["q_norm"] = {"scale": ("layers", None)}
+        layers["k_norm"] = {"scale": ("layers", None)}
     if cfg.is_moe:
         layers["router"] = {"kernel": ("layers", "embed", None)}
         layers["gate"] = {"kernel": ("layers", "expert", "embed", "mlp")}
@@ -303,6 +324,8 @@ def param_count(cfg: ModelConfig) -> int:
     mlp = 3 * D * F * (cfg.n_experts if cfg.is_moe else 1)
     router = D * cfg.n_experts if cfg.is_moe else 0
     per_layer = D * H * HD + 2 * D * KV * HD + H * HD * D + mlp + router + 2 * D
+    if cfg.arch == "qwen":
+        per_layer += 2 * HD  # per-head q/k RMSNorm scales
     head = 0 if cfg.arch == "gemma" else D * V  # gemma: tied
     return V * D + L * per_layer + D + head
 
@@ -585,6 +608,9 @@ def _block(
               bias("k")).reshape(B, S, KV, HD)
     v = _proj(h, layer_params["v"]["kernel"], lora.get("v"), lora_scale,
               bias("v")).reshape(B, S, KV, HD)
+    if cfg.arch == "qwen":  # per-head qk-norm, before RoPE
+        q = _rms_norm(q, layer_params["q_norm"]["scale"], cfg.norm_eps)
+        k = _rms_norm(k, layer_params["k_norm"]["scale"], cfg.norm_eps)
     if not gpt2:  # gpt2 uses learned absolute positions, added at embed time
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)

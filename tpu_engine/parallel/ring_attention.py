@@ -56,11 +56,19 @@ def _ring_flash_local(
     (``_flash_bwd``'s Δ' substitution).
     """
     B, Sq, H, D = q.shape
+    KV = k.shape[2]
     ring = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
 
     def to_bhsd(x):
         return x.transpose(0, 2, 1, 3).reshape(B * H, Sq, D)
+
+    def expand_kv(x):
+        # GQA: the ring rotates COMPACT [B, Sk, KV, D] blocks (KV/H of the
+        # inter-chip bytes); heads expand per hop, just before the kernel.
+        if KV != H:
+            x = jnp.repeat(x, H // KV, axis=2)
+        return to_bhsd(x)
 
     qb = to_bhsd(q)
     BH = B * H
@@ -83,7 +91,7 @@ def _ring_flash_local(
 
     def attend(m, l, o, k_blk, v_blk, i):
         kv_idx = (my_idx - i) % ring
-        kb, vb = to_bhsd(k_blk), to_bhsd(v_blk)
+        kb, vb = expand_kv(k_blk), expand_kv(v_blk)
         if causal:
             case = jnp.where(kv_idx > my_idx, 0,
                              jnp.where(kv_idx == my_idx, 1, 2))
@@ -134,13 +142,15 @@ def _ring_attention_local(
     """
     B, Sq, H, D = q.shape
     Sk, KV = k.shape[1], k.shape[2]
-    if KV != H:  # GQA: expand before the ring so every hop is one block
-        k = jnp.repeat(k, H // KV, axis=2)
-        v = jnp.repeat(v, H // KV, axis=2)
 
     block = _pick_block(Sq) if use_flash else 0
     if block and Sq >= 64 and Sk == Sq:
+        # Kernel path rotates COMPACT GQA K/V and expands per hop.
         return _ring_flash_local(q, k, v, axis_name, causal, interpret, block)
+
+    if KV != H:  # dense fallback: expand so every hop is one einsum
+        k = jnp.repeat(k, H // KV, axis=2)
+        v = jnp.repeat(v, H // KV, axis=2)
 
     ring = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
