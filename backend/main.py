@@ -18,7 +18,7 @@ import time
 from aiohttp import web
 
 from backend.http import cors_middleware, error_middleware, json_response
-from backend.routers import metrics, monitoring, profiling, topology, tpu, training
+from backend.routers import metrics, monitoring, profiling, serving, topology, tpu, training
 
 VERSION = "0.1.0"
 _started_at = time.time()
@@ -95,6 +95,7 @@ def create_app() -> web.Application:
     monitoring.setup(app)
     topology.setup(app)
     profiling.setup(app)
+    serving.setup(app)
     metrics.setup(app)
     app.router.add_get("/", root)
     app.router.add_get("/health", health_check)

@@ -1,0 +1,170 @@
+"""Continuous-batching serving routes.
+
+A capability the reference does not have at all: a shared generation
+endpoint over a slot pool (``tpu_engine/serving.py``). One server at a
+time per process (it owns the model weights + KV pool); start it from a
+supervised job's current weights or from a fresh/named model init, submit
+prompts, poll results, read stats, stop it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Optional
+
+from aiohttp import web
+from pydantic import BaseModel, ConfigDict, Field
+
+from backend import state
+from backend.http import ApiError, json_response, parse_body
+
+
+class ServingStartRequest(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    # Weight source: a supervised job id (its CURRENT params) or a model
+    # name (fresh deterministic init — test/demo use).
+    job_id: Optional[str] = None
+    model_name: Optional[str] = None
+    max_slots: int = Field(default=4, ge=1, le=64)
+    max_len: int = Field(default=1024, ge=8)
+    eos_id: Optional[int] = Field(default=None, ge=0)
+    seed: int = 0
+
+
+class ServingSubmitRequest(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    prompt: list[int] = Field(min_length=1)
+    max_new_tokens: int = Field(default=64, ge=1)
+    temperature: float = Field(default=0.0, ge=0.0)
+
+
+_server: Any = None
+_stop: Optional[threading.Event] = None
+_thread: Optional[threading.Thread] = None
+_lock = threading.Lock()
+
+
+def _shutdown_locked() -> None:
+    global _server, _stop, _thread
+    if _stop is not None:
+        _stop.set()
+    if _thread is not None:
+        _thread.join(timeout=10)
+    _server, _stop, _thread = None, None, None
+
+
+async def start_server(request: web.Request) -> web.Response:
+    req = await parse_body(request, ServingStartRequest)
+    if (req.job_id is None) == (req.model_name is None):
+        raise ApiError(422, "provide exactly one of job_id / model_name")
+
+    def _start():
+        import jax
+
+        from tpu_engine.models import transformer as tfm
+        from tpu_engine.serving import ContinuousBatcher
+
+        if req.job_id is not None:
+            job = state.launcher.get_job(req.job_id)
+            if job is None:
+                raise ApiError(404, f"job '{req.job_id}' not found")
+            if job.program is None or job._state is None:
+                raise ApiError(409, "job has no trained state yet")
+            cfg = job.program.model_config
+            # Decode-safe snapshot: the train step DONATES the live param
+            # buffers each step, and a LoRA job's servable weights are the
+            # merged tree — both handled by the supervisor's snapshot.
+            params = job._params_snapshot()
+        else:
+            cfg = tfm.MODEL_CONFIGS.get(req.model_name)
+            if cfg is None:
+                raise ApiError(
+                    404,
+                    f"unknown model '{req.model_name}'; known: "
+                    f"{sorted(tfm.MODEL_CONFIGS)}",
+                )
+            params = tfm.init_params(jax.random.PRNGKey(req.seed), cfg)
+        global _server, _stop, _thread
+        with _lock:
+            if _server is not None:
+                raise ApiError(
+                    409, "a serving instance is already running; stop it first"
+                )
+            try:
+                _server = ContinuousBatcher(
+                    params, cfg, max_slots=req.max_slots, max_len=req.max_len,
+                    eos_id=req.eos_id, seed=req.seed,
+                )
+            except ValueError as e:
+                raise ApiError(422, str(e))
+            _stop = threading.Event()
+            _thread = threading.Thread(
+                target=_server.serve_forever, args=(_stop,), daemon=True,
+                name="serving-loop",
+            )
+            _thread.start()
+        return cfg.name
+
+    name = await asyncio.to_thread(_start)
+    return json_response({
+        "started": True, "model": name, "max_slots": req.max_slots,
+        "max_len": req.max_len,
+    })
+
+
+async def stop_server(request: web.Request) -> web.Response:
+    def _stop_sync():
+        with _lock:
+            if _server is None:
+                raise ApiError(404, "no serving instance is running")
+            _shutdown_locked()
+
+    await asyncio.to_thread(_stop_sync)
+    return json_response({"stopped": True})
+
+
+def _require_server():
+    if _server is None:
+        raise ApiError(409, "no serving instance is running; POST /serving/start")
+    return _server
+
+
+async def submit(request: web.Request) -> web.Response:
+    srv = _require_server()
+    req = await parse_body(request, ServingSubmitRequest)
+    try:
+        rid = await asyncio.to_thread(
+            srv.submit, req.prompt, max_new_tokens=req.max_new_tokens,
+            temperature=req.temperature,
+        )
+    except ValueError as e:
+        raise ApiError(422, str(e))
+    return json_response({"request_id": rid})
+
+
+async def result(request: web.Request) -> web.Response:
+    srv = _require_server()
+    try:
+        rid = int(request.match_info["request_id"])
+    except ValueError:
+        raise ApiError(422, "request_id must be an integer")
+    try:
+        return json_response(await asyncio.to_thread(srv.result, rid))
+    except KeyError:
+        raise ApiError(404, f"request {rid} not found")
+
+
+async def stats(request: web.Request) -> web.Response:
+    srv = _require_server()
+    return json_response(await asyncio.to_thread(srv.stats))
+
+
+def setup(app: web.Application, prefix: str = "/api/v1/serving") -> None:
+    app.router.add_post(f"{prefix}/start", start_server)
+    app.router.add_post(f"{prefix}/stop", stop_server)
+    app.router.add_post(f"{prefix}/submit", submit)
+    app.router.add_get(f"{prefix}/result/{{request_id}}", result)
+    app.router.add_get(f"{prefix}/stats", stats)

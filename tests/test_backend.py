@@ -716,3 +716,39 @@ def test_speculative_generate_over_http(client, tmp_path_factory):
 
 # Compile-heavy module: excluded from the fast core run (pytest -m "not slow").
 pytestmark = pytest.mark.slow
+
+
+def test_serving_lifecycle_over_http(client):
+    # Exactly one of job_id / model_name.
+    r = client.post("/api/v1/serving/start", json={})
+    assert r.status_code == 422
+    # No instance yet → submit is a 409.
+    assert client.post("/api/v1/serving/submit",
+                       json={"prompt": [1, 2]}).status_code == 409
+
+    r = client.post("/api/v1/serving/start",
+                    json={"model_name": "gpt-tiny", "max_slots": 2,
+                          "max_len": 64})
+    assert r.status_code == 200 and r.json()["started"]
+    # Double start rejected.
+    assert client.post("/api/v1/serving/start",
+                       json={"model_name": "gpt-tiny"}).status_code == 409
+    try:
+        rid = client.post(
+            "/api/v1/serving/submit",
+            json={"prompt": [3, 4, 5], "max_new_tokens": 4},
+        ).json()["request_id"]
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            body = client.get(f"/api/v1/serving/result/{rid}").json()
+            if body["status"] == "done":
+                break
+            time.sleep(0.2)
+        assert body["status"] == "done"
+        assert len(body["tokens"]) == 4
+        st = client.get("/api/v1/serving/stats").json()
+        assert st["tokens_generated"] >= 4
+        assert client.get("/api/v1/serving/result/9999").status_code == 404
+    finally:
+        assert client.post("/api/v1/serving/stop").json()["stopped"]
+    assert client.post("/api/v1/serving/stop").status_code == 404

@@ -164,8 +164,11 @@ def _decode_block(x, layer_params, k_cache, v_cache, write, slot_pos, positions,
 
     x: [B, T, D] new activations; k_cache/v_cache: [B, M, KV, HD];
     ``write(cache_arr, rows)`` stores the chunk's rows at its slots (built
-    once in :func:`forward_with_cache`); ``slot_pos`` [M] is the global
-    position held by each cache slot after this chunk's writes.
+    once in :func:`forward_with_cache`); ``slot_pos`` is the global
+    position held by each cache slot after this chunk's writes — [M]
+    (all rows in lockstep, the generate() case) or [B, M] (per-row
+    positions, the continuous-batching slot pool in
+    ``tpu_engine/serving.py``).
     ``k_scale_c``/``v_scale_c`` [B, M, KV, 1] are present for int8 caches:
     new rows are quantised before the write and the cache reads dequantise
     (the convert+mul fuses into the attention dots).
@@ -216,10 +219,11 @@ def _decode_block(x, layer_params, k_cache, v_cache, write, slot_pos, positions,
     # additionally hide keys older than the window, matching the
     # training-time mask; ring-buffer slots overwritten by in-chunk later
     # positions are masked for earlier queries by the same comparison.
-    key_pos = slot_pos  # [M]
-    mask = (key_pos[None, :] >= 0) & (key_pos[None, :] <= positions[:, :, None])
+    key_pos = slot_pos if slot_pos.ndim == 2 else slot_pos[None, :]  # [B|1, M]
+    kp = key_pos[:, None, :]                                         # [B|1, 1, M]
+    mask = (kp >= 0) & (kp <= positions[:, :, None])
     if cfg.sliding_window:
-        mask &= key_pos[None, :] > positions[:, :, None] - cfg.sliding_window
+        mask &= kp > positions[:, :, None] - cfg.sliding_window
     scores = jnp.where(mask[:, None, :, :], scores, _NEG_INF)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
     attn = jnp.einsum("bhtm,bmhd->bthd", probs, vc).reshape(B, T, H * HD)

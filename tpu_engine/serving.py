@@ -1,0 +1,430 @@
+"""Continuous-batching generation server (in-process, TPU-static shapes).
+
+The reference has no serving story at all; :func:`tpu_engine.generate.generate`
+serves the single-request case. This module adds the missing piece for a
+shared endpoint: a fixed pool of decode SLOTS that requests join and leave
+independently — a finishing request frees its slot for the next queued
+prompt while the others keep decoding, so the chip never idles between
+requests and short prompts are not held hostage by long ones.
+
+TPU-first design:
+
+- **Static shapes everywhere.** The KV pool is ``[L, slots, max_len, KV,
+  HD]`` for the server's lifetime; one jitted decode step advances ALL
+  slots one token per call (empty/finished lanes compute masked garbage —
+  wasted lanes, never a recompile).
+- **Per-row positions.** Unlike :class:`generate.KVCache` (whose scalar
+  ``length`` advances every row in lockstep), each slot carries its own
+  length; K/V writes are per-row scatters (``.at[arange(B), lengths]``)
+  and the attention mask is ``key_pos <= length_b``.
+- **Prefill by reuse.** An admitted prompt runs through the existing
+  single-row :func:`generate.forward_with_cache` (padded up to a bucket
+  multiple so prompt-length recompiles are bounded) and its K/V rows are
+  copied into the slot — zero new model code on the prefill path, every
+  architecture family the decode block supports works here too.
+
+The host-side :class:`ContinuousBatcher` is thread-safe: ``submit`` from
+any thread, drive ``step`` from a serving loop (or ``serve_forever`` in a
+background thread).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tpu_engine.generate import (
+    KVCache,
+    _decode_block,
+    forward_with_cache,
+    init_cache,
+)
+from tpu_engine.models.transformer import (
+    ModelConfig,
+    cast_layer_stack,
+    embed_tokens,
+    unembed,
+)
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class SlotCache:
+    """Per-slot KV pool with INDEPENDENT row positions."""
+
+    k: jax.Array        # [L, B, S, KV, HD]
+    v: jax.Array
+    lengths: jax.Array  # [B] int32 — resident tokens per slot (0 = empty)
+
+
+def init_slot_cache(
+    cfg: ModelConfig, slots: int, max_len: int, dtype=jnp.bfloat16
+) -> SlotCache:
+    if cfg.sliding_window:
+        raise ValueError(
+            "continuous batching does not support sliding-window models yet "
+            "(per-row ring caches); serve with generate() per request"
+        )
+    shape = (cfg.n_layers, slots, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return SlotCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        lengths=jnp.zeros((slots,), jnp.int32),
+    )
+
+
+def decode_step(
+    params: dict[str, Any],
+    tokens: jax.Array,      # [B] int32 — last token per slot
+    cache: SlotCache,
+    active: jax.Array,      # [B] bool — rows that should advance
+    cfg: ModelConfig,
+    compute_dtype=jnp.bfloat16,
+) -> tuple[jax.Array, SlotCache]:
+    """One token for every slot. Returns (logits [B, V] fp32, cache).
+
+    Reuses the stock per-layer decode block (``generate._decode_block``):
+    the slot pool is just the per-row-positions instantiation of its
+    ``write`` callback (row scatter at each slot's own length) and its
+    rank-2 ``slot_pos`` (slot m holds global position m; visibility is
+    ``m <= length_b``). Every architecture family the block supports is
+    therefore served here with zero forked model code. Inactive rows still
+    compute (static shapes) but their lengths do not advance and their
+    writes land in lanes the mask never exposes.
+    """
+    B = tokens.shape[0]
+    S = cache.k.shape[2]
+    rows = jnp.arange(B)
+    positions = cache.lengths[:, None]                      # [B, 1]
+    x = embed_tokens(params, tokens[:, None], compute_dtype,
+                     positions=positions, cfg=cfg)          # [B, 1, D]
+    layer_stack = cast_layer_stack(params, compute_dtype)
+
+    # Slot m of row b holds global position m; positions past the row's
+    # length are not yet written → mark them "future" so the causal mask
+    # (m <= length_b) hides them.
+    slot_pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+
+    def write(cache_arr, new_rows):
+        # Per-row scatter at each slot's own position (T = 1).
+        return cache_arr.at[rows, cache.lengths].set(
+            new_rows[:, 0].astype(cache_arr.dtype)
+        )
+
+    def body(x, xs):
+        lp, k_c, v_c = xs                                   # k_c [B,S,KV,HD]
+        x, k_c, v_c, _, _ = _decode_block(
+            x, lp, k_c, v_c, write, slot_pos, positions, cfg
+        )
+        return x, (k_c, v_c)
+
+    x, (k_new, v_new) = lax.scan(body, x, (layer_stack, cache.k, cache.v))
+    logits = unembed(params, x, cfg)[:, 0]                  # [B, V] fp32
+    new_cache = SlotCache(
+        k=k_new, v=v_new,
+        lengths=cache.lengths + active.astype(jnp.int32),
+    )
+    return logits, new_cache
+
+
+@dataclass
+class Request:
+    """One generation request's lifecycle (host-side bookkeeping)."""
+
+    id: int
+    prompt: list[int]
+    max_new_tokens: int
+    temperature: float
+    status: str = "queued"        # queued | running | done | failed
+    error: Optional[str] = None
+    tokens: list[int] = field(default_factory=list)
+    slot: Optional[int] = None
+    submitted_at: float = field(default_factory=time.time)
+    finished_at: Optional[float] = None
+
+
+class ContinuousBatcher:
+    """Slot-pool batcher over :func:`decode_step`.
+
+    ``submit`` is thread-safe; ``step`` admits queued prompts into free
+    slots (prefill) and advances every active slot one token. Greedy when
+    ``temperature == 0``; otherwise softmax sampling with a per-(request,
+    step) folded key, so results are reproducible for a given ``seed``.
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        cfg: ModelConfig,
+        max_slots: int = 8,
+        max_len: int = 1024,
+        compute_dtype=jnp.bfloat16,
+        eos_id: Optional[int] = None,
+        seed: int = 0,
+        prefill_pad_to: int = 64,
+    ):
+        self.params = params
+        self.cfg = cfg
+        self.max_slots = int(max_slots)
+        self.max_len = int(max_len)
+        self.eos_id = eos_id
+        self.seed = seed
+        self.prefill_pad_to = int(prefill_pad_to)
+        self._cache = init_slot_cache(cfg, max_slots, max_len, compute_dtype)
+        self._decode = jax.jit(
+            partial(decode_step, cfg=cfg, compute_dtype=compute_dtype)
+        )
+        self._compute_dtype = compute_dtype
+        self._slots: list[Optional[Request]] = [None] * max_slots
+        self._last_tokens = np.zeros((max_slots,), np.int32)
+        self._queue: list[Request] = []
+        self._requests: dict[int, Request] = {}
+        self._ids = itertools.count()
+        self._pending_first_logits: dict[int, np.ndarray] = {}
+        if cfg.arch == "gpt2" and max_len > cfg.max_seq_len:
+            raise ValueError(
+                f"max_len {max_len} exceeds the learned position table "
+                f"(max_seq_len={cfg.max_seq_len}) of gpt2-family model"
+            )
+        self._lock = threading.Lock()
+        self._done = threading.Condition(self._lock)
+        self._tokens_out = 0
+        self._started = time.time()
+        self.last_error: Optional[str] = None
+
+    # -- client side ---------------------------------------------------------
+
+    def submit(self, prompt: list[int], max_new_tokens: int = 64,
+               temperature: float = 0.0) -> int:
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds the server's max_len {self.max_len}"
+            )
+        req = Request(id=next(self._ids), prompt=list(prompt),
+                      max_new_tokens=int(max_new_tokens),
+                      temperature=float(temperature))
+        with self._lock:
+            self._requests[req.id] = req
+            self._queue.append(req)
+        return req.id
+
+    def result(self, req_id: int) -> dict[str, Any]:
+        with self._lock:
+            req = self._requests.get(req_id)
+            if req is None:
+                raise KeyError(req_id)
+            out = {
+                "id": req.id, "status": req.status, "tokens": list(req.tokens),
+                "prompt_len": len(req.prompt),
+            }
+            if req.error:
+                out["error"] = req.error
+            return out
+
+    def wait(self, req_id: int, timeout: float = 60.0) -> dict[str, Any]:
+        deadline = time.time() + timeout
+        with self._done:
+            while True:
+                req = self._requests.get(req_id)
+                if req is None:
+                    raise KeyError(req_id)
+                if req.status in ("done", "failed"):
+                    out = {
+                        "id": req.id, "status": req.status,
+                        "tokens": list(req.tokens),
+                        "prompt_len": len(req.prompt),
+                    }
+                    if req.error:
+                        out["error"] = req.error
+                    return out
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise TimeoutError(f"request {req_id} not done in {timeout}s")
+                self._done.wait(remaining)
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            active = sum(1 for s in self._slots if s is not None)
+            dt = max(time.time() - self._started, 1e-9)
+            return {
+                "slots": self.max_slots,
+                "active_slots": active,
+                "queued": len(self._queue),
+                "requests_total": len(self._requests),
+                "tokens_generated": self._tokens_out,
+                "tokens_per_sec_lifetime": round(self._tokens_out / dt, 2),
+            }
+
+    # -- engine side ---------------------------------------------------------
+
+    def _prefill(self, req: Request, slot: int) -> None:
+        """Run the prompt through the stock single-row cache forward and
+        copy its K/V into the slot. Prompts pad up to ``prefill_pad_to``
+        multiples so the number of distinct compiled prefill shapes is
+        bounded; padded positions are never exposed (mask is per-row
+        length) and the first decode overwrites the first pad lane."""
+        P = len(req.prompt)
+        pad = -(-P // self.prefill_pad_to) * self.prefill_pad_to
+        pad = min(pad, self.max_len)
+        toks = np.zeros((1, pad), np.int32)
+        toks[0, :P] = req.prompt
+        c1 = init_cache(self.cfg, 1, pad, dtype=self._compute_dtype)
+        logits, c1 = forward_with_cache(
+            self.params, jnp.asarray(toks), c1, self.cfg,
+            compute_dtype=self._compute_dtype,
+        )
+        self._cache = _insert_prefill(self._cache, c1, slot, P)
+        # Next-token input = last REAL prompt token; its logits row P-1
+        # seeds sampling on the first decode step for this slot.
+        self._pending_first_logits[slot] = np.asarray(logits[0, P - 1])
+        self._last_tokens[slot] = req.prompt[-1]
+
+    def step(self) -> int:
+        """Admit queued requests, advance active slots one token.
+        Returns the number of tokens produced this call.
+
+        Locking: the lock guards only host bookkeeping (admission decisions
+        and result emission). Prefill, the jitted decode dispatch, and the
+        logits device→host sync — the long operations — run WITHOUT it, so
+        ``submit``/``result``/``stats`` from serving threads never wait on
+        device work. The engine thread is the sole mutator of the KV pool
+        and slot arrays, so they need no lock at all."""
+        # ---- admission (bookkeeping under the lock) ----
+        admitted: list[tuple[int, Request]] = []
+        with self._lock:
+            for slot in range(self.max_slots):
+                if self._slots[slot] is None and self._queue:
+                    req = self._queue.pop(0)
+                    req.status, req.slot = "running", slot
+                    self._slots[slot] = req
+                    admitted.append((slot, req))
+            active_reqs = [(i, r) for i, r in enumerate(self._slots) if r]
+        for slot, req in admitted:  # device work: outside the lock
+            self._prefill(req, slot)
+        if not active_reqs:
+            return 0
+
+        # ---- first token for freshly-prefilled slots comes from the
+        # prefill logits; everyone else decodes one step ----
+        produced = 0
+        fresh = dict(self._pending_first_logits)
+        self._pending_first_logits.clear()
+        with self._lock:
+            for slot, logits in fresh.items():
+                req = self._slots[slot]
+                if req is None:
+                    continue
+                tok = self._sample(logits, req)
+                self._emit(req, slot, tok)
+                produced += 1
+            active_reqs = [(i, r) for i, r in enumerate(self._slots) if r]
+            self._tokens_out += produced
+        if not active_reqs:
+            return produced
+        active = np.zeros((self.max_slots,), bool)
+        for i, _ in active_reqs:
+            active[i] = True
+        logits, self._cache = self._decode(
+            self.params, jnp.asarray(self._last_tokens), self._cache,
+            jnp.asarray(active),
+        )
+        logits_host = np.asarray(logits)  # device sync: outside the lock
+        with self._lock:
+            emitted = 0
+            for slot, req in active_reqs:
+                if self._slots[slot] is not req:
+                    continue  # request state changed while we computed
+                tok = self._sample(logits_host[slot], req)
+                self._emit(req, slot, tok)
+                emitted += 1
+            self._tokens_out += emitted
+        return produced + emitted
+
+    def _sample(self, logits: np.ndarray, req: Request) -> int:
+        if req.temperature <= 0.0:
+            return int(np.argmax(logits))
+        key = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(self.seed), req.id),
+            len(req.tokens),
+        )
+        probs = np.asarray(
+            jax.nn.softmax(jnp.asarray(logits) / req.temperature)
+        )
+        return int(np.random.default_rng(np.asarray(key)).choice(
+            len(probs), p=probs / probs.sum()
+        ))
+
+    def _emit(self, req: Request, slot: int, tok: int) -> None:
+        req.tokens.append(tok)
+        self._last_tokens[slot] = tok
+        finished = (
+            len(req.tokens) >= req.max_new_tokens
+            or (self.eos_id is not None and tok == self.eos_id)
+            or len(req.prompt) + len(req.tokens) >= self.max_len
+        )
+        if finished:
+            req.status = "done"
+            req.finished_at = time.time()
+            self._slots[slot] = None
+            # Free slot: zero its length so admission reuses it cleanly.
+            self._cache = _reset_slot(self._cache, slot)
+            self._done.notify_all()
+
+    def serve_forever(self, stop: threading.Event, idle_sleep: float = 0.01):
+        """Drive ``step`` until ``stop``. A step failure (e.g. a prefill
+        compile OOM) marks every in-flight and queued request ``failed``
+        with the error recorded — never a silently dead thread with
+        requests stuck in ``running`` forever."""
+        while not stop.is_set():
+            try:
+                produced = self.step()
+            except Exception as e:  # noqa: BLE001 — serving boundary
+                msg = f"{type(e).__name__}: {e}"
+                with self._lock:
+                    for req in list(self._slots) + list(self._queue):
+                        if req is not None and req.status in ("queued", "running"):
+                            req.status, req.error = "failed", msg
+                            req.finished_at = time.time()
+                    self._slots = [None] * self.max_slots
+                    self._queue.clear()
+                    self._done.notify_all()
+                self.last_error = msg
+                return
+            if produced == 0:
+                time.sleep(idle_sleep)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _insert_prefill(cache: SlotCache, c1: KVCache, slot, true_len):
+    """Copy a single-row prefill cache's positions into ``slot`` and set
+    its length to the TRUE prompt length (padding lanes stay masked and
+    are overwritten as decoding proceeds)."""
+    k = lax.dynamic_update_slice(
+        cache.k, c1.k.astype(cache.k.dtype), (0, slot, 0, 0, 0)
+    )
+    v = lax.dynamic_update_slice(
+        cache.v, c1.v.astype(cache.v.dtype), (0, slot, 0, 0, 0)
+    )
+    return SlotCache(
+        k=k, v=v,
+        lengths=cache.lengths.at[slot].set(jnp.asarray(true_len, jnp.int32)),
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _reset_slot(cache: SlotCache, slot):
+    return SlotCache(
+        k=cache.k, v=cache.v, lengths=cache.lengths.at[slot].set(0)
+    )
