@@ -29,6 +29,9 @@ class ServingStartRequest(BaseModel):
     model_name: Optional[str] = None
     max_slots: int = Field(default=4, ge=1, le=64)
     max_len: int = Field(default=1024, ge=8)
+    # Greedy tokens per device dispatch (host round-trip amortisation);
+    # batches with sampled requests fall back to per-step automatically.
+    decode_chunk_steps: int = Field(default=8, ge=1, le=256)
     eos_id: Optional[int] = Field(default=None, ge=0)
     seed: int = 0
 
@@ -97,6 +100,7 @@ async def start_server(request: web.Request) -> web.Response:
                 _server = ContinuousBatcher(
                     params, cfg, max_slots=req.max_slots, max_len=req.max_len,
                     eos_id=req.eos_id, seed=req.seed,
+                    chunk_steps=req.decode_chunk_steps,
                 )
             except ValueError as e:
                 raise ApiError(422, str(e))

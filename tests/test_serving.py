@@ -121,3 +121,50 @@ def test_capacity_and_window_guards(model):
         srv.submit(list(range(1, 30)), max_new_tokens=10)
     with pytest.raises(ValueError, match="sliding-window"):
         init_slot_cache(cfg.with_(sliding_window=8), 2, 32)
+
+
+def test_chunked_greedy_matches_per_step(model):
+    """chunk_steps > 1 (N tokens per dispatch, in-scan argmax feedback,
+    overshoot rewound) must be token-for-token identical to per-step
+    serving and to generate(), including slot reuse after an early finish
+    inside a chunk."""
+    cfg, params = model
+    srv = ContinuousBatcher(params, cfg, max_slots=2, max_len=96,
+                            compute_dtype=jnp.float32, prefill_pad_to=16,
+                            chunk_steps=4)
+    rng = np.random.default_rng(21)
+    p1 = rng.integers(1, cfg.vocab_size, 5).tolist()
+    p2 = rng.integers(1, cfg.vocab_size, 9).tolist()
+    p3 = rng.integers(1, cfg.vocab_size, 4).tolist()
+    # 6 and 10 are NOT multiples of 4 → both requests overshoot mid-chunk
+    # and must be trimmed + rewound; p3 then reuses a rewound slot.
+    r1 = srv.submit(p1, max_new_tokens=6)
+    r2 = srv.submit(p2, max_new_tokens=10)
+    for _ in range(10):
+        srv.step()
+        if srv.result(r1)["status"] == "done":
+            break
+    r3 = srv.submit(p3, max_new_tokens=7)
+    for _ in range(30):
+        if all(srv.result(r)["status"] == "done" for r in (r1, r2, r3)):
+            break
+        srv.step()
+    for rid, prompt, n in ((r1, p1, 6), (r2, p2, 10), (r3, p3, 7)):
+        assert srv.result(rid)["tokens"] == _ref_greedy(params, cfg, prompt, n)
+
+
+def test_chunked_mode_defers_to_per_step_for_sampling(model):
+    """A batch containing a temperature>0 request must take the per-step
+    path (the chunk's in-scan feedback is argmax-only)."""
+    cfg, params = model
+    srv = ContinuousBatcher(params, cfg, max_slots=2, max_len=64,
+                            compute_dtype=jnp.float32, prefill_pad_to=16,
+                            chunk_steps=4, seed=7)
+    g = srv.submit([2, 3, 4], max_new_tokens=5)             # greedy
+    s = srv.submit([5, 6], max_new_tokens=5, temperature=0.8)
+    for _ in range(20):
+        if all(srv.result(r)["status"] == "done" for r in (g, s)):
+            break
+        srv.step()
+    assert srv.result(g)["tokens"] == _ref_greedy(params, cfg, [2, 3, 4], 5)
+    assert len(srv.result(s)["tokens"]) == 5

@@ -136,6 +136,42 @@ def decode_step(
     return logits, new_cache
 
 
+def decode_chunk(
+    params: dict[str, Any],
+    tokens: jax.Array,      # [B] int32 — last token per slot
+    cache: SlotCache,
+    active: jax.Array,      # [B] bool
+    cfg: ModelConfig,
+    n_steps: int,
+    compute_dtype=jnp.bfloat16,
+) -> tuple[jax.Array, SlotCache]:
+    """``n_steps`` greedy tokens per active slot in ONE dispatch.
+
+    The host drives :func:`decode_step` one token at a time — fine on-chip,
+    but each step pays a host→device round trip (expensive through remote
+    runtimes). This scans the same step with argmax feedback, so a chunk of
+    N tokens costs one dispatch + one [B, N] transfer. The host trims
+    per-request overshoot (a request hitting eos or max_new_tokens
+    mid-chunk) and REWINDS its slot length — per-row positions make the
+    rewind free: lanes past the length are masked and later writes
+    overwrite them.
+
+    Greedy only: the feedback token inside the scan is ``argmax``; batches
+    containing sampled (temperature > 0) requests take the per-step path.
+    """
+
+    def one(carry, _):
+        toks, cache = carry
+        logits, cache = decode_step(params, toks, cache, active, cfg,
+                                    compute_dtype)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        toks = jnp.where(active, nxt, toks)
+        return (toks, cache), nxt
+
+    (_, cache), out = lax.scan(one, (tokens, cache), None, length=n_steps)
+    return out.T, cache  # [B, n_steps]
+
+
 @dataclass
 class Request:
     """One generation request's lifecycle (host-side bookkeeping)."""
@@ -171,6 +207,7 @@ class ContinuousBatcher:
         eos_id: Optional[int] = None,
         seed: int = 0,
         prefill_pad_to: int = 64,
+        chunk_steps: int = 1,
     ):
         self.params = params
         self.cfg = cfg
@@ -182,6 +219,13 @@ class ContinuousBatcher:
         self._cache = init_slot_cache(cfg, max_slots, max_len, compute_dtype)
         self._decode = jax.jit(
             partial(decode_step, cfg=cfg, compute_dtype=compute_dtype)
+        )
+        # Chunked greedy decode: N tokens per dispatch (host round-trip
+        # amortisation — see decode_chunk). 1 = always per-step.
+        self.chunk_steps = max(int(chunk_steps), 1)
+        self._chunk = jax.jit(
+            partial(decode_chunk, cfg=cfg, n_steps=self.chunk_steps,
+                    compute_dtype=compute_dtype)
         )
         self._compute_dtype = compute_dtype
         self._slots: list[Optional[Request]] = [None] * max_slots
@@ -336,6 +380,46 @@ class ContinuousBatcher:
         active = np.zeros((self.max_slots,), bool)
         for i, _ in active_reqs:
             active[i] = True
+
+        # Chunked greedy fast path: N tokens in one dispatch when every
+        # active request is greedy and nothing waits for admission (a
+        # queued request should not stall chunk_steps tokens).
+        with self._lock:
+            queue_empty = not self._queue
+        all_greedy = all(r.temperature <= 0.0 for _, r in active_reqs)
+        if self.chunk_steps > 1 and all_greedy and queue_empty:
+            toks_bn, self._cache = self._chunk(
+                self.params, jnp.asarray(self._last_tokens), self._cache,
+                jnp.asarray(active),
+            )
+            toks_host = np.asarray(toks_bn)  # [B, n] — one transfer
+            n = self.chunk_steps
+            deltas = np.zeros((self.max_slots,), np.int32)
+            with self._lock:
+                emitted = 0
+                for slot, req in active_reqs:
+                    if self._slots[slot] is not req:
+                        continue  # slot state changed; its length was set absolutely
+                    consumed = 0
+                    for t in toks_host[slot]:
+                        consumed += 1
+                        self._emit(req, slot, int(t))
+                        if req.status != "running":
+                            break
+                    # Rewind the overshoot ONLY for a still-running request:
+                    # a finished one had its slot length reset to 0 by _emit
+                    # (and any re-admission sets it absolutely) — subtracting
+                    # the delta there would drive the length negative.
+                    if req.status == "running":
+                        deltas[slot] = n - consumed
+                    emitted += consumed
+                self._tokens_out += emitted
+            if deltas.any():
+                # Rewind overshoot: per-row positions make this free — the
+                # rewound lanes are masked and later writes overwrite them.
+                self._cache = _rewind_lengths(self._cache, jnp.asarray(deltas))
+            return produced + emitted
+
         logits, self._cache = self._decode(
             self.params, jnp.asarray(self._last_tokens), self._cache,
             jnp.asarray(active),
@@ -421,6 +505,11 @@ def _insert_prefill(cache: SlotCache, c1: KVCache, slot, true_len):
         k=k, v=v,
         lengths=cache.lengths.at[slot].set(jnp.asarray(true_len, jnp.int32)),
     )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _rewind_lengths(cache: SlotCache, deltas):
+    return SlotCache(k=cache.k, v=cache.v, lengths=cache.lengths - deltas)
 
 
 @partial(jax.jit, donate_argnums=(0,))
