@@ -29,11 +29,20 @@ class ServingStartRequest(BaseModel):
     model_name: Optional[str] = None
     max_slots: int = Field(default=4, ge=1, le=64)
     max_len: int = Field(default=1024, ge=8)
-    # Greedy tokens per device dispatch (host round-trip amortisation);
-    # batches with sampled requests fall back to per-step automatically.
+    # Tokens per device dispatch (host round-trip amortisation) — greedy
+    # AND sampled requests ride the same chunked dispatch; a queued
+    # request waits at most this many tokens for admission.
     decode_chunk_steps: int = Field(default=8, ge=1, le=256)
+    # Prompt tokens ingested per dispatch (bounds the decode stall an
+    # admission can cause).
+    prefill_chunk: int = Field(default=256, ge=16)
     eos_id: Optional[int] = Field(default=None, ge=0)
     seed: int = 0
+    # model_name path only: serve sharded over a fresh mesh (tensor /
+    # fsdp axes). A job_id start inherits the JOB's mesh and sharded
+    # params automatically — multi-chip models serve as trained.
+    tensor_parallel: int = Field(default=1, ge=1)
+    fsdp: int = Field(default=1, ge=1)
 
 
 class ServingSubmitRequest(BaseModel):
@@ -70,6 +79,7 @@ async def start_server(request: web.Request) -> web.Response:
         from tpu_engine.models import transformer as tfm
         from tpu_engine.serving import ContinuousBatcher
 
+        mesh = None
         if req.job_id is not None:
             job = state.launcher.get_job(req.job_id)
             if job is None:
@@ -80,7 +90,11 @@ async def start_server(request: web.Request) -> web.Response:
             # Decode-safe snapshot: the train step DONATES the live param
             # buffers each step, and a LoRA job's servable weights are the
             # merged tree — both handled by the supervisor's snapshot.
+            # The snapshot keeps the job's TP/FSDP shardings, so serving
+            # inherits the job's mesh — models too large for one chip
+            # serve exactly as they trained.
             params = job._params_snapshot()
+            mesh = job.program.mesh
         else:
             cfg = tfm.MODEL_CONFIGS.get(req.model_name)
             if cfg is None:
@@ -90,6 +104,23 @@ async def start_server(request: web.Request) -> web.Response:
                     f"{sorted(tfm.MODEL_CONFIGS)}",
                 )
             params = tfm.init_params(jax.random.PRNGKey(req.seed), cfg)
+            if req.tensor_parallel > 1 or req.fsdp > 1:
+                from tpu_engine.mesh_runtime import MeshConfig, build_mesh
+                from tpu_engine.models.transformer import logical_axes
+                from tpu_engine.sharding import (
+                    ShardingStage, named_shardings, param_pspecs,
+                )
+                try:
+                    mesh = build_mesh(MeshConfig(
+                        fsdp=req.fsdp, model=req.tensor_parallel,
+                    ))
+                except ValueError as e:
+                    raise ApiError(422, str(e))
+                params = jax.device_put(params, named_shardings(
+                    mesh,
+                    param_pspecs(logical_axes(cfg),
+                                 ShardingStage.FULL_PARTITIONING),
+                ))
         global _server, _stop, _thread
         with _lock:
             if _server is not None:
@@ -101,6 +132,7 @@ async def start_server(request: web.Request) -> web.Response:
                     params, cfg, max_slots=req.max_slots, max_len=req.max_len,
                     eos_id=req.eos_id, seed=req.seed,
                     chunk_steps=req.decode_chunk_steps,
+                    prefill_chunk=req.prefill_chunk, mesh=mesh,
                 )
             except ValueError as e:
                 raise ApiError(422, str(e))
@@ -110,12 +142,12 @@ async def start_server(request: web.Request) -> web.Response:
                 name="serving-loop",
             )
             _thread.start()
-        return cfg.name
+        return cfg.name, mesh is not None
 
-    name = await asyncio.to_thread(_start)
+    name, sharded = await asyncio.to_thread(_start)
     return json_response({
         "started": True, "model": name, "max_slots": req.max_slots,
-        "max_len": req.max_len,
+        "max_len": req.max_len, "sharded": sharded,
     })
 
 

@@ -752,3 +752,63 @@ def test_serving_lifecycle_over_http(client):
     finally:
         assert client.post("/api/v1/serving/stop").json()["stopped"]
     assert client.post("/api/v1/serving/stop").status_code == 404
+
+
+def test_serving_from_sharded_trained_job(client):
+    """Round-4 headline over HTTP: train on an fsdp×tp mesh, then serve
+    from the job_id — the batcher inherits the job's mesh and TP/FSDP
+    param shardings, and streams match the job's own generate endpoint
+    (which decodes the same trained weights)."""
+    r = client.post(
+        "/api/v1/training/launch",
+        json={
+            "model_name": "gpt-tiny",
+            "mesh": {"fsdp": 2, "model": 4},
+            "micro_batch_size": 2,
+            "seq_len": 32,
+            "precision": "fp32",
+            "total_steps": 2,
+            "activation_checkpointing": False,
+            "warmup_steps": 1,
+            "dry_run": False,
+        },
+    )
+    assert r.status_code == 200, r.text
+    job_id = r.json()["job_id"]
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        if client.get(f"/api/v1/training/jobs/{job_id}").json()["status"] in (
+            "completed", "failed",
+        ):
+            break
+        time.sleep(1)
+    assert client.get(
+        f"/api/v1/training/jobs/{job_id}"
+    ).json()["status"] == "completed"
+
+    prompt = [5, 6, 7, 8]
+    ref = client.post(
+        f"/api/v1/training/jobs/{job_id}/generate",
+        json={"prompt_tokens": [prompt], "max_new_tokens": 6},
+    ).json()["new_tokens"][0]
+
+    r = client.post("/api/v1/serving/start",
+                    json={"job_id": job_id, "max_slots": 2, "max_len": 64})
+    assert r.status_code == 200, r.text
+    assert r.json()["sharded"] is True
+    try:
+        rid = client.post(
+            "/api/v1/serving/submit",
+            json={"prompt": prompt, "max_new_tokens": 6},
+        ).json()["request_id"]
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            body = client.get(f"/api/v1/serving/result/{rid}").json()
+            if body["status"] in ("done", "failed"):
+                break
+            time.sleep(0.2)
+        assert body["status"] == "done", body
+        assert body["tokens"] == ref
+        assert client.get("/api/v1/serving/stats").json()["sharded"] is True
+    finally:
+        client.post("/api/v1/serving/stop")

@@ -312,6 +312,29 @@ def test_sharded_stream_prefetch_matches_sync(token_file):
     b.close()
 
 
+def test_sharded_stream_dead_producer_reraises_not_deadlocks(token_file):
+    """A producer-thread failure must surface on EVERY subsequent next()
+    call (round-3 advisor: after the first raise the producer has exited,
+    so a retry loop would block forever on the empty queue)."""
+    from tpu_engine.data import _ShardedTokenStream
+
+    ds = TokenFileDataset(token_file, seq_len=64)
+    s = _ShardedTokenStream(ds, 1, 4, 0, 2, seed=3, prefetch=True)
+    assert s.next().shape == (1, 2, 64)
+
+    def boom(indices):
+        raise OSError("disk gone")
+
+    ds.read_batch = boom
+    with pytest.raises(OSError, match="disk gone"):
+        for _ in range(4):  # drain the one prefetched slab, then hit the error
+            s.next()
+    # Producer is dead now; next() must re-raise immediately, not block.
+    with pytest.raises(OSError, match="disk gone"):
+        s.next()
+    s.close()
+
+
 def test_make_data_fn_rejects_indivisible_process_count(token_file):
     from tpu_engine.mesh_runtime import MeshConfig
     from tpu_engine.sharding import ShardingStage, TPUTrainConfig

@@ -331,6 +331,7 @@ class _ShardedTokenStream:
         self._r0, self._rows = row_start, row_count
         self._walk = _PermWalk(n, seed, shuffle)
         self._queue: Any = None
+        self._dead: Optional[Exception] = None
         if prefetch:
             import queue as _queue
 
@@ -372,8 +373,14 @@ class _ShardedTokenStream:
         """This process's [accum, rows, seq] slab for the next step."""
         if self._queue is None:
             return self._read_local()
+        if self._dead is not None:
+            # The producer delivered an exception and exited; re-raise on
+            # every later call instead of blocking forever on an empty
+            # queue (a retry loop around data_fn would otherwise deadlock).
+            raise self._dead
         item = self._queue.get()
         if isinstance(item, Exception):
+            self._dead = item
             raise item
         return item
 

@@ -9,19 +9,34 @@ requests and short prompts are not held hostage by long ones.
 
 TPU-first design:
 
-- **Static shapes everywhere.** The KV pool is ``[L, slots, max_len, KV,
-  HD]`` for the server's lifetime; one jitted decode step advances ALL
-  slots one token per call (empty/finished lanes compute masked garbage —
-  wasted lanes, never a recompile).
+- **Static shapes everywhere.** The KV pool is ``[L, slots, S, KV, HD]``
+  for the server's lifetime; one jitted dispatch advances ALL slots
+  ``chunk_steps`` tokens per call (empty/finished lanes compute masked
+  garbage — wasted lanes, never a recompile).
 - **Per-row positions.** Unlike :class:`generate.KVCache` (whose scalar
   ``length`` advances every row in lockstep), each slot carries its own
-  length; K/V writes are per-row scatters (``.at[arange(B), lengths]``)
-  and the attention mask is ``key_pos <= length_b``.
-- **Prefill by reuse.** An admitted prompt runs through the existing
-  single-row :func:`generate.forward_with_cache` (padded up to a bucket
-  multiple so prompt-length recompiles are bounded) and its K/V rows are
-  copied into the slot — zero new model code on the prefill path, every
-  architecture family the decode block supports works here too.
+  length; K/V writes are per-row scatters (``.at[arange(B), lane]``) and
+  the attention mask is position-based. Sliding-window models get a
+  per-row RING pool (``S = window + prefill_chunk - 1`` lanes, writes at
+  ``position % S``) — O(window) serving memory, same as the single-row
+  ring cache in :mod:`tpu_engine.generate`.
+- **Sampling inside the dispatch.** Greedy AND temperature>0 requests
+  advance in the same chunked scan: each slot carries its temperature and
+  a folded per-(request, step) key, so a loaded server with mixed
+  sampling never drops to one-token-per-dispatch. Streams are
+  deterministic for a given ``seed`` and independent of batch
+  composition.
+- **Chunked prefill.** Prompts are ingested ``prefill_chunk`` tokens per
+  dispatch, interleaved with decode — an admission burst stalls running
+  slots by at most ONE prefill-chunk dispatch per step, not one full
+  prompt per admitted request (head-of-line fix, round-3 verdict).
+- **Mesh-sharded serving.** Pass ``mesh=`` to serve models larger than a
+  chip: params stay TP/FSDP-sharded exactly as the training job left
+  them, the KV pool shards its kv-heads dim over the ``model`` axis, and
+  every dispatch is jitted with explicit out-shardings + donation so the
+  pool never round-trips. The ``job_id`` start path in
+  ``backend/routers/serving.py`` wires a live supervised job's mesh and
+  sharded snapshot straight in.
 
 The host-side :class:`ContinuousBatcher` is thread-safe: ``submit`` from
 any thread, drive ``step`` from a serving loop (or ``serve_forever`` in a
@@ -30,6 +45,7 @@ background thread).
 
 from __future__ import annotations
 
+import collections
 import itertools
 import threading
 import time
@@ -41,6 +57,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_engine.generate import (
     KVCache,
@@ -59,26 +76,47 @@ from tpu_engine.models.transformer import (
 @jax.tree_util.register_dataclass
 @dataclass
 class SlotCache:
-    """Per-slot KV pool with INDEPENDENT row positions."""
+    """Per-slot KV pool with INDEPENDENT row positions.
+
+    ``lengths[b]`` is slot b's global position count (prompt + generated).
+    Non-ring pools identify lane m with position m (``pos`` is None);
+    ring pools (sliding-window models with fewer lanes than ``max_len``)
+    write position p into lane ``p % S`` and track the stored position per
+    lane in ``pos`` [B, S] (-1 = empty), mirroring the single-row ring
+    cache of :class:`tpu_engine.generate.KVCache`.
+    """
 
     k: jax.Array        # [L, B, S, KV, HD]
     v: jax.Array
     lengths: jax.Array  # [B] int32 — resident tokens per slot (0 = empty)
+    pos: Optional[jax.Array] = None  # [B, S] int32, ring pools only
+    ring: bool = field(default=False, metadata=dict(static=True))
+
+    @property
+    def n_lanes(self) -> int:
+        return self.k.shape[2]
 
 
 def init_slot_cache(
-    cfg: ModelConfig, slots: int, max_len: int, dtype=jnp.bfloat16
+    cfg: ModelConfig, slots: int, max_len: int, dtype=jnp.bfloat16,
+    prefill_chunk: Optional[int] = None,
 ) -> SlotCache:
+    """Allocate the serving pool. For sliding-window models the pool is a
+    per-row ring of ``window + prefill_chunk - 1`` lanes (a prefill chunk
+    of T tokens needs the window behind its oldest token resident) — the
+    slot-pool analogue of :func:`generate.init_cache`'s ring mode."""
+    lanes = max_len
     if cfg.sliding_window:
-        raise ValueError(
-            "continuous batching does not support sliding-window models yet "
-            "(per-row ring caches); serve with generate() per request"
-        )
-    shape = (cfg.n_layers, slots, max_len, cfg.n_kv_heads, cfg.head_dim)
+        chunk = max_len if prefill_chunk is None else prefill_chunk
+        lanes = min(max_len, cfg.sliding_window + chunk - 1)
+    ring = lanes < max_len
+    shape = (cfg.n_layers, slots, lanes, cfg.n_kv_heads, cfg.head_dim)
     return SlotCache(
         k=jnp.zeros(shape, dtype),
         v=jnp.zeros(shape, dtype),
         lengths=jnp.zeros((slots,), jnp.int32),
+        pos=jnp.full((slots, lanes), -1, jnp.int32) if ring else None,
+        ring=ring,
     )
 
 
@@ -94,29 +132,43 @@ def decode_step(
 
     Reuses the stock per-layer decode block (``generate._decode_block``):
     the slot pool is just the per-row-positions instantiation of its
-    ``write`` callback (row scatter at each slot's own length) and its
-    rank-2 ``slot_pos`` (slot m holds global position m; visibility is
-    ``m <= length_b``). Every architecture family the block supports is
+    ``write`` callback (row scatter at each slot's own lane) and its
+    rank-2 ``slot_pos``. Every architecture family the block supports is
     therefore served here with zero forked model code. Inactive rows still
     compute (static shapes) but their lengths do not advance and their
-    writes land in lanes the mask never exposes.
+    writes land in lanes the mask never exposes (for ring pools the
+    overwritten lane held a position already outside the window, and its
+    ``pos`` entry is not updated, so the garbage stays invisible).
     """
     B = tokens.shape[0]
-    S = cache.k.shape[2]
+    S = cache.n_lanes
     rows = jnp.arange(B)
     positions = cache.lengths[:, None]                      # [B, 1]
     x = embed_tokens(params, tokens[:, None], compute_dtype,
                      positions=positions, cfg=cfg)          # [B, 1, D]
     layer_stack = cast_layer_stack(params, compute_dtype)
 
-    # Slot m of row b holds global position m; positions past the row's
-    # length are not yet written → mark them "future" so the causal mask
-    # (m <= length_b) hides them.
-    slot_pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+    if cache.ring:
+        lane = cache.lengths % S
+        # Mark the written lane with its new position — ACTIVE rows only:
+        # an inactive row's garbage write must stay invisible.
+        pos_new = cache.pos.at[rows, lane].set(
+            jnp.where(active, cache.lengths, cache.pos[rows, lane])
+        )
+        slot_pos = pos_new                                   # [B, S]
+    else:
+        lane = cache.lengths
+        pos_new = None
+        # Lane m holds global position m; positions past the row's length
+        # are not yet written → the causal mask (m <= length_b) hides them.
+        slot_pos = jnp.broadcast_to(
+            jnp.arange(S, dtype=jnp.int32)[None, :], (B, S)
+        )
 
     def write(cache_arr, new_rows):
-        # Per-row scatter at each slot's own position (T = 1).
-        return cache_arr.at[rows, cache.lengths].set(
+        # Per-row scatter at each slot's own lane (T = 1). Out-of-bounds
+        # lanes (a finished-mid-chunk row running past capacity) drop.
+        return cache_arr.at[rows, lane].set(
             new_rows[:, 0].astype(cache_arr.dtype)
         )
 
@@ -132,8 +184,31 @@ def decode_step(
     new_cache = SlotCache(
         k=k_new, v=v_new,
         lengths=cache.lengths + active.astype(jnp.int32),
+        pos=pos_new, ring=cache.ring,
     )
     return logits, new_cache
+
+
+def _pick_tokens(
+    logits: jax.Array,      # [B, V] fp32
+    temps: jax.Array,       # [B] f32 — 0 = greedy
+    req_ids: jax.Array,     # [B] int32
+    counts: jax.Array,      # [B] int32 — tokens already drawn per request
+    base_key: jax.Array,
+) -> jax.Array:
+    """Per-slot sampling INSIDE the dispatch. Greedy rows take argmax;
+    temperature>0 rows draw categorically with a key folded from
+    (request id, draw count) — the stream for a request is deterministic
+    for a given server ``seed`` and independent of which other requests
+    share the batch or when they were admitted."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def draw(rid, cnt, lg, t):
+        key = jax.random.fold_in(jax.random.fold_in(base_key, rid), cnt)
+        return jax.random.categorical(key, lg / jnp.maximum(t, 1e-6))
+
+    sampled = jax.vmap(draw)(req_ids, counts, logits, temps).astype(jnp.int32)
+    return jnp.where(temps > 0.0, sampled, greedy)
 
 
 def decode_chunk(
@@ -141,34 +216,40 @@ def decode_chunk(
     tokens: jax.Array,      # [B] int32 — last token per slot
     cache: SlotCache,
     active: jax.Array,      # [B] bool
+    temps: jax.Array,       # [B] f32
+    req_ids: jax.Array,     # [B] int32
+    counts: jax.Array,      # [B] int32
+    base_key: jax.Array,
     cfg: ModelConfig,
     n_steps: int,
     compute_dtype=jnp.bfloat16,
 ) -> tuple[jax.Array, SlotCache]:
-    """``n_steps`` greedy tokens per active slot in ONE dispatch.
+    """``n_steps`` tokens per active slot in ONE dispatch (greedy and
+    sampled alike — see :func:`_pick_tokens`).
 
-    The host drives :func:`decode_step` one token at a time — fine on-chip,
-    but each step pays a host→device round trip (expensive through remote
-    runtimes). This scans the same step with argmax feedback, so a chunk of
-    N tokens costs one dispatch + one [B, N] transfer. The host trims
-    per-request overshoot (a request hitting eos or max_new_tokens
-    mid-chunk) and REWINDS its slot length — per-row positions make the
-    rewind free: lanes past the length are masked and later writes
-    overwrite them.
-
-    Greedy only: the feedback token inside the scan is ``argmax``; batches
-    containing sampled (temperature > 0) requests take the per-step path.
+    The host drives :func:`decode_step` one token at a time — fine
+    on-chip, but each step pays a host→device round trip (expensive
+    through remote runtimes). This scans the same step with in-scan token
+    feedback, so a chunk of N tokens costs one dispatch + one [B, N]
+    transfer. The host trims per-request overshoot (a request hitting eos
+    or max_new_tokens mid-chunk): the finished slot is simply reset, so
+    its overshoot lanes are masked and later admissions overwrite them.
+    A queued request waits at most ``n_steps`` tokens for the next
+    admission window — the chunk no longer disengages under load.
     """
 
     def one(carry, _):
-        toks, cache = carry
+        toks, cnts, cache = carry
         logits, cache = decode_step(params, toks, cache, active, cfg,
                                     compute_dtype)
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = _pick_tokens(logits, temps, req_ids, cnts, base_key)
         toks = jnp.where(active, nxt, toks)
-        return (toks, cache), nxt
+        cnts = cnts + active.astype(jnp.int32)
+        return (toks, cnts, cache), nxt
 
-    (_, cache), out = lax.scan(one, (tokens, cache), None, length=n_steps)
+    (_, _, cache), out = lax.scan(
+        one, (tokens, counts, cache), None, length=n_steps
+    )
     return out.T, cache  # [B, n_steps]
 
 
@@ -185,16 +266,39 @@ class Request:
     tokens: list[int] = field(default_factory=list)
     slot: Optional[int] = None
     submitted_at: float = field(default_factory=time.time)
+    first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
 
 
+@dataclass
+class _PrefillState:
+    """A prompt mid-ingestion: ``consumed`` of ``padded`` tokens are in
+    ``c1`` (single-row cache); advanced one bounded chunk per engine step
+    so running slots never stall behind a whole long prompt."""
+
+    req: Request
+    slot: int
+    c1: KVCache
+    toks: np.ndarray    # [1, padded] int32 — prompt, zero-padded
+    consumed: int = 0
+
+    @property
+    def padded(self) -> int:
+        return self.toks.shape[1]
+
+
 class ContinuousBatcher:
-    """Slot-pool batcher over :func:`decode_step`.
+    """Slot-pool batcher over :func:`decode_chunk`.
 
     ``submit`` is thread-safe; ``step`` admits queued prompts into free
-    slots (prefill) and advances every active slot one token. Greedy when
-    ``temperature == 0``; otherwise softmax sampling with a per-(request,
-    step) folded key, so results are reproducible for a given ``seed``.
+    slots (one bounded prefill chunk per step), then advances every active
+    slot ``chunk_steps`` tokens in one dispatch — greedy or sampled.
+    Streams are reproducible for a given ``seed``.
+
+    ``mesh`` (optional) serves models larger than one chip: pass the
+    training job's mesh and its sharded params; the KV pool shards
+    kv-heads over the ``model`` axis and all dispatches pin their
+    out-shardings (donated, so the pool never copies).
     """
 
     def __init__(
@@ -208,6 +312,9 @@ class ContinuousBatcher:
         seed: int = 0,
         prefill_pad_to: int = 64,
         chunk_steps: int = 1,
+        prefill_chunk: int = 256,
+        mesh: Optional[Mesh] = None,
+        stats_window_s: float = 30.0,
     ):
         self.params = params
         self.cfg = cfg
@@ -216,23 +323,69 @@ class ContinuousBatcher:
         self.eos_id = eos_id
         self.seed = seed
         self.prefill_pad_to = int(prefill_pad_to)
-        self._cache = init_slot_cache(cfg, max_slots, max_len, compute_dtype)
-        self._decode = jax.jit(
-            partial(decode_step, cfg=cfg, compute_dtype=compute_dtype)
+        # Prefill ingestion quantum: one chunk per engine step (bounded
+        # decode stall). Round to the pad bucket so chunk shapes stay few.
+        self.prefill_chunk = max(
+            -(-int(prefill_chunk) // self.prefill_pad_to) * self.prefill_pad_to,
+            self.prefill_pad_to,
         )
-        # Chunked greedy decode: N tokens per dispatch (host round-trip
-        # amortisation — see decode_chunk). 1 = always per-step.
         self.chunk_steps = max(int(chunk_steps), 1)
-        self._chunk = jax.jit(
-            partial(decode_chunk, cfg=cfg, n_steps=self.chunk_steps,
-                    compute_dtype=compute_dtype)
-        )
+        self.mesh = mesh
         self._compute_dtype = compute_dtype
-        self._slots: list[Optional[Request]] = [None] * max_slots
-        self._last_tokens = np.zeros((max_slots,), np.int32)
+        self._cache = init_slot_cache(
+            cfg, self.max_slots, self.max_len, compute_dtype,
+            prefill_chunk=self.prefill_chunk,
+        )
+        self._base_key = jax.random.PRNGKey(seed)
+
+        # -- sharding surface (mesh-sharded serving) ------------------------
+        rep = kv_sh = None
+        if mesh is not None:
+            rep = NamedSharding(mesh, P())
+            model_ax = None
+            if "model" in mesh.axis_names and \
+                    cfg.n_kv_heads % mesh.shape["model"] == 0:
+                model_ax = "model"
+            kv_sh = NamedSharding(mesh, P(None, None, None, model_ax, None))
+            cache_sh = SlotCache(
+                k=kv_sh, v=kv_sh, lengths=rep,
+                pos=rep if self._cache.ring else None, ring=self._cache.ring,
+            )
+            self._cache = jax.device_put(self._cache, cache_sh)
+            self._base_key = jax.device_put(self._base_key, rep)
+            self._cache_sh, self._rep, self._kv_sh = cache_sh, rep, kv_sh
+        else:
+            self._cache_sh = self._rep = self._kv_sh = None
+
+        self._decode = jax.jit(
+            partial(decode_chunk, cfg=cfg, n_steps=self.chunk_steps,
+                    compute_dtype=compute_dtype),
+            donate_argnums=(2,),  # the pool: alias, never copy (2x HBM)
+            out_shardings=None if mesh is None else (self._rep, self._cache_sh),
+        )
+        self._prefill_fn = jax.jit(
+            partial(_prefill_forward, cfg=cfg, compute_dtype=compute_dtype),
+            donate_argnums=(2,),
+        )
+        # NOTE: c1 (arg 1) is dead after the insert but NOT donated — its
+        # [L, 1, M, ...] buffers can never alias the [L, slots, S, ...]
+        # pool, so donation would only emit "unusable donation" warnings.
+        self._insert = jax.jit(
+            _insert_prefill, donate_argnums=(0,), static_argnums=(4,),
+            out_shardings=None if mesh is None else self._cache_sh,
+        )
+        self._reset = jax.jit(
+            _reset_slot, donate_argnums=(0,),
+            out_shardings=None if mesh is None else self._cache_sh,
+        )
+
+        self._slots: list[Optional[Request]] = [None] * self.max_slots
+        self._last_tokens = np.zeros((self.max_slots,), np.int32)
         self._queue: list[Request] = []
         self._requests: dict[int, Request] = {}
         self._ids = itertools.count()
+        self._prefilling: "collections.OrderedDict[int, _PrefillState]" = \
+            collections.OrderedDict()
         self._pending_first_logits: dict[int, np.ndarray] = {}
         if cfg.arch == "gpt2" and max_len > cfg.max_seq_len:
             raise ValueError(
@@ -243,12 +396,16 @@ class ContinuousBatcher:
         self._done = threading.Condition(self._lock)
         self._tokens_out = 0
         self._started = time.time()
+        self._stats_window_s = float(stats_window_s)
+        self._recent: collections.deque[tuple[float, int]] = collections.deque()
         self.last_error: Optional[str] = None
 
     # -- client side ---------------------------------------------------------
 
     def submit(self, prompt: list[int], max_new_tokens: int = 64,
                temperature: float = 0.0) -> int:
+        if self.last_error is not None:
+            raise RuntimeError(f"serving loop failed: {self.last_error}")
         if not prompt:
             raise ValueError("empty prompt")
         if len(prompt) + max_new_tokens > self.max_len:
@@ -260,22 +417,34 @@ class ContinuousBatcher:
                       max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature))
         with self._lock:
+            # Re-check under the lock: the failure handler drains the queue
+            # holding it, so a submit racing the shutdown cannot strand a
+            # request in "queued" with no engine thread left to serve it.
+            if self.last_error is not None:
+                raise RuntimeError(f"serving loop failed: {self.last_error}")
             self._requests[req.id] = req
             self._queue.append(req)
         return req.id
+
+    def _result_locked(self, req: Request) -> dict[str, Any]:
+        out = {
+            "id": req.id, "status": req.status, "tokens": list(req.tokens),
+            "prompt_len": len(req.prompt),
+        }
+        if req.first_token_at is not None:
+            out["ttft_ms"] = round(
+                (req.first_token_at - req.submitted_at) * 1e3, 2
+            )
+        if req.error:
+            out["error"] = req.error
+        return out
 
     def result(self, req_id: int) -> dict[str, Any]:
         with self._lock:
             req = self._requests.get(req_id)
             if req is None:
                 raise KeyError(req_id)
-            out = {
-                "id": req.id, "status": req.status, "tokens": list(req.tokens),
-                "prompt_len": len(req.prompt),
-            }
-            if req.error:
-                out["error"] = req.error
-            return out
+            return self._result_locked(req)
 
     def wait(self, req_id: int, timeout: float = 60.0) -> dict[str, Any]:
         deadline = time.time() + timeout
@@ -285,14 +454,7 @@ class ContinuousBatcher:
                 if req is None:
                     raise KeyError(req_id)
                 if req.status in ("done", "failed"):
-                    out = {
-                        "id": req.id, "status": req.status,
-                        "tokens": list(req.tokens),
-                        "prompt_len": len(req.prompt),
-                    }
-                    if req.error:
-                        out["error"] = req.error
-                    return out
+                    return self._result_locked(req)
                 remaining = deadline - time.time()
                 if remaining <= 0:
                     raise TimeoutError(f"request {req_id} not done in {timeout}s")
@@ -300,48 +462,88 @@ class ContinuousBatcher:
 
     def stats(self) -> dict[str, Any]:
         with self._lock:
+            now = time.time()
+            while self._recent and now - self._recent[0][0] > self._stats_window_s:
+                self._recent.popleft()
+            recent_tokens = sum(n for _, n in self._recent)
+            window = min(max(now - self._started, 1e-9), self._stats_window_s)
             active = sum(1 for s in self._slots if s is not None)
-            dt = max(time.time() - self._started, 1e-9)
+            dt = max(now - self._started, 1e-9)
             return {
                 "slots": self.max_slots,
                 "active_slots": active,
+                "prefilling": len(self._prefilling),
                 "queued": len(self._queue),
                 "requests_total": len(self._requests),
                 "tokens_generated": self._tokens_out,
+                "tokens_per_sec_recent": round(recent_tokens / window, 2),
                 "tokens_per_sec_lifetime": round(self._tokens_out / dt, 2),
+                "chunk_steps": self.chunk_steps,
+                "sharded": self.mesh is not None,
             }
 
     # -- engine side ---------------------------------------------------------
 
-    def _prefill(self, req: Request, slot: int) -> None:
-        """Run the prompt through the stock single-row cache forward and
-        copy its K/V into the slot. Prompts pad up to ``prefill_pad_to``
-        multiples so the number of distinct compiled prefill shapes is
-        bounded; padded positions are never exposed (mask is per-row
-        length) and the first decode overwrites the first pad lane."""
-        P = len(req.prompt)
-        pad = -(-P // self.prefill_pad_to) * self.prefill_pad_to
-        pad = min(pad, self.max_len)
+    def _begin_prefill(self, req: Request, slot: int) -> _PrefillState:
+        """Allocate the single-row ingestion cache. Prompts pad up to
+        ``prefill_pad_to`` multiples (bounded compiled final-chunk shapes);
+        padded positions are never exposed (mask is per-row length) and
+        decode overwrites the first pad lane before it can be seen."""
+        P_len = len(req.prompt)
+        pad = min(-(-P_len // self.prefill_pad_to) * self.prefill_pad_to,
+                  self.max_len)
         toks = np.zeros((1, pad), np.int32)
-        toks[0, :P] = req.prompt
-        c1 = init_cache(self.cfg, 1, pad, dtype=self._compute_dtype)
-        logits, c1 = forward_with_cache(
-            self.params, jnp.asarray(toks), c1, self.cfg,
-            compute_dtype=self._compute_dtype,
+        toks[0, :P_len] = req.prompt
+        if self._cache.ring:
+            # Ring pools need lane-aligned ingestion: the c1 ring must have
+            # exactly the pool's lane count so positions map to the same
+            # lanes (both write at position % S).
+            c1 = init_cache(self.cfg, 1, self.max_len, dtype=self._compute_dtype,
+                            max_chunk=self.prefill_chunk)
+        else:
+            # Bucket the cache size to prefill_chunk multiples so compiled
+            # (chunk_shape, cache_shape) pairs stay few.
+            M = min(-(-pad // self.prefill_chunk) * self.prefill_chunk,
+                    self.max_len)
+            M = max(M, pad)
+            c1 = init_cache(self.cfg, 1, M, dtype=self._compute_dtype)
+        if self._kv_sh is not None:
+            c1_sh = KVCache(k=self._kv_sh, v=self._kv_sh, pos=self._rep,
+                            length=self._rep, ring=c1.ring)
+            c1 = jax.device_put(c1, c1_sh)
+        return _PrefillState(req=req, slot=slot, c1=c1, toks=toks)
+
+    def _advance_prefill(self, st: _PrefillState) -> bool:
+        """Ingest ONE bounded chunk; True when the prompt is fully in and
+        its K/V rows have been copied into the slot."""
+        t0 = st.consumed
+        t1 = min(t0 + self.prefill_chunk, st.padded)
+        chunk = jnp.asarray(st.toks[:, t0:t1])
+        P_len = len(st.req.prompt)
+        # Logits row of the last REAL prompt token (it seeds the first
+        # sampled/greedy token) — only meaningful in its chunk.
+        row = min(max(P_len - 1 - t0, 0), t1 - t0 - 1)
+        last_row, st.c1 = self._prefill_fn(
+            self.params, chunk, st.c1, jnp.asarray(row, jnp.int32)
         )
-        self._cache = _insert_prefill(self._cache, c1, slot, P)
-        # Next-token input = last REAL prompt token; its logits row P-1
-        # seeds sampling on the first decode step for this slot.
-        self._pending_first_logits[slot] = np.asarray(logits[0, P - 1])
-        self._last_tokens[slot] = req.prompt[-1]
+        st.consumed = t1
+        if t0 <= P_len - 1 < t1:
+            self._pending_first_logits[st.slot] = np.asarray(last_row)
+        if st.consumed < st.padded:
+            return False
+        self._cache = self._insert(self._cache, st.c1, jnp.asarray(st.slot),
+                                   jnp.asarray(P_len, jnp.int32),
+                                   self._cache.ring)
+        self._last_tokens[st.slot] = st.req.prompt[-1]
+        return True
 
     def step(self) -> int:
-        """Admit queued requests, advance active slots one token.
-        Returns the number of tokens produced this call.
+        """Admit queued requests (one prefill chunk per call), advance
+        active slots ``chunk_steps`` tokens. Returns tokens produced.
 
         Locking: the lock guards only host bookkeeping (admission decisions
         and result emission). Prefill, the jitted decode dispatch, and the
-        logits device→host sync — the long operations — run WITHOUT it, so
+        token device→host sync — the long operations — run WITHOUT it, so
         ``submit``/``result``/``stats`` from serving threads never wait on
         device work. The engine thread is the sole mutator of the KV pool
         and slot arrays, so they need no lock at all."""
@@ -354,103 +556,95 @@ class ContinuousBatcher:
                     req.status, req.slot = "running", slot
                     self._slots[slot] = req
                     admitted.append((slot, req))
-            active_reqs = [(i, r) for i, r in enumerate(self._slots) if r]
-        for slot, req in admitted:  # device work: outside the lock
-            self._prefill(req, slot)
-        if not active_reqs:
-            return 0
+        for slot, req in admitted:  # host-side alloc only — cheap
+            self._prefilling[slot] = self._begin_prefill(req, slot)
+
+        # ---- ONE prefill chunk per step (bounded decode stall) ----
+        if self._prefilling:
+            slot, st = next(iter(self._prefilling.items()))
+            if st.req.status != "running":
+                self._prefilling.pop(slot)  # cancelled/failed meanwhile
+            elif self._advance_prefill(st):
+                self._prefilling.pop(slot)
 
         # ---- first token for freshly-prefilled slots comes from the
-        # prefill logits; everyone else decodes one step ----
+        # prefill logits; everyone else decodes a chunk. (A slot with
+        # pending first logits is never still prefilling: the logits row
+        # is captured in the final chunk, which also completes the
+        # ingestion in the same _advance_prefill call.) ----
         produced = 0
-        fresh = dict(self._pending_first_logits)
-        self._pending_first_logits.clear()
+        fresh = self._pending_first_logits
+        self._pending_first_logits = {}
         with self._lock:
             for slot, logits in fresh.items():
                 req = self._slots[slot]
                 if req is None:
                     continue
-                tok = self._sample(logits, req)
+                tok = self._first_token(logits, req)
                 self._emit(req, slot, tok)
                 produced += 1
-            active_reqs = [(i, r) for i, r in enumerate(self._slots) if r]
-            self._tokens_out += produced
+            self._note_tokens(produced)
+            active_reqs = [
+                (i, r) for i, r in enumerate(self._slots)
+                if r is not None and i not in self._prefilling
+            ]
         if not active_reqs:
             return produced
+
         active = np.zeros((self.max_slots,), bool)
-        for i, _ in active_reqs:
+        temps = np.zeros((self.max_slots,), np.float32)
+        req_ids = np.zeros((self.max_slots,), np.int32)
+        counts = np.zeros((self.max_slots,), np.int32)
+        for i, r in active_reqs:
             active[i] = True
+            temps[i] = r.temperature
+            req_ids[i] = r.id
+            counts[i] = len(r.tokens)
 
-        # Chunked greedy fast path: N tokens in one dispatch when every
-        # active request is greedy and nothing waits for admission (a
-        # queued request should not stall chunk_steps tokens).
-        with self._lock:
-            queue_empty = not self._queue
-        all_greedy = all(r.temperature <= 0.0 for _, r in active_reqs)
-        if self.chunk_steps > 1 and all_greedy and queue_empty:
-            toks_bn, self._cache = self._chunk(
-                self.params, jnp.asarray(self._last_tokens), self._cache,
-                jnp.asarray(active),
-            )
-            toks_host = np.asarray(toks_bn)  # [B, n] — one transfer
-            n = self.chunk_steps
-            deltas = np.zeros((self.max_slots,), np.int32)
-            with self._lock:
-                emitted = 0
-                for slot, req in active_reqs:
-                    if self._slots[slot] is not req:
-                        continue  # slot state changed; its length was set absolutely
-                    consumed = 0
-                    for t in toks_host[slot]:
-                        consumed += 1
-                        self._emit(req, slot, int(t))
-                        if req.status != "running":
-                            break
-                    # Rewind the overshoot ONLY for a still-running request:
-                    # a finished one had its slot length reset to 0 by _emit
-                    # (and any re-admission sets it absolutely) — subtracting
-                    # the delta there would drive the length negative.
-                    if req.status == "running":
-                        deltas[slot] = n - consumed
-                    emitted += consumed
-                self._tokens_out += emitted
-            if deltas.any():
-                # Rewind overshoot: per-row positions make this free — the
-                # rewound lanes are masked and later writes overwrite them.
-                self._cache = _rewind_lengths(self._cache, jnp.asarray(deltas))
-            return produced + emitted
-
-        logits, self._cache = self._decode(
+        toks_bn, self._cache = self._decode(
             self.params, jnp.asarray(self._last_tokens), self._cache,
-            jnp.asarray(active),
+            jnp.asarray(active), jnp.asarray(temps), jnp.asarray(req_ids),
+            jnp.asarray(counts), self._base_key,
         )
-        logits_host = np.asarray(logits)  # device sync: outside the lock
+        toks_host = np.asarray(toks_bn)  # [B, n] — one transfer
         with self._lock:
             emitted = 0
             for slot, req in active_reqs:
                 if self._slots[slot] is not req:
                     continue  # request state changed while we computed
-                tok = self._sample(logits_host[slot], req)
-                self._emit(req, slot, tok)
-                emitted += 1
-            self._tokens_out += emitted
+                for t in toks_host[slot]:
+                    self._emit(req, slot, int(t))
+                    emitted += 1
+                    if req.status != "running":
+                        break  # overshoot discarded; slot already reset
+            self._note_tokens(emitted)
         return produced + emitted
 
-    def _sample(self, logits: np.ndarray, req: Request) -> int:
+    def _note_tokens(self, n: int) -> None:
+        """Caller holds the lock."""
+        if n:
+            self._tokens_out += n
+            now = time.time()
+            self._recent.append((now, n))
+            while self._recent and now - self._recent[0][0] > self._stats_window_s:
+                self._recent.popleft()
+
+    def _first_token(self, logits: np.ndarray, req: Request) -> int:
+        """First token from the prefill logits — SAME key contract as the
+        in-dispatch draws (fold(fold(seed, id), 0)), so a request's stream
+        is one deterministic sequence regardless of where draws happen."""
         if req.temperature <= 0.0:
             return int(np.argmax(logits))
         key = jax.random.fold_in(
-            jax.random.fold_in(jax.random.PRNGKey(self.seed), req.id),
-            len(req.tokens),
+            jax.random.fold_in(self._base_key, req.id), 0
         )
-        probs = np.asarray(
-            jax.nn.softmax(jnp.asarray(logits) / req.temperature)
-        )
-        return int(np.random.default_rng(np.asarray(key)).choice(
-            len(probs), p=probs / probs.sum()
+        return int(jax.random.categorical(
+            key, jnp.asarray(logits) / req.temperature
         ))
 
     def _emit(self, req: Request, slot: int, tok: int) -> None:
+        if req.first_token_at is None:
+            req.first_token_at = time.time()
         req.tokens.append(tok)
         self._last_tokens[slot] = tok
         finished = (
@@ -462,20 +656,23 @@ class ContinuousBatcher:
             req.status = "done"
             req.finished_at = time.time()
             self._slots[slot] = None
-            # Free slot: zero its length so admission reuses it cleanly.
-            self._cache = _reset_slot(self._cache, slot)
+            # Free slot: zero its length (and ring positions) so admission
+            # reuses it cleanly; overshoot lanes from a mid-chunk finish
+            # become invisible the same instant.
+            self._cache = self._reset(self._cache, slot)
             self._done.notify_all()
 
     def serve_forever(self, stop: threading.Event, idle_sleep: float = 0.01):
         """Drive ``step`` until ``stop``. A step failure (e.g. a prefill
         compile OOM) marks every in-flight and queued request ``failed``
-        with the error recorded — never a silently dead thread with
-        requests stuck in ``running`` forever."""
+        with the error recorded, and later ``submit`` calls are rejected —
+        never a silently dead thread with requests stuck forever."""
         while not stop.is_set():
             try:
                 produced = self.step()
             except Exception as e:  # noqa: BLE001 — serving boundary
                 msg = f"{type(e).__name__}: {e}"
+                self.last_error = msg  # reject new submits first
                 with self._lock:
                     for req in list(self._slots) + list(self._queue):
                         if req is not None and req.status in ("queued", "running"):
@@ -483,37 +680,53 @@ class ContinuousBatcher:
                             req.finished_at = time.time()
                     self._slots = [None] * self.max_slots
                     self._queue.clear()
+                    self._prefilling.clear()
                     self._done.notify_all()
-                self.last_error = msg
                 return
-            if produced == 0:
+            # Sleep only when truly idle: a step that produced no token but
+            # advanced a prefill chunk (or left admissions waiting) must
+            # loop immediately — sleeping between every chunk of a long
+            # prompt would add ~idle_sleep × n_chunks to its TTFT.
+            if produced == 0 and not self._prefilling and not self._queue:
                 time.sleep(idle_sleep)
 
 
-@partial(jax.jit, donate_argnums=(0,))
-def _insert_prefill(cache: SlotCache, c1: KVCache, slot, true_len):
-    """Copy a single-row prefill cache's positions into ``slot`` and set
-    its length to the TRUE prompt length (padding lanes stay masked and
-    are overwritten as decoding proceeds)."""
+def _prefill_forward(params, toks, cache, row_idx, *, cfg, compute_dtype):
+    """One prefill chunk through the stock cached forward; returns only the
+    requested logits row (the [V] vector that seeds the first token) — on a
+    mesh this avoids all-gathering the full [T, V] logits per chunk."""
+    logits, cache = forward_with_cache(params, toks, cache, cfg,
+                                       compute_dtype=compute_dtype)
+    return logits[0, row_idx], cache
+
+
+def _insert_prefill(cache: SlotCache, c1: KVCache, slot, true_len, ring: bool):
+    """Copy a single-row prefill cache into ``slot`` and set its length to
+    the TRUE prompt length (padding lanes stay masked — causality for ring
+    pools, length for flat pools — and are overwritten as decoding
+    proceeds)."""
     k = lax.dynamic_update_slice(
         cache.k, c1.k.astype(cache.k.dtype), (0, slot, 0, 0, 0)
     )
     v = lax.dynamic_update_slice(
         cache.v, c1.v.astype(cache.v.dtype), (0, slot, 0, 0, 0)
     )
+    pos = cache.pos
+    if ring:
+        # Lane-aligned by construction (c1 ring size == pool lane count).
+        pos = lax.dynamic_update_slice(pos, c1.pos[None, :], (slot, 0))
     return SlotCache(
         k=k, v=v,
-        lengths=cache.lengths.at[slot].set(jnp.asarray(true_len, jnp.int32)),
+        lengths=cache.lengths.at[slot].set(true_len.astype(jnp.int32)),
+        pos=pos, ring=cache.ring,
     )
 
 
-@partial(jax.jit, donate_argnums=(0,))
-def _rewind_lengths(cache: SlotCache, deltas):
-    return SlotCache(k=cache.k, v=cache.v, lengths=cache.lengths - deltas)
-
-
-@partial(jax.jit, donate_argnums=(0,))
 def _reset_slot(cache: SlotCache, slot):
+    pos = cache.pos
+    if cache.ring:
+        pos = pos.at[slot].set(-1)
     return SlotCache(
-        k=cache.k, v=cache.v, lengths=cache.lengths.at[slot].set(0)
+        k=cache.k, v=cache.v, lengths=cache.lengths.at[slot].set(0),
+        pos=pos, ring=cache.ring,
     )
