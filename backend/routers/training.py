@@ -55,7 +55,10 @@ class TrainingLaunchRequest(BaseModel):
     param_offload: str = "none"
     grad_allreduce_dtype: Optional[str] = None
     attention_impl: Literal["auto", "xla", "flash", "ring", "ulysses"] = "auto"
-    pipeline_schedule: Literal["gpipe", "1f1b"] = "gpipe"
+    # "auto" resolves at build time: 1f1b when the microbatch count
+    # exceeds the pipe-stage count (where its O(P) activation residency
+    # pays), gpipe otherwise.
+    pipeline_schedule: Literal["auto", "gpipe", "1f1b"] = "auto"
     sliding_window: Optional[int] = Field(
         default=None, ge=0,
         description="sliding-window attention: None = model preset's window, "

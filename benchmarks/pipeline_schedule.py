@@ -1,17 +1,20 @@
-"""GPipe vs 1F1B pipeline schedules: memory and step-time A/B.
+"""GPipe vs 1F1B pipeline schedules: memory and per-sample throughput A/B.
 
-Two measurement planes (numbers in RESULTS.md):
+Two measurement planes (numbers in RESULTS.md §Pipeline):
 
 - ``--aot``: libtpu AOT compile of llama-7b (pipe=4, fsdp=4, v5e:4x4,
   seq 4096, flash, full remat) at growing microbatch counts;
   ``memory_analysis()`` reports the per-device temp memory each schedule
   actually needs. This is where 1F1B's O(P) in-flight activation bound
-  shows up against GPipe-by-autodiff's O(M + P) saved stage buffers.
-- ``--wall``: wall-clock per optimizer step on the 8-virtual-device CPU
-  mesh (gpt-tiny). In the masked-SPMD formulation the 1F1B warmup/drain
-  lanes burn compute rather than idling, so at equal M it is slightly
-  SLOWER — the schedule's value is spending the saved memory on more
-  microbatches (amortising the (P-1)/M bubble) or bigger ones.
+  shows up against GPipe-by-autodiff's O(M + P) saved stage buffers:
+  GPipe OOMs at M=16 where 1F1B keeps fitting through M=32.
+- ``--wall``: wall-clock PER SAMPLE on the 8-virtual-device CPU mesh at
+  growing M. The bubble is (P-1)/(M+P-1) of schedule ticks, so
+  per-sample time falls as M grows; GPipe's best *feasible* config on
+  memory-bound hardware is M=8 (the AOT plane), and 1F1B at M=16/32 —
+  configs GPipe cannot run — must beat it per sample. This is the
+  round-3 verdict's missing half of the 1F1B story: the schedule wins,
+  not just fits.
 
 Run: ``python benchmarks/pipeline_schedule.py --aot|--wall``
 """
@@ -26,47 +29,79 @@ import time
 def run_aot() -> None:
     from benchmarks.aot import aot_lowered
 
-    for M in (8, 16):
-        for sched in ("gpipe", "1f1b"):
-            t0 = time.time()
-            try:
-                comp = aot_lowered(
-                    "llama-7b", "v5e:4x4", dict(data=1, fsdp=4, pipe=4),
-                    micro=1, accum=M, seq=4096,
-                    overrides={
-                        "attention_impl": "flash",
-                        "pipeline_schedule": sched,
-                        "activation_checkpointing": True,
-                    },
-                ).compile()
-                ma = comp.memory_analysis()
-                print(json.dumps({
-                    "schedule": sched, "microbatches": M,
-                    "device_args_gib": round(ma.argument_size_in_bytes / 2**30, 2),
-                    "device_temp_gib": round(ma.temp_size_in_bytes / 2**30, 2),
-                    "compile_s": round(time.time() - t0, 1),
-                }))
-            except Exception as e:  # OOM is a *result* here, not a failure
-                print(json.dumps({
-                    "schedule": sched, "microbatches": M,
-                    "error": str(e)[:200],
-                }))
+    for sched, M in (("gpipe", 8), ("gpipe", 16), ("1f1b", 8),
+                     ("1f1b", 16), ("1f1b", 32)):
+        t0 = time.time()
+        try:
+            comp = aot_lowered(
+                "llama-7b", "v5e:4x4", dict(data=1, fsdp=4, pipe=4),
+                micro=1, accum=M, seq=4096,
+                overrides={
+                    "attention_impl": "flash",
+                    "pipeline_schedule": sched,
+                    "activation_checkpointing": True,
+                },
+            ).compile()
+            ma = comp.memory_analysis()
+            print(json.dumps({
+                "schedule": sched, "microbatches": M,
+                "device_args_gib": round(ma.argument_size_in_bytes / 2**30, 2),
+                "device_temp_gib": round(ma.temp_size_in_bytes / 2**30, 2),
+                "compile_s": round(time.time() - t0, 1),
+            }))
+        except Exception as e:  # OOM is a *result* here, not a failure
+            print(json.dumps({
+                "schedule": sched, "microbatches": M,
+                "error": str(e)[:200],
+            }))
 
 
 def run_wall() -> None:
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
     import jax
+
+    jax.config.update("jax_platforms", "cpu")  # 8-virtual-device CPU mesh
 
     from benchmarks.aot import build_program
 
-    for sched in ("gpipe", "1f1b"):
-        prog = build_program(
-            "gpt-tiny", dict(data=1, fsdp=2, model=2, pipe=2),
-            micro=2, accum=8, seq=128,
-            overrides={
-                "attention_impl": "xla", "pipeline_schedule": sched,
-                "activation_checkpointing": True,
-            },
-            devices=jax.devices()[:8],
+    from tpu_engine.mesh_runtime import MeshConfig, MeshRuntime
+    from tpu_engine.models import transformer as tfm
+    from tpu_engine.sharding import ShardingStage, TPUTrainConfig
+    from tpu_engine.train import build_train_program
+
+    # A compute-dominated config with a REAL bubble: 8 layers × 256-dim
+    # (2 layers/stage at pipe=4) so per-tick schedule overhead is small
+    # against the stage matmuls — at P=4, GPipe's memory-feasible M=8
+    # carries a (P-1)/(M+P-1) = 27% bubble that M=32 shrinks to 9%.
+    # (gpt-tiny at pipe=2 measures only per-tick overhead: the bubble
+    # swing is 6% while 1F1B's masked-lane overhead is ~13% — schedule
+    # arithmetic is invisible there. gpt-125m-class stages compile for
+    # tens of minutes on the CPU backend — too big for this plane.)
+    model_cfg = tfm.MODEL_CONFIGS["gpt-tiny"].with_(
+        name="gpt-mid-bench", d_model=256, n_heads=8, n_kv_heads=8,
+        d_ff=1024, n_layers=8, vocab_size=2048,
+    )
+    micro = 1
+    results = {}
+    for sched, M in (("gpipe", 8), ("gpipe", 16), ("1f1b", 8),
+                     ("1f1b", 16), ("1f1b", 32)):
+        cfg = TPUTrainConfig(
+            model_name="gpt-tiny",  # shape comes from model_cfg below
+            sharding_stage=ShardingStage.FULL_PARTITIONING,
+            mesh=MeshConfig(data=1, fsdp=2, pipe=4),
+            micro_batch_size=micro, gradient_accumulation_steps=M,
+            seq_len=256, attention_impl="xla", pipeline_schedule=sched,
+            activation_checkpointing=True,
+        )
+        prog = build_train_program(
+            cfg, model_cfg=model_cfg,
+            runtime=MeshRuntime(cfg.mesh, devices=jax.devices()[:8]),
         )
         state = prog.init(jax.random.PRNGKey(0))
         batch = prog.synthetic_batch(seed=0)
@@ -74,14 +109,31 @@ def run_wall() -> None:
             state, m = prog.step(state, batch)
         float(m["loss"])
         t0 = time.perf_counter()
-        n = 10
+        n = 3
         for _ in range(n):
             state, m = prog.step(state, batch)
         float(m["loss"])
+        step_ms = (time.perf_counter() - t0) / n * 1e3
+        per_sample = step_ms / (M * micro)
+        results[(sched, M)] = per_sample
         print(json.dumps({
-            "schedule": sched,
-            "step_ms": round((time.perf_counter() - t0) / n * 1e3, 1),
+            "schedule": sched, "microbatches": M,
+            "samples_per_step": M * micro,
+            "step_ms": round(step_ms, 1),
+            "per_sample_ms": round(per_sample, 2),
         }))
+    # The headline comparison: GPipe's best memory-feasible config on the
+    # AOT plane is M=8; 1F1B runs M=16/32 in the memory GPipe's M=16
+    # needs and per-sample time must come out ahead.
+    best_1f1b = min(results[("1f1b", 16)], results[("1f1b", 32)])
+    print(json.dumps({
+        "metric": "pipeline_1f1b_per_sample_vs_gpipe_feasible",
+        "gpipe_m8_per_sample_ms": round(results[("gpipe", 8)], 2),
+        "best_1f1b_per_sample_ms": round(best_1f1b, 2),
+        "value": round(results[("gpipe", 8)] / best_1f1b, 3),
+        "unit": "x_speedup_per_sample",
+        "wins": best_1f1b < results[("gpipe", 8)],
+    }))
 
 
 if __name__ == "__main__":

@@ -638,6 +638,21 @@ def test_prometheus_metrics_endpoint(client):
     assert r2.status_code == 200, r2.text
     body = client.get("/metrics").text
     assert 'tpu_engine_job_loss{job_id="ext-scrape-job",model="external"} 2.5' in body
+    # Serving plane: down by default; up with slot/throughput gauges once
+    # a server runs (round-4 hygiene: chunk depth + occupancy scrapeable).
+    assert "tpu_engine_serving_up 0" in body
+    r3 = client.post("/api/v1/serving/start",
+                     json={"model_name": "gpt-tiny", "max_slots": 2,
+                           "max_len": 64})
+    assert r3.status_code == 200, r3.text
+    try:
+        body = client.get("/metrics").text
+        assert "tpu_engine_serving_up 1" in body
+        assert "tpu_engine_serving_slots 2" in body
+        assert "tpu_engine_serving_chunk_steps" in body
+        assert "tpu_engine_serving_sharded 0" in body
+    finally:
+        client.post("/api/v1/serving/stop")
     # Proper exposition format: versioned content type, HELP/TYPE per
     # family preceding its samples (round-1 advisor finding).
     assert "version=0.0.4" in m.headers["content-type"]

@@ -29,6 +29,10 @@ def _cfg(mesh, model_name="gpt-tiny", **kw):
         param_dtype=Precision.FP32,
         activation_checkpointing=True,
         total_steps=10,
+        # Pin: these tests exercise specific schedules; "auto" (the config
+        # default) would resolve accum=4 > pipe=2 to 1f1b and silently
+        # change what the gpipe tests cover (see test_auto_schedule_*).
+        pipeline_schedule="gpipe",
     )
     base.update(kw)
     return TPUTrainConfig(**base)
@@ -185,6 +189,41 @@ def test_flash_attention_under_pipeline():
                       precision=Precision.BF16), n_steps=2)
     np.testing.assert_allclose([l for l, _ in fl], [l for l, _ in xl],
                                rtol=2e-3)
+
+
+def test_auto_schedule_selection():
+    """pipeline_schedule="auto" (the default) resolves at build time:
+    1f1b exactly when the microbatch count exceeds the stage count (the
+    regime where its O(P) activation residency frees real memory —
+    measured in benchmarks/RESULTS.md §Pipeline), gpipe otherwise, and
+    gpipe whenever the manual-vjp schedule lacks a requested feature."""
+    mesh = MeshConfig(data=2, fsdp=2, pipe=2)
+    # M=4 > P=2 → 1f1b.
+    assert build_train_program(
+        _cfg(mesh, pipeline_schedule="auto")
+    ).pipeline_schedule == "1f1b"
+    # M=2 <= P=2 → gpipe (warmup/drain overhead, no memory win).
+    assert build_train_program(
+        _cfg(mesh, pipeline_schedule="auto", gradient_accumulation_steps=2)
+    ).pipeline_schedule == "gpipe"
+    # No pipe axis → schedule is irrelevant; resolves to gpipe.
+    assert build_train_program(
+        _cfg(MeshConfig(data=2, fsdp=2, model=2), pipeline_schedule="auto")
+    ).pipeline_schedule == "gpipe"
+    # Features the manual-vjp schedule lacks force gpipe instead of
+    # erroring (explicit "1f1b" still errors — tests below).
+    assert build_train_program(
+        _cfg(mesh, pipeline_schedule="auto", loss_chunk_size=32)
+    ).pipeline_schedule == "gpipe"
+    assert build_train_program(
+        _cfg(mesh, pipeline_schedule="auto", precision=Precision.BF16,
+             param_dtype=Precision.FP32, grad_allreduce_dtype="bf16")
+    ).pipeline_schedule == "gpipe"
+    # Explicit choices are honoured verbatim.
+    assert build_train_program(
+        _cfg(mesh, pipeline_schedule="1f1b")
+    ).pipeline_schedule == "1f1b"
+    assert build_train_program(_cfg(mesh)).pipeline_schedule == "gpipe"
 
 
 def test_1f1b_rejects_loss_chunking():

@@ -285,9 +285,20 @@ def test_tpu_info_cli_source_parses_canned_output():
     assert snap.per_chip[0] == {
         "hbm_used_gb": 1.5, "hbm_total_gb": 15.75,
         "duty_cycle_pct": 12.0, "tensorcore_util_pct": 34.2,
+        "holder_pid": 777,
     }
     assert snap.per_chip[1]["duty_cycle_pct"] == 97.5
     assert snap.per_chip[1]["hbm_used_gb"] == 14.2
+    # Chips-table PID column (the process HOLDING each chip — possibly one
+    # this control plane never launched; reference gpu_manager.py:174-184).
+    assert snap.per_chip[1]["holder_pid"] == 777
+
+
+def test_tpu_info_cli_holder_pid_absent_when_cell_empty():
+    text = _TPU_INFO_OUTPUT.replace("│ 777 │", "│     │")
+    fields = telemetry.TpuInfoCliSource.parse(text)
+    assert "holder_pid" not in fields.get(0, {})
+    assert fields[0]["hbm_used_gb"] == 1.5  # other tables still parse
 
 
 def test_tpu_info_cli_source_degrades_to_none():

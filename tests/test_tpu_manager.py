@@ -100,6 +100,39 @@ def test_live_runtime_fleet_on_cpu_backend():
     assert all(d.platform == "cpu" for d in fleet.devices)
 
 
+def test_fleet_surfaces_foreign_chip_holder():
+    """A chip held by a pid this control plane never launched (tpu-info's
+    chips-table PID column) appears in the device's process list with
+    foreign=True; our own pid reads foreign=False with a resolved name
+    (reference foreign-process table, gpu_manager.py:174-184)."""
+    import os
+
+    from tpu_engine import telemetry
+
+    me = os.getpid()
+    foreign = 999_999_999  # no such pid → name stays None
+    canned = f"""\
+TPU Chips
+│ /dev/accel0 │ TPU v5 lite │ 1 │ {foreign} │
+│ /dev/accel1 │ TPU v5 lite │ 1 │ {me} │
+"""
+    telemetry.set_sources(
+        [telemetry.TpuInfoCliSource(runner=lambda: canned)]
+    )
+    try:
+        fleet = TPUManager().get_fleet_status()
+        d0, d1 = fleet.devices[0], fleet.devices[1]
+        assert [p.pid for p in d0.processes] == [foreign]
+        assert d0.processes[0].foreign is True
+        assert d0.processes[0].name is None
+        assert [p.pid for p in d1.processes] == [me]
+        assert d1.processes[0].foreign is False
+        assert d1.processes[0].name  # /proc/<self>/comm resolves
+        assert not fleet.devices[2].processes  # no PID row → no holder
+    finally:
+        telemetry.set_sources(None)
+
+
 def test_fleet_cli_renders_table(capsys):
     from tpu_engine.tpu_manager import main
 

@@ -85,6 +85,20 @@ class KVCache:
         return self.k_scale is not None
 
 
+def ring_lanes(cfg: ModelConfig, max_len: int,
+               chunk: Optional[int] = None) -> int:
+    """Lane count for a KV buffer: ``max_len`` for full-context models, or
+    the ring size ``min(max_len, window + chunk - 1)`` for sliding-window
+    models (a chunk of T queries needs the window behind its oldest query
+    resident). THE single source of this formula — the serving slot pool
+    copies a single-row ring cache into its own lanes and is only correct
+    because both sides size lanes identically."""
+    if not cfg.sliding_window:
+        return max_len
+    chunk = max_len if chunk is None else chunk
+    return min(max_len, cfg.sliding_window + chunk - 1)
+
+
 def init_cache(
     cfg: ModelConfig, batch: int, max_len: int, dtype=jnp.bfloat16,
     max_chunk: Optional[int] = None, kv_quant: bool = False,
@@ -99,10 +113,7 @@ def init_cache(
     ``kv_quant=True`` stores k/v as int8 with per-(slot, kv-head) scales —
     half the cache HBM of bf16, at ~1% quantisation error (symmetric
     absmax over head_dim)."""
-    slots = max_len
-    if cfg.sliding_window:
-        chunk = max_len if max_chunk is None else max_chunk
-        slots = min(max_len, cfg.sliding_window + chunk - 1)
+    slots = ring_lanes(cfg, max_len, max_chunk)
     shape = (cfg.n_layers, batch, slots, cfg.n_kv_heads, cfg.head_dim)
     store_dtype = jnp.int8 if kv_quant else dtype
     scale_shape = shape[:-1] + (1,)

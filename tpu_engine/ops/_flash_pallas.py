@@ -92,9 +92,17 @@ def _k_index(q_idx, j, block: int, window: int):
     return jnp.maximum(_lo_block(q_idx, block, window), 0) + j
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
-                block_q: int, block_k: int, scale: float, window: int,
-                causal: bool = True):
+_LOG2E = 1.4426950408889634
+# Running-max floor, in base-2 logit units. Any REAL logit sits far above
+# it, and a fully-masked row (all scores _NEG_INF) clamps here, pushing
+# every exp2(s2 - m) to exactly 0.0 (fp32 flushes below 2^-149) — which is
+# what makes the masked-probability select unnecessary (see _fwd_tile).
+_M2_FLOOR = -1e6
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                q_scr, *, block_q: int, block_k: int, scale: float,
+                window: int, causal: bool = True):
     q_idx = pl.program_id(1)
     j = pl.program_id(2)
     n_j = pl.num_programs(2)
@@ -105,6 +113,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
+        # Fold scale·log2(e) into the Q tile ONCE per (bh, q_block): the
+        # kernel then works entirely in base-2 logit units — jnp.exp2
+        # instead of exp, and no [BQ, BK]-wide scale multiply per K tile.
+        q_scr[...] = (
+            q_ref[0].astype(jnp.float32) * (scale * _LOG2E)
+        ).astype(q_scr.dtype)
 
     # Causal with BLOCK_Q == BLOCK_K: only K blocks with k_idx <= q_idx
     # contribute; the rest are skipped entirely. (The windowed lower bound
@@ -113,30 +127,34 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
     # active and no visibility mask is computed at all.
     active = (k_idx <= q_idx) if causal else (j >= 0)
 
-    @pl.when(active)
-    def _compute():
-        # Feed the MXU its native input dtype: bf16 operands with fp32
-        # accumulation (preferred_element_type). Upcasting q/k/v to fp32
-        # before the dots quarters MXU throughput for zero accuracy gain —
-        # the accumulator is fp32 either way, and softmax stays fp32 below.
-        q = q_ref[0]                            # [BQ, D] storage dtype
+    def _tile(masked: bool):
+        """One K-block of online softmax, in base-2 units.
+
+        ``masked=False`` skips the visibility iota/compare/select entirely
+        — correct for every tile strictly inside the visible band, which
+        is MOST tiles at long sequence (the diagonal tile always masks;
+        with a window, so do the tiles straddling its lower edge)."""
+        q2 = q_scr[...]                         # [BQ, D] pre-scaled
         k_blk = k_ref[0]                        # [BK, D]
         v_blk = v_ref[0]                        # [BK, D]
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [BQ, BK] fp32
-        if causal:
+        # bf16 operands, fp32 accumulation: the MXU's native contract.
+        s2 = jax.lax.dot_general(
+            q2, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BQ, BK] base-2 logits
+        if masked:
             q_pos = q_idx * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = k_idx * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(_visible(q_pos, k_pos, window), s, _NEG_INF)
+            s2 = jnp.where(_visible(q_pos, k_pos, window), s2, _NEG_INF)
         m = m_scr[...]
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        if causal:
-            p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
-        corr = jnp.exp(m - m_new)
+        # The _M2_FLOOR clamp replaces the old masked-p select: masked
+        # entries hold -1e30, so exp2(-1e30 - floor) underflows to 0.0
+        # without a [BQ, BK] where().
+        m_new = jnp.maximum(jnp.maximum(m, jnp.max(s2, axis=-1)), _M2_FLOOR)
+        p = jnp.exp2(s2 - m_new[:, None])
+        corr = jnp.exp2(m - m_new)
         m_scr[...] = m_new
         l_scr[...] = l_scr[...] * corr + jnp.sum(p, axis=-1)
         # p rounds to the storage dtype for the second MXU dot (standard
@@ -146,11 +164,34 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
             preferred_element_type=jnp.float32,
         )
 
+    if causal:
+        # A tile needs the visibility mask iff it touches the causal
+        # diagonal or the window's lower edge; interior tiles are fully
+        # visible and skip the iota/compare/select. (window is static:
+        # without one this reduces to k_idx == q_idx.)
+        needs_mask = k_idx == q_idx
+        if window:
+            needs_mask |= (q_idx - k_idx + 1) * block_q - 1 >= window
+
+        @pl.when(active & needs_mask)
+        def _compute_masked():
+            _tile(True)
+
+        @pl.when(active & jnp.logical_not(needs_mask))
+        def _compute_interior():
+            _tile(False)
+    else:
+        @pl.when(active)
+        def _compute():
+            _tile(False)
+
     @pl.when(j == n_j - 1)
     def _finalize():
         l_safe = jnp.maximum(l_scr[...], 1e-30)
         o_ref[0] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
-        lse_ref[0, 0] = m_scr[...] + jnp.log(l_safe)
+        # lse leaves the kernel in NATURAL-log units (ring-attention merges
+        # and the backward recompute consume it): m2/log2(e) + ln(l).
+        lse_ref[0, 0] = m_scr[...] * (1.0 / _LOG2E) + jnp.log(l_safe)
 
 
 def _kv_clamp(block: int, window: int, causal: bool = True):
@@ -194,9 +235,10 @@ def _flash_fwd(q, k, v, block: int, interpret: bool, window: int,
             jax.ShapeDtypeStruct((BH, 1, S), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block,), jnp.float32),      # running max m
+            pltpu.VMEM((block,), jnp.float32),      # running max m (base-2)
             pltpu.VMEM((block,), jnp.float32),      # running sum l
             pltpu.VMEM((block, D), jnp.float32),    # output accumulator
+            pltpu.VMEM((block, D), q.dtype),        # scale·log2e-folded Q
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),

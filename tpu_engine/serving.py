@@ -64,6 +64,7 @@ from tpu_engine.generate import (
     _decode_block,
     forward_with_cache,
     init_cache,
+    ring_lanes,
 )
 from tpu_engine.models.transformer import (
     ModelConfig,
@@ -105,10 +106,7 @@ def init_slot_cache(
     per-row ring of ``window + prefill_chunk - 1`` lanes (a prefill chunk
     of T tokens needs the window behind its oldest token resident) — the
     slot-pool analogue of :func:`generate.init_cache`'s ring mode."""
-    lanes = max_len
-    if cfg.sliding_window:
-        chunk = max_len if prefill_chunk is None else prefill_chunk
-        lanes = min(max_len, cfg.sliding_window + chunk - 1)
+    lanes = ring_lanes(cfg, max_len, prefill_chunk)
     ring = lanes < max_len
     shape = (cfg.n_layers, slots, lanes, cfg.n_kv_heads, cfg.head_dim)
     return SlotCache(
@@ -575,12 +573,19 @@ class ContinuousBatcher:
         produced = 0
         fresh = self._pending_first_logits
         self._pending_first_logits = {}
+        # Sampling a first token can dispatch to the device (categorical
+        # draw) — do it OUTSIDE the lock, like every other long operation;
+        # only this engine thread mutates _slots, so the reads are safe.
+        first_toks = {
+            slot: self._first_token(logits, self._slots[slot])
+            for slot, logits in fresh.items()
+            if self._slots[slot] is not None
+        }
         with self._lock:
-            for slot, logits in fresh.items():
+            for slot, tok in first_toks.items():
                 req = self._slots[slot]
                 if req is None:
                     continue
-                tok = self._first_token(logits, req)
                 self._emit(req, slot, tok)
                 produced += 1
             self._note_tokens(produced)

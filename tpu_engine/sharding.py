@@ -342,8 +342,12 @@ class TPUTrainConfig(BaseModel):
     # buffers); "1f1b" = interleaved one-forward-one-backward with manual
     # per-stage vjp — activation residency O(P) ring slots per stage, the
     # schedule that lets microbatch counts grow without activation blowup
-    # (tpu_engine/parallel/pipeline_1f1b.py).
-    pipeline_schedule: Literal["gpipe", "1f1b"] = "gpipe"
+    # (tpu_engine/parallel/pipeline_1f1b.py). "auto" (default) picks 1f1b
+    # exactly where it wins — microbatch count above the stage count, so
+    # the O(P) residency frees real memory and the warmup/drain overhead
+    # is amortised — and gpipe otherwise (measured: benchmarks/RESULTS.md
+    # §Pipeline; resolution in train.build_train_program).
+    pipeline_schedule: Literal["auto", "gpipe", "1f1b"] = "auto"
 
     # Elasticity (reference :78,226-238): TPU slices are fixed-shape, so
     # elasticity means re-launch at a new mesh shape + resume from checkpoint.

@@ -354,6 +354,13 @@ _CLI_RUNTIME_ROW = re.compile(
 _CLI_TC_ROW = re.compile(
     rf"{_CLI_SEP}?\s*(\d+)\s*{_CLI_SEP}\s*([\d.]+)\s*%\s*{_CLI_SEP}?\s*$"
 )
+# TPU Chips table: │ /dev/accel0 │ TPU v5 lite │ 1 │ 777 │ — the trailing
+# PID column is the process HOLDING the chip (possibly a process this
+# control plane never launched — the reference's per-GPU foreign process
+# table, ``gpu_manager.py:174-184``). An empty PID cell = unheld.
+_CLI_CHIP_ROW = re.compile(
+    rf"/dev/[\w/]*?(\d+)\s*{_CLI_SEP}.*{_CLI_SEP}\s*(\d+)\s*{_CLI_SEP}?\s*$"
+)
 
 
 class TpuInfoCliSource:
@@ -429,6 +436,11 @@ class TpuInfoCliSource:
         """CLI table text → {device index: overlay fields}."""
         out: dict[int, dict[str, Any]] = {}
         for line in text.splitlines():
+            m = _CLI_CHIP_ROW.search(line)
+            if m and "/dev/" in line:
+                idx = int(m.group(1))
+                out.setdefault(idx, {})["holder_pid"] = int(m.group(2))
+                continue
             m = _CLI_RUNTIME_ROW.search(line)
             if m:
                 idx = int(m.group(1))

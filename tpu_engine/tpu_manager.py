@@ -53,13 +53,42 @@ class TPUHealthStatus(str, Enum):
 class TPUJobRef(BaseModel):
     """A supervised job holding this chip — the TPU analogue of the
     reference's per-GPU process table (``gpu_manager.py:27-33``, populated
-    ``:174-184``). TPU runtimes expose no foreign-process table, so the
-    entries are the control plane's OWN jobs, registered by their
-    supervisors (``tpu_engine.telemetry.register_job_devices``)."""
+    ``:174-184``). The entries are the control plane's OWN jobs, registered
+    by their supervisors (``tpu_engine.telemetry.register_job_devices``);
+    FOREIGN holders are surfaced separately via :class:`TPUProcessRef`."""
 
     job_id: str
     status: str
     process_index: int = 0
+
+
+class TPUProcessRef(BaseModel):
+    """An OS process holding this chip — including ones this control plane
+    never launched. Reference parity: ``GPUProcess`` (``gpu_manager.py:
+    27-33``: pid, name, memory). Source: ``tpu-info``'s TPU Chips table PID
+    column (the runtime exposes no per-process memory attribution, so
+    ``memory_mb`` has no TPU-honest value and is omitted). ``foreign`` is
+    True when the pid is not this control-plane process — a chip held by a
+    job nobody here supervises."""
+
+    pid: int
+    name: Optional[str] = None
+    foreign: bool = False
+
+
+def _process_ref(pid: int) -> "TPUProcessRef":
+    """Resolve a chip-holder pid into a process ref. The name comes from
+    /proc/<pid>/comm when the pid is on this host (tpu-info runs host-local,
+    so it always is); a vanished pid keeps name=None."""
+    import os
+
+    name = None
+    try:
+        with open(f"/proc/{pid}/comm") as f:
+            name = f.read().strip() or None
+    except OSError:
+        pass
+    return TPUProcessRef(pid=pid, name=name, foreign=pid != os.getpid())
 
 
 class TPUDevice(BaseModel):
@@ -98,6 +127,10 @@ class TPUDevice(BaseModel):
     # Supervised jobs whose mesh holds this chip (live snapshots only;
     # injected/mock fleets have no job registry to consult).
     jobs: list[TPUJobRef] = Field(default_factory=list)
+    # OS processes holding the chip per `tpu-info`'s chips table —
+    # including FOREIGN holders the control plane didn't launch
+    # (reference ``gpu_manager.py:174-184``).
+    processes: list[TPUProcessRef] = Field(default_factory=list)
 
     @property
     def hbm_free_gb(self) -> float:
@@ -368,6 +401,13 @@ class TPUManager:
                             dev.hbm_utilization_pct = round(
                                 dev.hbm_used_gb / dev.hbm_total_gb * 100.0, 2
                             )
+                    # Chip-holder process from tpu-info's chips table:
+                    # foreign pids (a JAX job this plane never launched)
+                    # become visible here, reference ``:174-184`` parity.
+                    if extra.get("holder_pid") is not None and not dev.processes:
+                        dev.processes = [
+                            _process_ref(int(extra["holder_pid"]))
+                        ]
                     self._assess_health(dev)
 
             # Per-chip job attribution: lay the supervised-job claims

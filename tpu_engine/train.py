@@ -33,6 +33,7 @@ from tpu_engine.mesh_runtime import BATCH_AXES, MeshRuntime
 from tpu_engine.models import transformer as tfm
 from tpu_engine.sharding import (
     OffloadDevice,
+    Precision,
     ShardingStage,
     TPUTrainConfig,
     dtype_of,
@@ -268,6 +269,9 @@ class TrainProgram:
     # merge (for generation/export). None for full-parameter training.
     base_params: Any = None
     merged_params: Optional[Callable[[Any], Any]] = None
+    # The RESOLVED pipeline schedule ("gpipe" | "1f1b") — config "auto"
+    # is decided at build time (see build_train_program's selection rule).
+    pipeline_schedule: str = "gpipe"
 
     @property
     def mesh(self) -> Mesh:
@@ -368,6 +372,27 @@ def build_train_program(
         raise ValueError(
             f"model n_layers={model_cfg.n_layers} must be divisible by the "
             f"pipe axis size {pipe_size}"
+        )
+    # Schedule auto-selection (measured A/B in benchmarks/RESULTS.md
+    # §Pipeline): at M <= P microbatches 1F1B's residency bound equals
+    # GPipe's while its masked warmup/drain lanes burn compute (~8% slower
+    # at equal M), so gpipe wins; at M > P GPipe's O(M) saved stage buffers
+    # grow past 1F1B's O(P) ring — on memory-bound configs GPipe simply
+    # OOMs (llama-7b pipe=4 M=16 on v5e:4x4) where 1F1B keeps scaling and
+    # its per-sample time overtakes GPipe's best feasible M. Features the
+    # manual-vjp schedule does not support fall back to gpipe.
+    pipe_schedule = cfg.pipeline_schedule
+    if pipe_schedule == "auto":
+        unsupported_1f1b = bool(cfg.loss_chunk_size) or (
+            cfg.grad_allreduce_dtype is not None
+            and cfg.grad_allreduce_dtype != Precision.FP32
+        )
+        pipe_schedule = (
+            "1f1b"
+            if pipe_size > 1
+            and cfg.gradient_accumulation_steps > pipe_size
+            and not unsupported_1f1b
+            else "gpipe"
         )
     if cfg.loss_chunk_size and cfg.seq_len % cfg.loss_chunk_size != 0:
         raise ValueError(
@@ -745,7 +770,7 @@ def build_train_program(
 
         pipe_grad_fn = jax.value_and_grad(pipe_loss_fn)
 
-        if cfg.pipeline_schedule == "1f1b":
+        if pipe_schedule == "1f1b":
             # Interleaved 1F1B with manual per-stage vjp: O(P) in-flight
             # stage inputs instead of GPipe-by-autodiff's O(M + P) saved
             # boundary buffers (tpu_engine/parallel/pipeline_1f1b.py).
@@ -841,7 +866,7 @@ def build_train_program(
         else None
     )
     reduced_comm = comm_dtype is not None and comm_dtype != jnp.float32
-    if reduced_comm and pipe_size > 1 and cfg.pipeline_schedule == "1f1b":
+    if reduced_comm and pipe_size > 1 and pipe_schedule == "1f1b":
         raise ValueError(
             "grad_allreduce_dtype with pipeline_schedule='1f1b' is not "
             "supported: the manual-vjp schedule accumulates gradients in "
@@ -1014,6 +1039,7 @@ def build_train_program(
         eval_step=jit_eval,
         base_params=base_params if use_lora else None,
         merged_params=merged_fn,
+        pipeline_schedule=pipe_schedule,
     )
 
 
