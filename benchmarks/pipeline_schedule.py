@@ -43,6 +43,11 @@ def run_aot() -> None:
                 },
             ).compile()
             ma = comp.memory_analysis()
+            # NOTE: cost_analysis().flops is NOT reported — XLA counts a
+            # lax.scan body once regardless of trip count, so "per-sample
+            # FLOPs" from it halves every time M doubles (verified: 1f1b
+            # M=8/16/32 all report the same per-STEP flops). Schedule
+            # arithmetic lives in RESULTS.md §Pipeline instead.
             print(json.dumps({
                 "schedule": sched, "microbatches": M,
                 "device_args_gib": round(ma.argument_size_in_bytes / 2**30, 2),
@@ -122,17 +127,23 @@ def run_wall() -> None:
             "step_ms": round(step_ms, 1),
             "per_sample_ms": round(per_sample, 2),
         }))
-    # The headline comparison: GPipe's best memory-feasible config on the
-    # AOT plane is M=8; 1F1B runs M=16/32 in the memory GPipe's M=16
-    # needs and per-sample time must come out ahead.
+    # The CPU backend CANNOT exhibit pipeline-schedule arithmetic: its
+    # per-tick cost grows with M (cache pressure from the O(M) saved
+    # buffers — observe GPipe's own per-sample time WORSENING from M=8 to
+    # M=16 where tick counts predict a 14% improvement), and the
+    # masked-SPMD 1F1B pays a large per-tick manual-vjp overhead there.
+    # Report the measurement and the diagnostic ratio honestly; the
+    # TPU-honest planes are the AOT memory wall (--aot: GPipe M=16 OOMs,
+    # 1F1B fits through M=32) and tick arithmetic (RESULTS.md §Pipeline).
     best_1f1b = min(results[("1f1b", 16)], results[("1f1b", 32)])
+    gpipe_scaling = results[("gpipe", 16)] / results[("gpipe", 8)]
     print(json.dumps({
-        "metric": "pipeline_1f1b_per_sample_vs_gpipe_feasible",
+        "metric": "pipeline_cpu_wall_per_sample",
         "gpipe_m8_per_sample_ms": round(results[("gpipe", 8)], 2),
         "best_1f1b_per_sample_ms": round(best_1f1b, 2),
-        "value": round(results[("gpipe", 8)] / best_1f1b, 3),
-        "unit": "x_speedup_per_sample",
-        "wins": best_1f1b < results[("gpipe", 8)],
+        "gpipe_m16_over_m8_per_sample": round(gpipe_scaling, 3),
+        "tick_arithmetic_predicts": 0.864,  # (19/16)/(11/8)
+        "cpu_backend_follows_tick_arithmetic": gpipe_scaling < 1.0,
     }))
 
 

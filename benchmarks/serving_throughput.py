@@ -95,6 +95,31 @@ def bench_sustained(params, cfg, prompt, chunk, total_requests=48):
     }
 
 
+def bench_speculative(params, cfg, draft_params, draft_cfg, prompt, gamma,
+                      tag):
+    """Spec-decode burst: 8 greedy requests, slots full. Reported against
+    the chunked burst at the same load."""
+    from tpu_engine.serving import ContinuousBatcher
+
+    srv = ContinuousBatcher(params, cfg, max_slots=8, max_len=512,
+                            draft_params=draft_params, draft_cfg=draft_cfg,
+                            spec_gamma=gamma)
+    r0 = srv.submit(prompt, max_new_tokens=16)
+    _drain(srv, [r0])
+    t0 = time.time()
+    rids = [srv.submit(prompt, max_new_tokens=128) for _ in range(8)]
+    _drain(srv, rids)
+    dt = time.time() - t0
+    toks = 8 * 128
+    st = srv.stats()
+    return {
+        "scenario": f"burst_speculative_{tag}", "gamma": gamma, "slots": 8,
+        "tokens": toks, "sec": round(dt, 2),
+        "tokens_per_sec": round(toks / dt, 1),
+        "spec_accept_rate": st.get("spec_accept_rate"),
+    }
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -119,6 +144,19 @@ def main() -> None:
         "value": round(sus[16] / sus[1], 2),
         "unit": "x_vs_per_step",
     }))
+
+    # Speculative bounds. No distilled draft exists in-image (zero egress,
+    # random inits — a fresh small model's argmax never agrees with the
+    # target's), so measure the two honest endpoints: acceptance ceiling
+    # (draft == target: alpha ~= 1 at worst-case draft cost) and floor (a
+    # 2-layer random draft: alpha ~= 1/(gamma+1), pure overhead).
+    print(json.dumps(bench_speculative(
+        params, cfg, params, cfg, prompt, gamma=7, tag="ceiling")))
+    draft_cfg = cfg.with_(name="gpt-125m-d2", n_layers=2)
+    draft_params = tfm.init_params(jax.random.PRNGKey(5), draft_cfg,
+                                   dtype=jnp.bfloat16)
+    print(json.dumps(bench_speculative(
+        params, cfg, draft_params, draft_cfg, prompt, gamma=4, tag="floor")))
 
 
 if __name__ == "__main__":

@@ -254,11 +254,15 @@ def forward_with_cache(
     cache: KVCache,
     cfg: ModelConfig,
     compute_dtype=jnp.bfloat16,
-) -> tuple[jax.Array, KVCache]:
+    want_logits: bool = True,
+) -> tuple[Optional[jax.Array], KVCache]:
     """Run ``tokens`` [B, T] through the stack against (and into) ``cache``.
 
     Serves both phases: prefill (T = prompt length) and decode (T = 1).
     Returns (logits [B, T, V] fp32, updated cache with length += T).
+    ``want_logits=False`` (static) skips the unembed entirely and returns
+    ``(None, cache)`` — cache-ingestion-only callers (the speculative
+    draft's prompt prefill) should not pay a T×D×V matmul per chunk.
 
     For non-ring caches the caller must keep ``cache.length + T <=
     cache.max_len`` (size the cache to prompt + max_new_tokens, as
@@ -342,7 +346,7 @@ def forward_with_cache(
     x, out = lax.scan(body, x, (layer_stack, cache.k, cache.v) + scales)
     k_new, v_new = out[0], out[1]
     ks_new, vs_new = (out[2], out[3]) if cache.quantized else (None, None)
-    logits = unembed(params, x, cfg)
+    logits = unembed(params, x, cfg) if want_logits else None
     return logits, KVCache(k=k_new, v=v_new, pos=pos_new,
                            length=cache.length + T, ring=cache.ring,
                            k_scale=ks_new, v_scale=vs_new)

@@ -251,6 +251,123 @@ def decode_chunk(
     return out.T, cache  # [B, n_steps]
 
 
+def decode_verify(
+    params: dict[str, Any],
+    tokens: jax.Array,      # [B, T] int32 — chain of inputs per slot
+    cache: SlotCache,
+    active: jax.Array,      # [B] bool
+    cfg: ModelConfig,
+    compute_dtype=jnp.bfloat16,
+) -> tuple[jax.Array, SlotCache]:
+    """T tokens per slot in ONE forward (the speculative verify pass).
+
+    Row b's inputs sit at positions ``lengths[b] + arange(T)``; their K/V
+    rows are written before attention (so in-chain causality is the
+    ordinary position mask), and logits for ALL T inputs come back —
+    logits[b, i] scores the token following input i. Lengths advance by T
+    for active rows; the CALLER rewinds them to the accepted frontier
+    (free under per-row positions: lanes past a row's length are masked
+    and the next round's chain overwrites them before exposure).
+    Non-ring pools only (speculative serving rejects window models)."""
+    B, T = tokens.shape
+    S = cache.n_lanes
+    rows = jnp.arange(B)
+    positions = cache.lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    x = embed_tokens(params, tokens, compute_dtype,
+                     positions=positions, cfg=cfg)  # [B, T, D]
+    layer_stack = cast_layer_stack(params, compute_dtype)
+    slot_pos = jnp.broadcast_to(
+        jnp.arange(S, dtype=jnp.int32)[None, :], (B, S)
+    )
+
+    def write(cache_arr, new_rows):  # new_rows [B, T, KV, HD]
+        return cache_arr.at[rows[:, None], positions].set(
+            new_rows.astype(cache_arr.dtype)
+        )
+
+    def body(x, xs):
+        lp, k_c, v_c = xs
+        x, k_c, v_c, _, _ = _decode_block(
+            x, lp, k_c, v_c, write, slot_pos, positions, cfg
+        )
+        return x, (k_c, v_c)
+
+    x, (k_new, v_new) = lax.scan(body, x, (layer_stack, cache.k, cache.v))
+    logits = unembed(params, x, cfg)  # [B, T, V] fp32
+    new_cache = SlotCache(
+        k=k_new, v=v_new,
+        lengths=cache.lengths + T * active.astype(jnp.int32),
+        pos=None, ring=False,
+    )
+    return logits, new_cache
+
+
+def speculative_round(
+    params: dict[str, Any],
+    draft_params: dict[str, Any],
+    tokens: jax.Array,      # [B] int32 — last emitted token per slot
+    cache: SlotCache,
+    draft_cache: SlotCache,
+    active: jax.Array,      # [B] bool
+    cfg: ModelConfig,
+    draft_cfg: ModelConfig,
+    gamma: int,
+    compute_dtype=jnp.bfloat16,
+) -> tuple[jax.Array, jax.Array, SlotCache, SlotCache]:
+    """One batched draft-propose / target-verify round for EVERY slot.
+
+    The slot-pool generalisation of :func:`generate.speculative_generate`
+    (single request, its own cache invariant: resident K/V = every token
+    EXCEPT the last emitted — which is exactly the serving pool's steady
+    state, since each decode writes its INPUT token's K/V). The draft
+    proposes ``gamma`` greedy tokens per slot autoregressively (one extra
+    step ingests its own last proposal's K/V — a fully-accepted round
+    would otherwise leave a permanent draft-cache hole); the target
+    verifies all slots' chains in ONE ``T = gamma+1`` forward; per-row
+    acceptance is the longest agreeing prefix plus the target's
+    correction/bonus token. Both caches rewind per-row to the accepted
+    frontier — a [B]-vector subtraction; rejected lanes stay masked until
+    the next round's chain overwrites them.
+
+    Returns (tgt [B, gamma+1] candidate tokens, n_acc [B] accepted counts
+    (1..gamma+1), target cache, draft cache). Output streams are
+    token-identical to plain greedy serving wherever the target's chunked
+    and incremental argmax agree (bit-exact on CPU; ~1e-2 logit deltas on
+    TPU can flip near-ties — same caveat as ``speculative_generate``)."""
+
+    def dstep(carry, _):
+        toks, dc = carry
+        logits, dc = decode_step(draft_params, toks, dc, active, draft_cfg,
+                                 compute_dtype)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        toks = jnp.where(active, nxt, toks)
+        return (toks, dc), nxt
+
+    (_, draft_cache), props = lax.scan(
+        dstep, (tokens, draft_cache), None, length=gamma + 1
+    )
+    proposals = props[:gamma].T                      # [B, gamma]
+    chain = jnp.concatenate([tokens[:, None], proposals], axis=1)  # [B, g+1]
+
+    logits, cache = decode_verify(params, chain, cache, active, cfg,
+                                  compute_dtype)
+    tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, g+1]
+    matches = (proposals == tgt[:, :gamma]).astype(jnp.int32)
+    n_acc = jnp.sum(jnp.cumprod(matches, axis=1), axis=1) + 1  # [B] 1..g+1
+
+    # Rewind both caches to the accepted frontier: resident = everything
+    # except the new last token (tgt[:, n_acc-1]).
+    overshoot = jnp.where(active, (gamma + 1) - n_acc, 0).astype(jnp.int32)
+    cache = SlotCache(k=cache.k, v=cache.v,
+                      lengths=cache.lengths - overshoot,
+                      pos=None, ring=False)
+    # The draft ran gamma+1 steps; its frontier rewinds to match exactly.
+    draft_cache = SlotCache(k=draft_cache.k, v=draft_cache.v,
+                            lengths=draft_cache.lengths - overshoot,
+                            pos=None, ring=False)
+    return tgt, n_acc, cache, draft_cache
+
+
 @dataclass
 class Request:
     """One generation request's lifecycle (host-side bookkeeping)."""
@@ -272,13 +389,15 @@ class Request:
 class _PrefillState:
     """A prompt mid-ingestion: ``consumed`` of ``padded`` tokens are in
     ``c1`` (single-row cache); advanced one bounded chunk per engine step
-    so running slots never stall behind a whole long prompt."""
+    so running slots never stall behind a whole long prompt. Speculative
+    servers ingest the prompt into the draft model's cache too (``dc1``)."""
 
     req: Request
     slot: int
     c1: KVCache
     toks: np.ndarray    # [1, padded] int32 — prompt, zero-padded
     consumed: int = 0
+    dc1: Optional[KVCache] = None
 
     @property
     def padded(self) -> int:
@@ -313,6 +432,9 @@ class ContinuousBatcher:
         prefill_chunk: int = 256,
         mesh: Optional[Mesh] = None,
         stats_window_s: float = 30.0,
+        draft_params: Any = None,
+        draft_cfg: Optional[ModelConfig] = None,
+        spec_gamma: int = 4,
     ):
         self.params = params
         self.cfg = cfg
@@ -355,6 +477,53 @@ class ContinuousBatcher:
         else:
             self._cache_sh = self._rep = self._kv_sh = None
 
+        # -- speculative decoding (draft-propose / batched verify) ----------
+        self._draft_params = draft_params
+        self._draft_cfg = draft_cfg
+        self.spec_gamma = int(spec_gamma)
+        self._draft_cache = None
+        if draft_params is not None:
+            if draft_cfg is None:
+                raise ValueError("draft_params requires draft_cfg")
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_cfg.vocab_size} != target vocab "
+                    f"{cfg.vocab_size}: speculative verify compares token ids"
+                )
+            if self._cache.ring or cfg.sliding_window or draft_cfg.sliding_window:
+                raise ValueError(
+                    "speculative serving does not support sliding-window "
+                    "models (the verify chain's rewind assumes flat lanes)"
+                )
+            if mesh is not None:
+                raise ValueError(
+                    "speculative serving does not run mesh-sharded yet; "
+                    "drop draft_params or mesh"
+                )
+            if self.spec_gamma < 1:
+                raise ValueError(f"spec_gamma must be >= 1, got {spec_gamma}")
+            self._draft_cache = init_slot_cache(
+                draft_cfg, self.max_slots, self.max_len, compute_dtype,
+                prefill_chunk=self.prefill_chunk,
+            )
+            self._spec = jax.jit(
+                partial(speculative_round, cfg=cfg, draft_cfg=draft_cfg,
+                        gamma=self.spec_gamma, compute_dtype=compute_dtype),
+                donate_argnums=(3, 4),  # both pools alias across rounds
+            )
+            # The draft's prompt ingestion needs no logits — skip the
+            # T×D×V unembed per chunk (it would rival the whole 2-layer
+            # draft forward it accompanies).
+            self._draft_prefill_fn = jax.jit(
+                partial(_draft_prefill_ingest, cfg=draft_cfg,
+                        compute_dtype=compute_dtype),
+                donate_argnums=(2,),
+            )
+            self._draft_insert = jax.jit(
+                _insert_prefill, donate_argnums=(0,), static_argnums=(4,),
+            )
+            self._draft_reset = jax.jit(_reset_slot, donate_argnums=(0,))
+
         self._decode = jax.jit(
             partial(decode_chunk, cfg=cfg, n_steps=self.chunk_steps,
                     compute_dtype=compute_dtype),
@@ -393,6 +562,8 @@ class ContinuousBatcher:
         self._lock = threading.Lock()
         self._done = threading.Condition(self._lock)
         self._tokens_out = 0
+        self._spec_rounds = 0
+        self._spec_accepted = 0
         self._started = time.time()
         self._stats_window_s = float(stats_window_s)
         self._recent: collections.deque[tuple[float, int]] = collections.deque()
@@ -406,6 +577,13 @@ class ContinuousBatcher:
             raise RuntimeError(f"serving loop failed: {self.last_error}")
         if not prompt:
             raise ValueError("empty prompt")
+        if temperature > 0.0 and self._draft_params is not None:
+            raise ValueError(
+                "speculative server is greedy-only: temperature>0 requests "
+                "would desynchronise the draft cache (verify is exact only "
+                "for argmax streams); start a non-speculative server for "
+                "sampling"
+            )
         if len(prompt) + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
@@ -467,7 +645,7 @@ class ContinuousBatcher:
             window = min(max(now - self._started, 1e-9), self._stats_window_s)
             active = sum(1 for s in self._slots if s is not None)
             dt = max(now - self._started, 1e-9)
-            return {
+            out = {
                 "slots": self.max_slots,
                 "active_slots": active,
                 "prefilling": len(self._prefilling),
@@ -478,7 +656,15 @@ class ContinuousBatcher:
                 "tokens_per_sec_lifetime": round(self._tokens_out / dt, 2),
                 "chunk_steps": self.chunk_steps,
                 "sharded": self.mesh is not None,
+                "speculative": self._draft_params is not None,
             }
+            if self._spec_rounds:
+                # Mean accepted tokens per draft round, of gamma+1 possible.
+                out["spec_accept_rate"] = round(
+                    self._spec_accepted / (self._spec_rounds *
+                                           (self.spec_gamma + 1)), 3
+                )
+            return out
 
     # -- engine side ---------------------------------------------------------
 
@@ -509,7 +695,11 @@ class ContinuousBatcher:
             c1_sh = KVCache(k=self._kv_sh, v=self._kv_sh, pos=self._rep,
                             length=self._rep, ring=c1.ring)
             c1 = jax.device_put(c1, c1_sh)
-        return _PrefillState(req=req, slot=slot, c1=c1, toks=toks)
+        dc1 = None
+        if self._draft_params is not None:
+            dc1 = init_cache(self._draft_cfg, 1, c1.max_len,
+                             dtype=self._compute_dtype)
+        return _PrefillState(req=req, slot=slot, c1=c1, toks=toks, dc1=dc1)
 
     def _advance_prefill(self, st: _PrefillState) -> bool:
         """Ingest ONE bounded chunk; True when the prompt is fully in and
@@ -524,6 +714,8 @@ class ContinuousBatcher:
         last_row, st.c1 = self._prefill_fn(
             self.params, chunk, st.c1, jnp.asarray(row, jnp.int32)
         )
+        if st.dc1 is not None:  # speculative: the draft ingests the prompt too
+            st.dc1 = self._draft_prefill_fn(self._draft_params, chunk, st.dc1)
         st.consumed = t1
         if t0 <= P_len - 1 < t1:
             self._pending_first_logits[st.slot] = np.asarray(last_row)
@@ -532,6 +724,11 @@ class ContinuousBatcher:
         self._cache = self._insert(self._cache, st.c1, jnp.asarray(st.slot),
                                    jnp.asarray(P_len, jnp.int32),
                                    self._cache.ring)
+        if st.dc1 is not None:
+            self._draft_cache = self._draft_insert(
+                self._draft_cache, st.dc1, jnp.asarray(st.slot),
+                jnp.asarray(P_len, jnp.int32), False,
+            )
         self._last_tokens[st.slot] = st.req.prompt[-1]
         return True
 
@@ -597,11 +794,40 @@ class ContinuousBatcher:
             return produced
 
         active = np.zeros((self.max_slots,), bool)
+        for i, _ in active_reqs:
+            active[i] = True
+
+        # Speculative path: draft proposes gamma tokens per slot, target
+        # verifies every slot's chain in one T=gamma+1 forward; each round
+        # emits 1..gamma+1 tokens per slot for two model dispatches.
+        # (Greedy-only by the submit guard — no sampling state needed.)
+        if self._draft_params is not None:
+            tgt, n_acc, self._cache, self._draft_cache = self._spec(
+                self.params, self._draft_params,
+                jnp.asarray(self._last_tokens), self._cache,
+                self._draft_cache, jnp.asarray(active),
+            )
+            tgt_host = np.asarray(tgt)          # [B, gamma+1]
+            n_acc_host = np.asarray(n_acc)      # [B]
+            with self._lock:
+                emitted = 0
+                for slot, req in active_reqs:
+                    if self._slots[slot] is not req:
+                        continue
+                    self._spec_rounds += 1
+                    self._spec_accepted += int(n_acc_host[slot])
+                    for t in tgt_host[slot][: n_acc_host[slot]]:
+                        self._emit(req, slot, int(t))
+                        emitted += 1
+                        if req.status != "running":
+                            break  # slot reset; surplus accepted tokens dropped
+                self._note_tokens(emitted)
+            return produced + emitted
+
         temps = np.zeros((self.max_slots,), np.float32)
         req_ids = np.zeros((self.max_slots,), np.int32)
         counts = np.zeros((self.max_slots,), np.int32)
         for i, r in active_reqs:
-            active[i] = True
             temps[i] = r.temperature
             req_ids[i] = r.id
             counts[i] = len(r.tokens)
@@ -665,6 +891,8 @@ class ContinuousBatcher:
             # reuses it cleanly; overshoot lanes from a mid-chunk finish
             # become invisible the same instant.
             self._cache = self._reset(self._cache, slot)
+            if self._draft_cache is not None:
+                self._draft_cache = self._draft_reset(self._draft_cache, slot)
             self._done.notify_all()
 
     def serve_forever(self, stop: threading.Event, idle_sleep: float = 0.01):
@@ -703,6 +931,15 @@ def _prefill_forward(params, toks, cache, row_idx, *, cfg, compute_dtype):
     logits, cache = forward_with_cache(params, toks, cache, cfg,
                                        compute_dtype=compute_dtype)
     return logits[0, row_idx], cache
+
+
+def _draft_prefill_ingest(params, toks, cache, *, cfg, compute_dtype):
+    """Cache-only prompt ingestion for the speculative draft: no unembed,
+    no logits (the draft's first proposal re-derives from the last token)."""
+    _, cache = forward_with_cache(params, toks, cache, cfg,
+                                  compute_dtype=compute_dtype,
+                                  want_logits=False)
+    return cache
 
 
 def _insert_prefill(cache: SlotCache, c1: KVCache, slot, true_len, ring: bool):
