@@ -1,0 +1,106 @@
+"""Inter-token latency for RUNNING slots during an admission burst.
+
+Round-3 verdict item 3: admission used to prefill every admitted prompt
+sequentially before any decode step — a burst of admissions stalled all
+running slots for the full prompts' forwards. Round 4 ingests prompts in
+bounded ``prefill_chunk`` dispatches, at most one chunk per engine step,
+interleaved with decode. This measures what running requests actually
+feel: per-token emission gaps (engine-side timestamps, no polling noise)
+for slots that were decoding when a burst of long prompts arrived —
+small ``prefill_chunk`` bounds the worst gap, large chunks (the
+monolithic-prefill regime) stretch it.
+
+Run: ``python benchmarks/serving_latency.py`` (real chip; one JSON line
+per prefill_chunk setting).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+class _TimestampingBatcher:
+    """Benchmark-side shim: records an engine-side timestamp per emitted
+    token without touching product code."""
+
+    def __new__(cls, *a, **kw):
+        from tpu_engine.serving import ContinuousBatcher
+
+        class Timestamped(ContinuousBatcher):
+            def __init__(self, *args, **kwargs):
+                super().__init__(*args, **kwargs)
+                self.emit_times: dict[int, list[float]] = {}
+
+            def _emit(self, req, slot, tok):
+                self.emit_times.setdefault(req.id, []).append(
+                    time.perf_counter()
+                )
+                super()._emit(req, slot, tok)
+
+        return Timestamped(*a, **kw)
+
+
+def run_one(params, cfg, prefill_chunk: int) -> dict:
+    srv = _TimestampingBatcher(
+        params, cfg, max_slots=8, max_len=1024, chunk_steps=8,
+        prefill_chunk=prefill_chunk, prefill_pad_to=64,
+    )
+    # Warm all compiled shapes: a short request end-to-end, plus one
+    # long-prompt request so the burst's prefill shapes are cached.
+    w1 = srv.submit(list(range(1, 33)), max_new_tokens=24)
+    w2 = srv.submit(list(range(1, 513)), max_new_tokens=8)
+    while not all(srv.result(w)["status"] == "done" for w in (w1, w2)):
+        srv.step()
+
+    # 4 running decode requests, into steady state.
+    running = [srv.submit(list(range(1, 33)), max_new_tokens=400)
+               for _ in range(4)]
+    while min(len(srv.result(r)["tokens"]) for r in running) < 24:
+        srv.step()
+
+    # THE BURST: 4 long prompts land at once.
+    burst_t = time.perf_counter()
+    burst = [srv.submit(list(range(1, 513)), max_new_tokens=16)
+             for _ in range(4)]
+    while not all(srv.result(b)["status"] == "done" for b in burst):
+        srv.step()
+    # Keep decoding a moment past the burst so trailing gaps are captured.
+    for _ in range(4):
+        srv.step()
+
+    # Inter-token gaps of the RUNNING requests, within the burst window.
+    end_t = time.perf_counter()
+    gaps = []
+    for r in running:
+        ts = [t for t in srv.emit_times[r] if burst_t - 0.5 <= t <= end_t]
+        gaps += [b - a for a, b in zip(ts, ts[1:])]
+    gaps.sort()
+    pct = lambda p: round(gaps[min(int(len(gaps) * p), len(gaps) - 1)] * 1e3, 1)
+    return {
+        "prefill_chunk": prefill_chunk,
+        "burst_prompts": 4, "prompt_len": 512,
+        "running_slots": 4, "gaps_measured": len(gaps),
+        "intertoken_p50_ms": pct(0.50),
+        "intertoken_p95_ms": pct(0.95),
+        "intertoken_max_ms": round(gaps[-1] * 1e3, 1),
+        "burst_window_s": round(end_t - burst_t, 2),
+    }
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_engine.models import transformer as tfm
+
+    cfg = tfm.MODEL_CONFIGS["gpt-125m"]
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.bfloat16)
+    # 512 = the whole prompt in one dispatch (the round-3 monolithic
+    # regime); 128/64 = bounded interleave.
+    for chunk in (512, 128, 64):
+        print(json.dumps(run_one(params, cfg, chunk)))
+
+
+if __name__ == "__main__":
+    main()
