@@ -43,6 +43,12 @@ class ServingStartRequest(BaseModel):
     # params automatically — multi-chip models serve as trained.
     tensor_parallel: int = Field(default=1, ge=1)
     fsdp: int = Field(default=1, ge=1)
+    # Weight-only quantization of the served tree ("int8"): projection
+    # kernels become int8 codes + per-channel scales — half the weight
+    # HBM footprint AND half the per-token weight traffic (decode is
+    # weight-bandwidth-bound). Composable with both weight sources and
+    # with sharded serving.
+    quantize: Optional[str] = Field(default=None, pattern="^int8$")
 
 
 class ServingSubmitRequest(BaseModel):
@@ -95,6 +101,27 @@ async def start_server(request: web.Request) -> web.Response:
             # serve exactly as they trained.
             params = job._params_snapshot()
             mesh = job.program.mesh
+            if req.quantize == "int8":
+                from tpu_engine.models.transformer import logical_axes
+                from tpu_engine.quant import quantize_params, quantize_pspecs
+                from tpu_engine.sharding import (
+                    ShardingStage, named_shardings, param_pspecs,
+                )
+
+                params = quantize_params(params)
+                if mesh is not None:
+                    # Re-pin the quantized tree: q keeps the kernel
+                    # layout, the scale drops the contracted dim. (A job
+                    # that trained below full partitioning re-lays out
+                    # to the TP/FSDP serving layout here — what a tree
+                    # too large for one chip needs.)
+                    qspecs = quantize_pspecs(
+                        param_pspecs(logical_axes(cfg),
+                                     ShardingStage.FULL_PARTITIONING),
+                        params,
+                    )
+                    params = jax.device_put(
+                        params, named_shardings(mesh, qspecs))
         else:
             cfg = tfm.MODEL_CONFIGS.get(req.model_name)
             if cfg is None:
@@ -104,6 +131,13 @@ async def start_server(request: web.Request) -> web.Response:
                     f"{sorted(tfm.MODEL_CONFIGS)}",
                 )
             params = tfm.init_params(jax.random.PRNGKey(req.seed), cfg)
+            if req.quantize == "int8":
+                # Quantize BEFORE any mesh placement: the sharded paths
+                # below then move int8 bytes once, instead of resharding
+                # the full-precision tree and discarding it.
+                from tpu_engine.quant import quantize_params as _qp
+
+                params = _qp(params)
             if req.tensor_parallel > 1 or req.fsdp > 1:
                 from tpu_engine.mesh_runtime import MeshConfig, build_mesh
                 from tpu_engine.models.transformer import logical_axes
@@ -116,11 +150,13 @@ async def start_server(request: web.Request) -> web.Response:
                     ))
                 except ValueError as e:
                     raise ApiError(422, str(e))
-                params = jax.device_put(params, named_shardings(
-                    mesh,
-                    param_pspecs(logical_axes(cfg),
-                                 ShardingStage.FULL_PARTITIONING),
-                ))
+                specs = param_pspecs(logical_axes(cfg),
+                                     ShardingStage.FULL_PARTITIONING)
+                if req.quantize == "int8":
+                    from tpu_engine.quant import quantize_pspecs
+
+                    specs = quantize_pspecs(specs, params)
+                params = jax.device_put(params, named_shardings(mesh, specs))
         global _server, _stop, _thread
         with _lock:
             if _server is not None:
@@ -148,6 +184,7 @@ async def start_server(request: web.Request) -> web.Response:
     return json_response({
         "started": True, "model": name, "max_slots": req.max_slots,
         "max_len": req.max_len, "sharded": sharded,
+        "quantize": req.quantize,
     })
 
 

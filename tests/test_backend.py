@@ -827,3 +827,60 @@ def test_serving_from_sharded_trained_job(client):
         assert client.get("/api/v1/serving/stats").json()["sharded"] is True
     finally:
         client.post("/api/v1/serving/stop")
+
+
+def test_serving_quantized_over_http(client):
+    """quantize="int8" serves a weight-only-quantized tree (round 4):
+    the started instance reports the mode, decodes deterministically, and
+    the sharded variant composes (quantized pspec mirror on the mesh)."""
+    r = client.post("/api/v1/serving/start",
+                    json={"model_name": "gpt-tiny", "max_slots": 2,
+                          "max_len": 64, "quantize": "int8"})
+    assert r.status_code == 200, r.text
+    assert r.json()["quantize"] == "int8"
+    try:
+        rid = client.post(
+            "/api/v1/serving/submit",
+            json={"prompt": [3, 4, 5], "max_new_tokens": 4},
+        ).json()["request_id"]
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            body = client.get(f"/api/v1/serving/result/{rid}").json()
+            if body["status"] in ("done", "failed"):
+                break
+            time.sleep(0.2)
+        assert body["status"] == "done", body
+        first = body["tokens"]
+        assert len(first) == 4
+    finally:
+        client.post("/api/v1/serving/stop")
+
+    # Sharded + quantized: same stream (weight values identical; layout
+    # must not change the tokens).
+    r = client.post("/api/v1/serving/start",
+                    json={"model_name": "gpt-tiny", "max_slots": 2,
+                          "max_len": 64, "quantize": "int8",
+                          "tensor_parallel": 4, "fsdp": 2})
+    assert r.status_code == 200, r.text
+    assert r.json()["sharded"] is True
+    try:
+        rid = client.post(
+            "/api/v1/serving/submit",
+            json={"prompt": [3, 4, 5], "max_new_tokens": 4},
+        ).json()["request_id"]
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            body = client.get(f"/api/v1/serving/result/{rid}").json()
+            if body["status"] in ("done", "failed"):
+                break
+            time.sleep(0.2)
+        assert body["status"] == "done", body
+        assert body["tokens"] == first
+    finally:
+        client.post("/api/v1/serving/stop")
+
+    # Unknown mode rejected by the schema.
+    assert client.post(
+        "/api/v1/serving/start",
+        json={"model_name": "gpt-tiny", "quantize": "int4"},
+    ).status_code == 422

@@ -1,0 +1,227 @@
+"""Weight-only int8 quantization (``tpu_engine/quant.py``).
+
+Load-bearing invariants:
+
+- power-of-two scales make the quantized forward BIT-EXACT vs the
+  unquantized bf16 forward (exponent-shift scaling commutes with the
+  dot) — so the dispatch plumbing is pinned with zero tolerance;
+- random weights stay within the per-channel absmax error bound and
+  the end-to-end logits stay strongly correlated with fp32;
+- serving through :class:`ContinuousBatcher` with a quantized tree
+  emits streams identical to :func:`generate` on the same tree (the
+  serving-consistency invariant every other serving feature pins);
+- the pspec mirror shards a quantized tree the way its source params
+  were sharded (8-virtual-device CPU mesh).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_engine.generate import generate
+from tpu_engine.models import transformer as tfm
+from tpu_engine.quant import (
+    QuantWeight,
+    dequantize_weight,
+    quantize_params,
+    quantize_pspecs,
+    quantize_weight,
+)
+
+
+def _params(name="gpt-tiny", seed=0):
+    cfg = tfm.MODEL_CONFIGS[name]
+    return cfg, tfm.init_params(jax.random.PRNGKey(seed), cfg)
+
+
+def _pow2_params(params):
+    """Snap every quantization-site kernel to exactly-representable int8
+    codes times per-output-channel power-of-two scales; quantizing such a
+    kernel is lossless and its scale multiplies bf16 values exactly."""
+
+    def snap(leaf, key):
+        w = np.asarray(leaf, np.float32)
+        k = jax.random.fold_in(jax.random.PRNGKey(7), key)
+        codes = np.asarray(jax.random.randint(k, w.shape, -127, 128), np.float32)
+        # Force at least one |code| == 127 per output channel so absmax
+        # quantization recovers exactly these codes and scales.
+        codes[..., 0, :] = 127.0
+        exp = (np.asarray(
+            jax.random.randint(jax.random.fold_in(k, 1), w.shape[:-2] + (1,) + w.shape[-1:], -9, -5)
+        )).astype(np.float32)
+        return jnp.asarray(codes * np.exp2(exp), jnp.float32)
+
+    out = jax.tree.map(lambda a: a, params)  # copy structure
+    i = 0
+    layers = dict(out["layers"])
+    for name in ("q", "k", "v", "o", "gate", "up", "down", "fc", "proj"):
+        if name in layers and "kernel" in layers[name]:
+            sub = dict(layers[name])
+            sub["kernel"] = snap(sub["kernel"], i)
+            layers[name] = sub
+            i += 1
+    out["layers"] = layers
+    if "lm_head" in out:
+        out["lm_head"] = {"kernel": snap(out["lm_head"]["kernel"], 99)}
+    return out
+
+
+def test_quantize_roundtrip_error_bound():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32), jnp.float32)
+    qw = quantize_weight(w)
+    assert qw.q.dtype == jnp.int8
+    assert qw.scale.shape == (1, 32)
+    err = np.abs(np.asarray(dequantize_weight(qw) - w))
+    # Symmetric absmax: |error| <= scale/2 per element.
+    bound = np.asarray(qw.scale) / 2 + 1e-9
+    assert (err <= bound).all()
+
+
+def test_pow2_quantization_is_lossless():
+    _, params = _params()
+    p2 = _pow2_params(params)
+    w = p2["layers"]["q"]["kernel"]
+    qw = quantize_weight(w)
+    np.testing.assert_array_equal(
+        np.asarray(dequantize_weight(qw)), np.asarray(w)
+    )
+
+
+@pytest.mark.parametrize("name", ["gpt-tiny", "gpt2-tiny", "gemma-tiny",
+                                  "qwen-tiny", "moe-tiny"])
+def test_quantized_forward_bitexact_on_pow2_weights(name):
+    """With power-of-two per-channel scales, (h @ q) * s == h @ (q * s)
+    exactly in floating point — the quantized dispatch must be bit-equal
+    to the plain bf16 forward across every architecture family."""
+    cfg, params = _params(name)
+    params = _pow2_params(params)
+    qparams = quantize_params(params)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                              cfg.vocab_size, jnp.int32)
+    ref = tfm.forward(params, toks, cfg)
+    got = tfm.forward(qparams, toks, cfg)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_quantized_logits_close_to_fp32_random_weights():
+    cfg, params = _params("gpt-tiny", seed=3)
+    qparams = quantize_params(params)
+    toks = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0,
+                              cfg.vocab_size, jnp.int32)
+    ref = np.asarray(tfm.forward(params, toks, cfg,
+                                 compute_dtype=jnp.float32)).ravel()
+    got = np.asarray(tfm.forward(qparams, toks, cfg,
+                                 compute_dtype=jnp.float32)).ravel()
+    corr = np.corrcoef(ref, got)[0, 1]
+    assert corr > 0.999, f"quantized logits decorrelated: r={corr}"
+
+
+def test_quantize_params_structure_and_guards():
+    cfg, params = _params("moe-tiny")
+    qparams = quantize_params(params)
+    layers = qparams["layers"]
+    for k in ("q", "k", "v", "o", "gate", "up", "down"):
+        assert isinstance(layers[k]["kernel"], QuantWeight)
+    # Router, norms, embeddings stay full precision.
+    assert not isinstance(layers["router"]["kernel"], QuantWeight)
+    assert not isinstance(qparams["embed"]["embedding"], QuantWeight)
+    assert isinstance(qparams["lm_head"]["kernel"], QuantWeight)
+    # MoE expert scale carries the expert dim: [L, E, 1, F].
+    g = layers["gate"]["kernel"]
+    assert g.scale.shape == g.q.shape[:-2] + (1,) + g.q.shape[-1:]
+    with pytest.raises(ValueError, match="already"):
+        quantize_params(qparams)
+
+
+def test_gpt2_biases_survive_quantization():
+    cfg, params = _params("gpt2-tiny")
+    qparams = quantize_params(params)
+    assert isinstance(qparams["layers"]["fc"]["kernel"], QuantWeight)
+    np.testing.assert_array_equal(
+        np.asarray(qparams["layers"]["fc"]["bias"]),
+        np.asarray(params["layers"]["fc"]["bias"]),
+    )
+
+
+def test_generate_quantized_deterministic_and_matches_pow2():
+    cfg, params = _params()
+    params = _pow2_params(params)
+    qparams = quantize_params(params)
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (1, 8), 0,
+                                cfg.vocab_size, jnp.int32)
+    ref = generate(params, prompt, cfg, max_new_tokens=12)
+    got = generate(qparams, prompt, cfg, max_new_tokens=12)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+    again = generate(qparams, prompt, cfg, max_new_tokens=12)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(again))
+
+
+def test_moe_decode_quantized_runs():
+    cfg, params = _params("moe-tiny")
+    qparams = quantize_params(params)
+    prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    out = generate(qparams, prompt, cfg, max_new_tokens=6)
+    assert out.shape == (1, 10)
+    assert (np.asarray(out) >= 0).all()
+
+
+def test_serving_quantized_matches_generate():
+    from tpu_engine.serving import ContinuousBatcher
+
+    cfg, params = _params()
+    qparams = quantize_params(params)
+    prompts = [[3, 1, 4, 1, 5], [9, 2, 6, 5], [3, 5, 8, 9, 7, 9]]
+    N = 10
+    b = ContinuousBatcher(qparams, cfg, max_slots=2, max_len=64,
+                          chunk_steps=4)
+    ids = [b.submit(p, max_new_tokens=N) for p in prompts]
+    for _ in range(200):
+        b.step()
+        if all(b.result(i)["status"] == "done" for i in ids):
+            break
+    for p, i in zip(prompts, ids):
+        ref = generate(qparams, jnp.asarray([p], jnp.int32), cfg,
+                       max_new_tokens=N)
+        assert b.result(i)["tokens"] == np.asarray(ref)[0, len(p):].tolist()
+
+
+def test_quantized_pspec_mirror_shards_on_mesh():
+    from tpu_engine.mesh_runtime import MeshConfig, build_mesh
+    from tpu_engine.models.transformer import logical_axes
+    from tpu_engine.sharding import (
+        ShardingStage, named_shardings, param_pspecs,
+    )
+
+    cfg, params = _params()
+    qparams = quantize_params(params)
+    pspecs = param_pspecs(logical_axes(cfg), ShardingStage.FULL_PARTITIONING)
+    qspecs = quantize_pspecs(pspecs, qparams)
+    # q inherits the kernel's spec; scale drops the contracted dim.
+    qk = qspecs["layers"]["q"]["kernel"]
+    assert qk.q == pspecs["layers"]["q"]["kernel"]
+    assert qk.scale[-1] == qk.q[-1] if len(qk.q) else True
+    mesh = build_mesh(MeshConfig(fsdp=2, model=4))
+    sharded = jax.device_put(qparams, named_shardings(mesh, qspecs))
+    qkern = sharded["layers"]["q"]["kernel"]
+    # The heads dim (last) shards over "model" for q and scale alike.
+    assert qkern.q.sharding.spec[-1] == "model"
+    assert qkern.scale.sharding.spec[-1] == "model"
+
+    # Sharded serving from the quantized tree matches single-device.
+    from tpu_engine.serving import ContinuousBatcher
+
+    prompt = [2, 7, 1, 8, 2, 8]
+    N = 8
+    ref = generate(qparams, jnp.asarray([prompt], jnp.int32), cfg,
+                   max_new_tokens=N)
+    b = ContinuousBatcher(sharded, cfg, max_slots=2, max_len=64,
+                          chunk_steps=4, mesh=mesh)
+    rid = b.submit(prompt, max_new_tokens=N)
+    for _ in range(100):
+        b.step()
+        if b.result(rid)["status"] == "done":
+            break
+    assert b.result(rid)["tokens"] == np.asarray(ref)[0, len(prompt):].tolist()

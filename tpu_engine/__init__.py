@@ -55,6 +55,13 @@ from tpu_engine.generate import (
     generate,
     init_cache,
 )
+from tpu_engine.quant import (
+    QuantWeight,
+    dequantize_weight,
+    quantize_params,
+    quantize_pspecs,
+    quantize_weight,
+)
 
 __version__ = "0.1.0"
 

@@ -46,6 +46,7 @@ from tpu_engine.models.transformer import (
     embed_tokens,
     unembed,
 )
+from tpu_engine.quant import QuantWeight, dequantize_weight
 
 _NEG_INF = -1e30
 
@@ -143,10 +144,22 @@ def _moe_mlp_decode(h, layer_params, cfg: ModelConfig):
     )
     probs = jax.nn.softmax(router_logits, axis=-1)  # [B, T, E] fp32
 
-    gate = jnp.einsum("btd,edf->btef", h, layer_params["gate"]["kernel"])
-    up = jnp.einsum("btd,edf->btef", h, layer_params["up"]["kernel"])
+    def kern(name):
+        # Expert kernels may be int8 QuantWeights (weight-only quantized
+        # serving): dequantize inline — the convert+scale is an
+        # elementwise producer XLA fuses into the einsum's operand read,
+        # so HBM still sees int8 bytes (the scale's output-dim broadcast
+        # does not line up with these expert einsums' outputs, hence
+        # operand-side application here, unlike ``_proj``).
+        w = layer_params[name]["kernel"]
+        if isinstance(w, QuantWeight):
+            return dequantize_weight(w, h.dtype)
+        return w
+
+    gate = jnp.einsum("btd,edf->btef", h, kern("gate"))
+    up = jnp.einsum("btd,edf->btef", h, kern("up"))
     expert_out = jnp.einsum(
-        "btef,efd->bted", jax.nn.silu(gate) * up, layer_params["down"]["kernel"]
+        "btef,efd->bted", jax.nn.silu(gate) * up, kern("down")
     )  # [B, T, E, D]
 
     # Top-k gates, renormalised to sum to 1 (matches training's combine).

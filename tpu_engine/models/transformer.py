@@ -32,6 +32,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 
+from tpu_engine.quant import QuantWeight, dequantize_weight
+
 
 @dataclass(frozen=True)
 class ModelConfig:
@@ -526,11 +528,20 @@ def _moe_mlp(h, layer_params, cfg: ModelConfig):
     combine = combine / jnp.maximum(denom, 1e-9).astype(h.dtype)
     dispatch = (combine > 0).astype(h.dtype)                      # [B, S, E, C]
 
+    def kern(name):
+        # Expert kernels may be int8 QuantWeights (quantized eval /
+        # prefill of a serving tree): dequantize inline — XLA fuses the
+        # convert+scale into the einsum's operand read.
+        w = layer_params[name]["kernel"]
+        if isinstance(w, QuantWeight):
+            return dequantize_weight(w, h.dtype)
+        return w
+
     expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, h)         # [E, B, C, D]
-    gate = jnp.einsum("ebcd,edf->ebcf", expert_in, layer_params["gate"]["kernel"])
-    up = jnp.einsum("ebcd,edf->ebcf", expert_in, layer_params["up"]["kernel"])
+    gate = jnp.einsum("ebcd,edf->ebcf", expert_in, kern("gate"))
+    up = jnp.einsum("ebcd,edf->ebcf", expert_in, kern("up"))
     expert_out = jnp.einsum(
-        "ebcf,efd->ebcd", jax.nn.silu(gate) * up, layer_params["down"]["kernel"]
+        "ebcf,efd->ebcd", jax.nn.silu(gate) * up, kern("down")
     )
     out = jnp.einsum("bsec,ebcd->bsd", combine, expert_out)
 
@@ -547,8 +558,21 @@ def _proj(h, kernel, lora_ab=None, lora_scale=1.0, bias=None):
     """Last-dim projection ``h @ W (+ b)``, with an optional rank-sized LoRA
     term ``scale·(h@A)@B`` — the activation-side formulation: only [.., r]
     intermediates and rank-sized cotangents, never a full ΔW.
-    h: [B, S, in], kernel: [in, out] → [B, S, out]."""
-    out = jnp.einsum("bsi,io->bso", h, kernel)
+    h: [B, S, in], kernel: [in, out] → [B, S, out].
+
+    ``kernel`` may be an int8 :class:`tpu_engine.quant.QuantWeight`
+    (weight-only quantized serving): the per-output-channel scale is
+    constant along the contraction, so it applies to the matmul OUTPUT —
+    the int8→compute-dtype convert fuses into the dot's operand read and
+    the weight's HBM traffic stays int8-sized."""
+    if isinstance(kernel, QuantWeight):
+        out = jnp.einsum("bsi,io->bso", h, kernel.q.astype(h.dtype))
+        # Scale in fp32 (one rounding, at the end) — rounding the scale
+        # itself to bf16 would add a second, avoidable error; the
+        # mul+cast fuses into the matmul's output loop.
+        out = (out.astype(jnp.float32) * kernel.scale).astype(h.dtype)
+    else:
+        out = jnp.einsum("bsi,io->bso", h, kernel)
     if bias is not None:
         out = out + bias.astype(out.dtype)
     if lora_ab is not None:
@@ -752,6 +776,12 @@ def unembed(params: dict[str, Any], x: jax.Array, cfg: ModelConfig) -> jax.Array
     x = _norm(x, jax.tree.map(lambda a: a.astype(x.dtype), params["final_norm"]), cfg)
     head = (params["embed"]["embedding"].T if cfg.arch in ("gpt2", "gemma")
             else params["lm_head"]["kernel"])
+    if isinstance(head, QuantWeight):
+        logits = jnp.einsum(
+            "...sd,dv->...sv", x, head.q.astype(x.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        return logits * head.scale.astype(jnp.float32)
     return jnp.einsum(
         "...sd,dv->...sv", x, head.astype(x.dtype),
         preferred_element_type=jnp.float32,
@@ -759,10 +789,16 @@ def unembed(params: dict[str, Any], x: jax.Array, cfg: ModelConfig) -> jax.Array
 
 
 def cast_layer_stack(params: dict[str, Any], compute_dtype=jnp.bfloat16) -> dict[str, Any]:
-    """The stacked per-layer params ([L, ...] leaves) cast to compute dtype."""
+    """The stacked per-layer params ([L, ...] leaves) cast to compute dtype.
+    :class:`QuantWeight` kernels pass through untouched — their int8
+    codes cast at the matmul and their fp32 scales must NOT round to
+    bf16 (that would double the quantization error for free)."""
     return jax.tree.map(
-        lambda a: a.astype(compute_dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a,
+        lambda a: a if isinstance(a, QuantWeight)
+        else a.astype(compute_dtype) if jnp.issubdtype(a.dtype, jnp.floating)
+        else a,
         params["layers"],
+        is_leaf=lambda a: isinstance(a, QuantWeight),
     )
 
 

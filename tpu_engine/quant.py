@@ -1,0 +1,177 @@
+"""Weight-only int8 quantization for serving.
+
+The reference control plane launches DeepSpeed jobs with fp16/bf16
+configs only (``deepspeed_launcher.py``: precision knobs, no inference
+quantization — the reference has no inference path at all). Serving is
+where quantization pays on TPU: decode is weight-HBM-bandwidth-bound
+(every generated token re-reads every weight), so storing projection
+kernels as int8 halves both the weight footprint and the per-token HBM
+traffic — the same lever as the KV-cache int8 mode
+(:func:`tpu_engine.generate.init_cache` ``kv_quant``), applied to the
+other half of decode's working set. Together they serve llama-7b-class
+models on a single 16 GiB v5e chip.
+
+Scheme: symmetric per-output-channel absmax. A kernel ``[..., in, out]``
+becomes int8 codes of the same shape plus an fp32 scale ``[..., 1, out]``
+(the contracted dim reduced). Because the scale is constant along the
+contraction, it applies AFTER the matmul — ``(h @ q) * scale`` — so the
+int8→bf16 convert fuses into the dot's operand read (XLA producer
+fusion) and HBM sees only the int8 bytes. int8 magnitudes ≤ 127 are
+exact in bfloat16, so the cast loses nothing.
+
+What quantizes: the per-layer projection kernels (q/k/v/o,
+gate/up/down — incl. stacked MoE expert kernels — or fc/proj for
+GPT-2-family) and the LM head. What stays in the master dtype:
+embeddings (a lookup, and the tied head of gpt2/gemma — tied-head
+models keep a full-precision head), norm scales/biases, projection
+biases, the MoE router (fp32-critical and ~0.01% of bytes), and qk-norm
+scales.
+
+Training never sees :class:`QuantWeight` — this is a serving-side
+transform applied to a trained (or snapshot) param tree.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class QuantWeight:
+    """An int8-quantized linear kernel (a pytree — crosses jit/scan
+    boundaries; ``lax.scan`` over a stacked ``[L, ...]`` tree slices
+    ``q`` and ``scale`` in lockstep).
+
+    ``q``: int8 codes, the original kernel's shape ``[..., in, out]``.
+    ``scale``: fp32, ``[..., 1, out]`` — per-output-channel absmax/127,
+    constant along the contracted (input) dim so it can be applied to
+    the matmul OUTPUT.
+    """
+
+    q: jax.Array
+    scale: jax.Array
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return self.q.shape
+
+    @property
+    def ndim(self) -> int:
+        return self.q.ndim
+
+
+def quantize_weight(w: jax.Array, axis: int = -2) -> QuantWeight:
+    """Symmetric int8 quantization with the absmax taken over ``axis``
+    (the contracted dim — every kernel this module touches contracts its
+    second-to-last dim)."""
+    w32 = w.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(w32), axis=axis, keepdims=True) / 127.0
+    scale = jnp.maximum(scale, 1e-12)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return QuantWeight(q=q, scale=scale)
+
+
+def dequantize_weight(qw: QuantWeight, dtype=jnp.float32) -> jax.Array:
+    return (qw.q.astype(jnp.float32) * qw.scale).astype(dtype)
+
+
+# Per-layer projection names whose "kernel" quantizes. Covers the llama
+# family (q/k/v/o/gate/up/down), GPT-2 (q/k/v/o/fc/proj), and MoE
+# (stacked expert gate/up/down; the router stays fp32).
+_QUANT_LAYER_KEYS = ("q", "k", "v", "o", "gate", "up", "down", "fc", "proj")
+
+
+def _walk(params: dict[str, Any], kernel_fn) -> dict[str, Any]:
+    """Structural walk shared by the param transform and the
+    pspec mirror: applies ``kernel_fn`` to every quantization site,
+    preserving everything else (biases, norms, router, embeddings)."""
+    out = dict(params)
+    if "layers" in params:
+        layers = dict(params["layers"])
+        for name in _QUANT_LAYER_KEYS:
+            sub = layers.get(name)
+            if isinstance(sub, dict) and "kernel" in sub:
+                new_sub = dict(sub)
+                new_sub["kernel"] = kernel_fn(sub["kernel"])
+                layers[name] = new_sub
+        out["layers"] = layers
+    if "lm_head" in params:
+        head = dict(params["lm_head"])
+        head["kernel"] = kernel_fn(head["kernel"])
+        out["lm_head"] = head
+    return out
+
+
+def quantize_params(params: dict[str, Any]) -> dict[str, Any]:
+    """Param tree → serving tree with projection kernels as
+    :class:`QuantWeight`. Idempotent-hostile by design: quantizing an
+    already-quantized tree raises (re-quantization would silently
+    compound the error)."""
+
+    def quant(kernel):
+        if isinstance(kernel, QuantWeight):
+            raise ValueError("params are already int8-quantized")
+        return quantize_weight(kernel)
+
+    return _walk(params, quant)
+
+
+def quantized_param_bytes(params: dict[str, Any]) -> int:
+    """Total bytes of a (possibly quantized) param tree — int8 leaves
+    count 1 byte, scales 4; the fit benchmarks' accounting helper."""
+    return sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree.leaves(params)
+    )
+
+
+def quantize_pspecs(pspecs: dict[str, Any], qparams: dict[str, Any]) -> dict[str, Any]:
+    """Mirror a PartitionSpec tree onto a quantized param tree: at each
+    :class:`QuantWeight` site the kernel's spec applies to ``q``
+    unchanged, and the scale inherits it with the contracted dim (which
+    collapsed to size 1) unsharded. ``qparams`` supplies each site's
+    rank (a spec may have trailing dims trimmed); both trees are walked
+    in one paired traversal, so a site present in one but not the other
+    fails loudly instead of misaligning.
+    """
+
+    def mirror(spec: P, site) -> QuantWeight:
+        if not isinstance(site, QuantWeight):
+            raise ValueError(
+                "quantize_pspecs needs the QUANTIZED param tree to read "
+                f"kernel ranks (found {type(site).__name__}); call "
+                "quantize_params first"
+            )
+        axes = list(spec) + [None] * (site.ndim - len(spec))
+        axes[-2] = None  # the contracted dim is size 1 in the scale
+        return QuantWeight(q=spec, scale=P(*axes))
+
+    out = dict(pspecs)
+    if ("layers" in pspecs) != ("layers" in qparams):
+        raise ValueError("pspec and param trees disagree on 'layers'")
+    if "layers" in pspecs:
+        layers = dict(pspecs["layers"])
+        for name in _QUANT_LAYER_KEYS:
+            spec_sub, par_sub = layers.get(name), qparams["layers"].get(name)
+            has_spec = isinstance(spec_sub, dict) and "kernel" in spec_sub
+            has_par = isinstance(par_sub, dict) and "kernel" in par_sub
+            if has_spec != has_par:
+                raise ValueError(f"pspec/param trees disagree on layers.{name}")
+            if has_spec:
+                new_sub = dict(spec_sub)
+                new_sub["kernel"] = mirror(spec_sub["kernel"], par_sub["kernel"])
+                layers[name] = new_sub
+        out["layers"] = layers
+    if ("lm_head" in pspecs) != ("lm_head" in qparams):
+        raise ValueError("pspec and param trees disagree on 'lm_head'")
+    if "lm_head" in pspecs:
+        head = dict(pspecs["lm_head"])
+        head["kernel"] = mirror(head["kernel"], qparams["lm_head"]["kernel"])
+        out["lm_head"] = head
+    return out
