@@ -49,6 +49,11 @@ class ServingStartRequest(BaseModel):
     # weight-bandwidth-bound). Composable with both weight sources and
     # with sharded serving.
     quantize: Optional[str] = Field(default=None, pattern="^int8$")
+    # KV-pool quantization ("int8", same vocabulary as the training
+    # router's kv_cache knob): the slot pool stores int8 codes +
+    # per-(lane, head) scales — half the serving-pool HBM. Independent
+    # of (and composable with) weight quantization.
+    kv_cache: Optional[str] = Field(default=None, pattern="^int8$")
 
 
 class ServingSubmitRequest(BaseModel):
@@ -169,6 +174,7 @@ async def start_server(request: web.Request) -> web.Response:
                     eos_id=req.eos_id, seed=req.seed,
                     chunk_steps=req.decode_chunk_steps,
                     prefill_chunk=req.prefill_chunk, mesh=mesh,
+                    kv_quant=req.kv_cache == "int8",
                 )
             except ValueError as e:
                 raise ApiError(422, str(e))

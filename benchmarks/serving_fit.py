@@ -1,17 +1,25 @@
-"""AOT memory proof: llama-7b SERVING fits a v5e:2x2 (TP=4) slot pool.
+"""AOT memory proofs: llama-7b SERVING fits — and int8 shrinks the bill.
 
-Round-3 verdict item 1(b): the framework could *train* 7B-class models
-across chips but not serve them — a llama-7b at bf16 (~12.6 GiB weights
-+ KV pool) cannot sit on one 16 GiB v5e chip. This compiles the REAL
-serving dispatches (``tpu_engine.serving.decode_chunk`` and the chunked
-prefill forward) against a described v5e:2x2 topology with the exact
-shardings :class:`ContinuousBatcher` uses under ``mesh=`` (params TP
-over the ``model`` axis, KV pool kv-heads sharded, donated pool), and
-reports the per-device HBM the XLA compiler actually allocated.
+Round-3 verdict item 1(b) established the gap (the framework could
+*train* 7B-class models but not serve them); the round-4 bf16 proof put
+llama-7b serving on a v5e:2x2 (TP=4). The int8 rows extend it: weight-only
+int8 (``tpu_engine/quant.py``) + int8 KV pool (``init_slot_cache
+kv_quant``) roughly halve both components, putting llama-7b serving on
+a SINGLE 16 GiB v5e chip — no mesh at all.
 
-No chips required (AOT topology compile); run:
-``python benchmarks/serving_fit.py``. Prints one JSON line per program
-plus a combined-fit line.
+Each row compiles the REAL serving dispatches
+(``tpu_engine.serving.decode_chunk`` + the chunked prefill forward) with
+the exact shardings :class:`ContinuousBatcher` uses and reports the
+per-device HBM the XLA compiler actually allocated:
+
+- TP rows compile against a described v5e:2x2 topology (no chips
+  needed);
+- the single-chip row compiles against the local TPU backend (a real
+  v5e chip — skipped off-TPU) since libtpu rejects a 1x1 topology
+  descriptor.
+
+Run: ``python benchmarks/serving_fit.py``. One JSON line per program,
+plus a combined-fit line per row.
 """
 
 from __future__ import annotations
@@ -22,35 +30,69 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 GIB = 2**30
 
-# Serving shape under proof: 8 concurrent slots, 2k context each.
 MODEL = "llama-7b"
-TOPOLOGY = "v5e:2x2"
-TP = 4
-MAX_SLOTS = 8
-MAX_LEN = 2048
 CHUNK_STEPS = 16
 PREFILL_CHUNK = 256
 
+# (label, tp, weight int8?, kv int8?, slots, max_len). tp=1 compiles on
+# the local chip. The single-chip row uses 8 slots x 1024 context: the
+# decode scan double-buffers the pool within a step (layer-scan input and
+# output stacks coexist), so 8 x 2048 lands ~0.8 GiB over one chip's HBM
+# — at 8 x 1024 (or 4 x 2048, same bytes) it fits with >3 GiB headroom.
+ROWS = (
+    ("bf16_v5e_2x2_tp4", 4, False, False, 8, 2048),
+    ("int8_v5e_2x2_tp4", 4, True, True, 8, 2048),
+    ("int8_v5e_1chip", 1, True, True, 8, 1024),
+)
 
-def main() -> None:
-    from jax.experimental import topologies
 
+def _per_device_gib(shapes, shardings) -> float:
+    """Bytes of one device's shards of an abstract tree (int8 leaves
+    count 1 byte — the sharded twin of ``quantized_param_bytes``)."""
+    return sum(
+        s.dtype.itemsize * int(jnp.prod(jnp.asarray(sh.shard_shape(s.shape))))
+        for s, sh in zip(
+            jax.tree.leaves(shapes),
+            jax.tree.leaves(shardings,
+                            is_leaf=lambda x: isinstance(x, NamedSharding)),
+        )
+    ) / GIB
+
+
+def run_row(label: str, tp: int, w_int8: bool, kv_int8: bool,
+            max_slots: int, max_len: int) -> None:
+    from tpu_engine.generate import KVCache, init_cache
     from tpu_engine.mesh_runtime import MeshConfig, build_mesh
     from tpu_engine.models import transformer as tfm
+    from tpu_engine.quant import quantize_params, quantize_pspecs, \
+        quantized_param_bytes
     from tpu_engine.serving import (
         SlotCache, decode_chunk, init_slot_cache, _prefill_forward,
     )
-    from tpu_engine.generate import KVCache, init_cache
+    from tpu_engine.sharding import (
+        ShardingStage, named_shardings, param_pspecs,
+    )
 
     cfg = tfm.MODEL_CONFIGS[MODEL]
-    topo = topologies.get_topology_desc(TOPOLOGY, platform="tpu")
-    mesh = build_mesh(MeshConfig(model=TP), devices=topo.devices)
+    if tp > 1:
+        from jax.experimental import topologies
+
+        topo = topologies.get_topology_desc("v5e:2x2", platform="tpu")
+        mesh = build_mesh(MeshConfig(model=tp), devices=topo.devices)
+        topology = "v5e:2x2"
+    else:
+        if jax.devices()[0].platform != "tpu":
+            print(json.dumps({"row": label, "skipped": "needs a local TPU"}))
+            return
+        mesh = build_mesh(MeshConfig())  # 1-device mesh on the real chip
+        topology = str(jax.devices()[0].device_kind)
     rep = NamedSharding(mesh, P())
-    kv_sh = NamedSharding(mesh, P(None, None, None, "model", None))
+    model_ax = "model" if tp > 1 else None
+    kv_sh = NamedSharding(mesh, P(None, None, None, model_ax, None))
 
     def sds(tree, sharding_tree):
         return jax.tree.map(
@@ -58,38 +100,48 @@ def main() -> None:
             tree, sharding_tree,
         )
 
-    # Params: bf16 serving weights, TP/FSDP-sharded exactly as a trained
-    # job's snapshot (fsdp axis is size 1 here — pure TP serving).
-    from tpu_engine.sharding import (
-        ShardingStage, named_shardings, param_pspecs,
-    )
-    p_shape = jax.eval_shape(
+    # Params: bf16 (or int8-quantized) serving weights, sharded exactly as
+    # the batcher receives them.
+    bf16_shape = jax.eval_shape(
         partial(tfm.init_params, cfg=cfg, dtype=jnp.bfloat16),
         jax.random.PRNGKey(0),
     )
-    p_sh = named_shardings(
-        mesh, param_pspecs(tfm.logical_axes(cfg), ShardingStage.FULL_PARTITIONING)
-    )
+    p_shape = bf16_shape
+    p_specs = param_pspecs(tfm.logical_axes(cfg),
+                           ShardingStage.FULL_PARTITIONING)
+    if w_int8:
+        p_shape = jax.eval_shape(quantize_params, bf16_shape)
+        p_specs = quantize_pspecs(p_specs, p_shape)
+        assert quantized_param_bytes(p_shape) < \
+            0.55 * quantized_param_bytes(bf16_shape), \
+            "int8 tree should be < 55% of the bf16 tree"
+    p_sh = named_shardings(mesh, p_specs)
     params_abs = sds(p_shape, p_sh)
-    params_gib = sum(
-        s.dtype.itemsize * int(jnp.prod(jnp.asarray(sh.shard_shape(s.shape))))
-        for s, sh in zip(jax.tree.leaves(p_shape), jax.tree.leaves(
-            p_sh, is_leaf=lambda x: isinstance(x, NamedSharding)))
-    ) / GIB
+    params_gib = _per_device_gib(p_shape, p_sh)
 
     # The slot pool, sharded as ContinuousBatcher shards it.
     cache_shape = jax.eval_shape(
-        partial(init_slot_cache, cfg, MAX_SLOTS, MAX_LEN, jnp.bfloat16)
+        partial(init_slot_cache, cfg, max_slots, max_len, jnp.bfloat16,
+                kv_quant=kv_int8)
     )
-    cache_sh = SlotCache(k=kv_sh, v=kv_sh, lengths=rep, pos=None, ring=False)
+    cache_sh = SlotCache(
+        k=kv_sh, v=kv_sh, lengths=rep, pos=None, ring=False,
+        k_scale=kv_sh if kv_int8 else None,
+        v_scale=kv_sh if kv_int8 else None,
+    )
     cache_abs = sds(cache_shape, cache_sh)
-    pool_gib = 2 * (
-        cache_shape.k.dtype.itemsize
-        * int(jnp.prod(jnp.asarray(kv_sh.shard_shape(cache_shape.k.shape))))
-    ) / GIB
+    pool_gib = _per_device_gib(cache_shape, cache_sh)
 
-    vec = lambda dt: jax.ShapeDtypeStruct((MAX_SLOTS,), dt, sharding=rep)
+    vec = lambda dt: jax.ShapeDtypeStruct((max_slots,), dt, sharding=rep)
     key_abs = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=rep)
+    c1_shape = jax.eval_shape(
+        partial(init_cache, cfg, 1, max_len, dtype=jnp.bfloat16,
+                kv_quant=kv_int8)
+    )
+    c1_sh = KVCache(k=kv_sh, v=kv_sh, pos=rep, length=rep, ring=False,
+                    k_scale=kv_sh if kv_int8 else None,
+                    v_scale=kv_sh if kv_int8 else None)
+    c1_gib = _per_device_gib(c1_shape, c1_sh)
 
     results = {}
     for name, build in (
@@ -107,11 +159,7 @@ def main() -> None:
         ).lower(
             params_abs,
             jax.ShapeDtypeStruct((1, PREFILL_CHUNK), jnp.int32, sharding=rep),
-            sds(
-                jax.eval_shape(partial(init_cache, cfg, 1, MAX_LEN,
-                                       dtype=jnp.bfloat16)),
-                KVCache(k=kv_sh, v=kv_sh, pos=rep, length=rep, ring=False),
-            ),
+            sds(c1_shape, c1_sh),
             jax.ShapeDtypeStruct((), jnp.int32, sharding=rep),
         )),
     ):
@@ -122,8 +170,9 @@ def main() -> None:
         temp_gib = ma.temp_size_in_bytes / GIB
         results[name] = dict(args=args_gib, temp=temp_gib)
         print(json.dumps({
-            "program": name, "model": MODEL, "topology": TOPOLOGY, "tp": TP,
-            "slots": MAX_SLOTS, "max_len": MAX_LEN,
+            "row": label, "program": name, "model": MODEL,
+            "topology": topology, "tp": tp,
+            "slots": max_slots, "max_len": max_len,
             "device_args_gib": round(args_gib, 2),
             "device_temp_gib": round(temp_gib, 2),
             "device_peak_gib": round(args_gib + temp_gib, 2),
@@ -133,15 +182,12 @@ def main() -> None:
     # Steady-state residency: params + pool + one prefill c1 cache + the
     # larger of the two programs' temporaries (they never run concurrently
     # — the engine thread serialises dispatches).
-    c1_gib = 2 * (
-        2 * cfg.n_layers * 1 * MAX_LEN * cfg.n_kv_heads * cfg.head_dim // TP
-    ) / GIB
     combined = (
         results["decode_chunk"]["args"] + c1_gib
         + max(results["decode_chunk"]["temp"], results["prefill_chunk"]["temp"])
     )
     print(json.dumps({
-        "metric": "llama7b_serving_fit_v5e_2x2_tp4",
+        "metric": f"llama7b_serving_fit_{label}",
         "params_gib_per_device": round(params_gib, 2),
         "kv_pool_gib_per_device": round(pool_gib, 2),
         "prefill_c1_gib_per_device": round(c1_gib, 2),
@@ -149,6 +195,11 @@ def main() -> None:
         "fits_16gib_hbm": combined < 16.0,
         "headroom_gib": round(16.0 - combined, 2),
     }))
+
+
+def main() -> None:
+    for row in ROWS:
+        run_row(*row)
 
 
 if __name__ == "__main__":

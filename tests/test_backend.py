@@ -835,9 +835,11 @@ def test_serving_quantized_over_http(client):
     the sharded variant composes (quantized pspec mirror on the mesh)."""
     r = client.post("/api/v1/serving/start",
                     json={"model_name": "gpt-tiny", "max_slots": 2,
-                          "max_len": 64, "quantize": "int8"})
+                          "max_len": 64, "quantize": "int8",
+                          "kv_cache": "int8"})
     assert r.status_code == 200, r.text
     assert r.json()["quantize"] == "int8"
+    assert client.get("/api/v1/serving/stats").json()["kv_quant"] is True
     try:
         rid = client.post(
             "/api/v1/serving/submit",

@@ -40,11 +40,17 @@ def _params(name="gpt-tiny", seed=0):
 def _pow2_params(params):
     """Snap every quantization-site kernel to exactly-representable int8
     codes times per-output-channel power-of-two scales; quantizing such a
-    kernel is lossless and its scale multiplies bf16 values exactly."""
+    kernel is lossless and its scale multiplies bf16 values exactly.
+    Sites come from the PRODUCTION walker (``quant._walk``) so this test
+    keeps pinning every kernel the transform actually quantizes."""
+    from tpu_engine.quant import _walk
 
-    def snap(leaf, key):
+    counter = [0]
+
+    def snap(leaf):
         w = np.asarray(leaf, np.float32)
-        k = jax.random.fold_in(jax.random.PRNGKey(7), key)
+        counter[0] += 1
+        k = jax.random.fold_in(jax.random.PRNGKey(7), counter[0])
         codes = np.asarray(jax.random.randint(k, w.shape, -127, 128), np.float32)
         # Force at least one |code| == 127 per output channel so absmax
         # quantization recovers exactly these codes and scales.
@@ -54,19 +60,7 @@ def _pow2_params(params):
         )).astype(np.float32)
         return jnp.asarray(codes * np.exp2(exp), jnp.float32)
 
-    out = jax.tree.map(lambda a: a, params)  # copy structure
-    i = 0
-    layers = dict(out["layers"])
-    for name in ("q", "k", "v", "o", "gate", "up", "down", "fc", "proj"):
-        if name in layers and "kernel" in layers[name]:
-            sub = dict(layers[name])
-            sub["kernel"] = snap(sub["kernel"], i)
-            layers[name] = sub
-            i += 1
-    out["layers"] = layers
-    if "lm_head" in out:
-        out["lm_head"] = {"kernel": snap(out["lm_head"]["kernel"], 99)}
-    return out
+    return _walk(params, snap)
 
 
 def test_quantize_roundtrip_error_bound():

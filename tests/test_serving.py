@@ -418,3 +418,140 @@ def test_sliding_window_model_serving_matches_generate():
             break
         srv.step()
     assert srv.result(r3)["tokens"] == _ref_greedy(params, cfg, p3, 8)
+
+
+def _ref_greedy_kvq(params, cfg, prompt, n):
+    out = generate(params, jnp.asarray([prompt], jnp.int32), cfg,
+                   max_new_tokens=n, compute_dtype=jnp.float32,
+                   kv_quant=True)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def test_kv_quant_pool_matches_generate_kv_quant():
+    """int8 KV slot pool (round 4): codes + per-(lane, head) scales ride
+    the same per-row scatters as the bf16 pool, and streams match
+    generate(kv_quant=True) exactly on CPU — the quantization math is
+    per-row, so pool vs single-row layout cannot change the codes."""
+    cfg = tfm.MODEL_CONFIGS["gpt-tiny"]
+    params = tfm.init_params(jax.random.PRNGKey(3), cfg, dtype=jnp.float32)
+    srv = ContinuousBatcher(params, cfg, max_slots=2, max_len=96,
+                            compute_dtype=jnp.float32, prefill_pad_to=16,
+                            chunk_steps=4, kv_quant=True)
+    assert srv._cache.quantized and srv._cache.k.dtype == jnp.int8
+    assert srv.stats()["kv_quant"] is True
+    rng = np.random.default_rng(21)
+    prompts = [rng.integers(1, cfg.vocab_size, n).tolist() for n in (5, 11, 3)]
+    rids = [srv.submit(p, max_new_tokens=m)
+            for p, m in zip(prompts, (6, 9, 4))]
+    for _ in range(40):
+        if all(srv.result(r)["status"] == "done" for r in rids):
+            break
+        srv.step()
+    for rid, p, m in zip(rids, prompts, (6, 9, 4)):
+        assert srv.result(rid)["tokens"] == _ref_greedy_kvq(params, cfg, p, m)
+
+
+def test_kv_quant_composes_with_weight_quant_and_sampling():
+    from tpu_engine.quant import quantize_params
+
+    cfg = tfm.MODEL_CONFIGS["gpt-tiny"]
+    params = tfm.init_params(jax.random.PRNGKey(3), cfg, dtype=jnp.float32)
+    qparams = quantize_params(params)
+    srv = ContinuousBatcher(qparams, cfg, max_slots=2, max_len=96,
+                            compute_dtype=jnp.float32, prefill_pad_to=16,
+                            chunk_steps=4, kv_quant=True)
+    p = [3, 1, 4, 1, 5, 9]
+    rid = srv.submit(p, max_new_tokens=8)
+    rs = srv.submit([2, 7, 1], max_new_tokens=6, temperature=0.7)
+    for _ in range(40):
+        if all(srv.result(r)["status"] == "done" for r in (rid, rs)):
+            break
+        srv.step()
+    assert srv.result(rid)["tokens"] == _ref_greedy_kvq(qparams, cfg, p, 8)
+    assert len(srv.result(rs)["tokens"]) == 6
+    # Sampled stream is reproducible on a fresh server with the same seed
+    # (same submission order: the per-request key folds the request id).
+    srv2 = ContinuousBatcher(qparams, cfg, max_slots=2, max_len=96,
+                             compute_dtype=jnp.float32, prefill_pad_to=16,
+                             chunk_steps=4, kv_quant=True)
+    srv2.submit(p, max_new_tokens=8)
+    rs2 = srv2.submit([2, 7, 1], max_new_tokens=6, temperature=0.7)
+    for _ in range(40):
+        if srv2.result(rs2)["status"] == "done":
+            break
+        srv2.step()
+    assert srv2.result(rs2)["tokens"] == srv.result(rs)["tokens"]
+
+
+def test_kv_quant_ring_pool_serving():
+    """int8 pool composes with the sliding-window ring: scale lanes wrap
+    with their code lanes."""
+    cfg = tfm.MODEL_CONFIGS["gpt-tiny"].with_(sliding_window=12)
+    params = tfm.init_params(jax.random.PRNGKey(3), cfg, dtype=jnp.float32)
+    srv = ContinuousBatcher(params, cfg, max_slots=2, max_len=128,
+                            compute_dtype=jnp.float32, prefill_pad_to=16,
+                            prefill_chunk=16, chunk_steps=3, kv_quant=True)
+    assert srv._cache.ring and srv._cache.quantized
+    rng = np.random.default_rng(9)
+    p1 = rng.integers(1, cfg.vocab_size, 40).tolist()
+    r1 = srv.submit(p1, max_new_tokens=20)
+    for _ in range(60):
+        if srv.result(r1)["status"] == "done":
+            break
+        srv.step()
+    assert srv.result(r1)["tokens"] == _ref_greedy_kvq(params, cfg, p1, 20)
+
+
+def test_kv_quant_sharded_pool():
+    from tpu_engine.mesh_runtime import MeshConfig, build_mesh
+    from tpu_engine.models.transformer import logical_axes
+    from tpu_engine.sharding import (
+        ShardingStage, named_shardings, param_pspecs,
+    )
+
+    cfg = tfm.MODEL_CONFIGS["gpt-tiny"]
+    params = tfm.init_params(jax.random.PRNGKey(3), cfg, dtype=jnp.float32)
+    mesh = build_mesh(MeshConfig(fsdp=2, model=4))
+    sharded = jax.device_put(params, named_shardings(
+        mesh, param_pspecs(logical_axes(cfg), ShardingStage.FULL_PARTITIONING)
+    ))
+    srv = ContinuousBatcher(sharded, cfg, max_slots=2, max_len=96,
+                            compute_dtype=jnp.float32, prefill_pad_to=16,
+                            chunk_steps=3, mesh=mesh, kv_quant=True)
+    assert srv._cache.k_scale.sharding.spec == jax.sharding.PartitionSpec(
+        None, None, None, "model", None
+    )
+    p = [5, 11, 3, 8, 2]
+    rid = srv.submit(p, max_new_tokens=7)
+    for _ in range(40):
+        if srv.result(rid)["status"] == "done":
+            break
+        srv.step()
+    assert srv.result(rid)["tokens"] == _ref_greedy_kvq(params, cfg, p, 7)
+
+
+def test_kv_quant_speculative_serving():
+    """Speculative rounds on a quantized target pool: the verify write
+    quantizes T=gamma+1 rows at once and the per-row rewind leaves stale
+    scale lanes masked until overwritten — streams must still match plain
+    greedy kv-quant serving."""
+    cfg = tfm.MODEL_CONFIGS["gpt-tiny"]
+    params = tfm.init_params(jax.random.PRNGKey(3), cfg, dtype=jnp.float32)
+    prompts = [[3, 1, 4, 1, 5], [9, 2, 6]]
+    plain = ContinuousBatcher(params, cfg, max_slots=2, max_len=64,
+                              compute_dtype=jnp.float32, prefill_pad_to=16,
+                              chunk_steps=2, kv_quant=True)
+    spec = ContinuousBatcher(params, cfg, max_slots=2, max_len=64,
+                             compute_dtype=jnp.float32, prefill_pad_to=16,
+                             draft_params=params, draft_cfg=cfg, spec_gamma=3,
+                             kv_quant=True)
+    streams = {}
+    for srv in (plain, spec):
+        rids = [srv.submit(p, max_new_tokens=8) for p in prompts]
+        for _ in range(60):
+            if all(srv.result(r)["status"] == "done" for r in rids):
+                break
+            srv.step()
+        streams[srv] = [srv.result(r)["tokens"] for r in rids]
+    assert streams[plain] == streams[spec]
+    assert spec.stats()["spec_accept_rate"] > 0.9  # draft == target

@@ -46,6 +46,7 @@ background thread).
 from __future__ import annotations
 
 import collections
+import dataclasses
 import itertools
 import threading
 import time
@@ -92,29 +93,46 @@ class SlotCache:
     lengths: jax.Array  # [B] int32 — resident tokens per slot (0 = empty)
     pos: Optional[jax.Array] = None  # [B, S] int32, ring pools only
     ring: bool = field(default=False, metadata=dict(static=True))
+    # int8-quantized pool (``init_slot_cache(kv_quant=True)``): k/v hold
+    # int8 codes and these hold the per-(lane, kv-head) absmax/127
+    # scales [L, B, S, KV, 1] — the slot-pool twin of
+    # :class:`generate.KVCache`'s quantized mode. Halves the pool's HBM;
+    # dequantisation fuses into the attention reads.
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
 
     @property
     def n_lanes(self) -> int:
         return self.k.shape[2]
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
 
 def init_slot_cache(
     cfg: ModelConfig, slots: int, max_len: int, dtype=jnp.bfloat16,
-    prefill_chunk: Optional[int] = None,
+    prefill_chunk: Optional[int] = None, kv_quant: bool = False,
 ) -> SlotCache:
     """Allocate the serving pool. For sliding-window models the pool is a
     per-row ring of ``window + prefill_chunk - 1`` lanes (a prefill chunk
     of T tokens needs the window behind its oldest token resident) — the
-    slot-pool analogue of :func:`generate.init_cache`'s ring mode."""
+    slot-pool analogue of :func:`generate.init_cache`'s ring mode.
+    ``kv_quant=True`` stores the pool as int8 codes + per-(lane, kv-head)
+    scales — half the serving-pool HBM."""
     lanes = ring_lanes(cfg, max_len, prefill_chunk)
     ring = lanes < max_len
     shape = (cfg.n_layers, slots, lanes, cfg.n_kv_heads, cfg.head_dim)
+    store_dtype = jnp.int8 if kv_quant else dtype
+    scale_shape = shape[:-1] + (1,)
     return SlotCache(
-        k=jnp.zeros(shape, dtype),
-        v=jnp.zeros(shape, dtype),
+        k=jnp.zeros(shape, store_dtype),
+        v=jnp.zeros(shape, store_dtype),
         lengths=jnp.zeros((slots,), jnp.int32),
         pos=jnp.full((slots, lanes), -1, jnp.int32) if ring else None,
         ring=ring,
+        k_scale=jnp.zeros(scale_shape, jnp.float32) if kv_quant else None,
+        v_scale=jnp.zeros(scale_shape, jnp.float32) if kv_quant else None,
     )
 
 
@@ -166,23 +184,31 @@ def decode_step(
     def write(cache_arr, new_rows):
         # Per-row scatter at each slot's own lane (T = 1). Out-of-bounds
         # lanes (a finished-mid-chunk row running past capacity) drop.
+        # Serves the scale arrays of a quantized pool too (same leading
+        # [B, S, KV] dims, trailing 1 instead of HD).
         return cache_arr.at[rows, lane].set(
             new_rows[:, 0].astype(cache_arr.dtype)
         )
 
-    def body(x, xs):
-        lp, k_c, v_c = xs                                   # k_c [B,S,KV,HD]
-        x, k_c, v_c, _, _ = _decode_block(
-            x, lp, k_c, v_c, write, slot_pos, positions, cfg
-        )
-        return x, (k_c, v_c)
+    scales = (cache.k_scale, cache.v_scale) if cache.quantized else ()
 
-    x, (k_new, v_new) = lax.scan(body, x, (layer_stack, cache.k, cache.v))
+    def body(x, xs):
+        lp, k_c, v_c, *scale_cs = xs                        # k_c [B,S,KV,HD]
+        x, k_c, v_c, ks_c, vs_c = _decode_block(
+            x, lp, k_c, v_c, write, slot_pos, positions, cfg,
+            k_scale_c=scale_cs[0] if scale_cs else None,
+            v_scale_c=scale_cs[1] if scale_cs else None,
+        )
+        return x, (k_c, v_c) + ((ks_c, vs_c) if scale_cs else ())
+
+    x, out = lax.scan(body, x, (layer_stack, cache.k, cache.v) + scales)
+    k_new, v_new = out[0], out[1]
+    ks_new, vs_new = (out[2], out[3]) if cache.quantized else (None, None)
     logits = unembed(params, x, cfg)[:, 0]                  # [B, V] fp32
     new_cache = SlotCache(
         k=k_new, v=v_new,
         lengths=cache.lengths + active.astype(jnp.int32),
-        pos=pos_new, ring=cache.ring,
+        pos=pos_new, ring=cache.ring, k_scale=ks_new, v_scale=vs_new,
     )
     return logits, new_cache
 
@@ -280,24 +306,30 @@ def decode_verify(
         jnp.arange(S, dtype=jnp.int32)[None, :], (B, S)
     )
 
-    def write(cache_arr, new_rows):  # new_rows [B, T, KV, HD]
+    def write(cache_arr, new_rows):  # new_rows [B, T, KV, HD] (or [.., 1])
         return cache_arr.at[rows[:, None], positions].set(
             new_rows.astype(cache_arr.dtype)
         )
 
-    def body(x, xs):
-        lp, k_c, v_c = xs
-        x, k_c, v_c, _, _ = _decode_block(
-            x, lp, k_c, v_c, write, slot_pos, positions, cfg
-        )
-        return x, (k_c, v_c)
+    scales = (cache.k_scale, cache.v_scale) if cache.quantized else ()
 
-    x, (k_new, v_new) = lax.scan(body, x, (layer_stack, cache.k, cache.v))
+    def body(x, xs):
+        lp, k_c, v_c, *scale_cs = xs
+        x, k_c, v_c, ks_c, vs_c = _decode_block(
+            x, lp, k_c, v_c, write, slot_pos, positions, cfg,
+            k_scale_c=scale_cs[0] if scale_cs else None,
+            v_scale_c=scale_cs[1] if scale_cs else None,
+        )
+        return x, (k_c, v_c) + ((ks_c, vs_c) if scale_cs else ())
+
+    x, out = lax.scan(body, x, (layer_stack, cache.k, cache.v) + scales)
+    k_new, v_new = out[0], out[1]
+    ks_new, vs_new = (out[2], out[3]) if cache.quantized else (None, None)
     logits = unembed(params, x, cfg)  # [B, T, V] fp32
     new_cache = SlotCache(
         k=k_new, v=v_new,
         lengths=cache.lengths + T * active.astype(jnp.int32),
-        pos=None, ring=False,
+        pos=None, ring=False, k_scale=ks_new, v_scale=vs_new,
     )
     return logits, new_cache
 
@@ -358,13 +390,10 @@ def speculative_round(
     # Rewind both caches to the accepted frontier: resident = everything
     # except the new last token (tgt[:, n_acc-1]).
     overshoot = jnp.where(active, (gamma + 1) - n_acc, 0).astype(jnp.int32)
-    cache = SlotCache(k=cache.k, v=cache.v,
-                      lengths=cache.lengths - overshoot,
-                      pos=None, ring=False)
+    cache = dataclasses.replace(cache, lengths=cache.lengths - overshoot)
     # The draft ran gamma+1 steps; its frontier rewinds to match exactly.
-    draft_cache = SlotCache(k=draft_cache.k, v=draft_cache.v,
-                            lengths=draft_cache.lengths - overshoot,
-                            pos=None, ring=False)
+    draft_cache = dataclasses.replace(
+        draft_cache, lengths=draft_cache.lengths - overshoot)
     return tgt, n_acc, cache, draft_cache
 
 
@@ -435,6 +464,7 @@ class ContinuousBatcher:
         draft_params: Any = None,
         draft_cfg: Optional[ModelConfig] = None,
         spec_gamma: int = 4,
+        kv_quant: bool = False,
     ):
         self.params = params
         self.cfg = cfg
@@ -451,10 +481,11 @@ class ContinuousBatcher:
         )
         self.chunk_steps = max(int(chunk_steps), 1)
         self.mesh = mesh
+        self.kv_quant = bool(kv_quant)
         self._compute_dtype = compute_dtype
         self._cache = init_slot_cache(
             cfg, self.max_slots, self.max_len, compute_dtype,
-            prefill_chunk=self.prefill_chunk,
+            prefill_chunk=self.prefill_chunk, kv_quant=self.kv_quant,
         )
         self._base_key = jax.random.PRNGKey(seed)
 
@@ -470,6 +501,9 @@ class ContinuousBatcher:
             cache_sh = SlotCache(
                 k=kv_sh, v=kv_sh, lengths=rep,
                 pos=rep if self._cache.ring else None, ring=self._cache.ring,
+                # Scales shard with their codes (kv-heads over "model").
+                k_scale=kv_sh if self.kv_quant else None,
+                v_scale=kv_sh if self.kv_quant else None,
             )
             self._cache = jax.device_put(self._cache, cache_sh)
             self._base_key = jax.device_put(self._base_key, rep)
@@ -657,6 +691,7 @@ class ContinuousBatcher:
                 "chunk_steps": self.chunk_steps,
                 "sharded": self.mesh is not None,
                 "speculative": self._draft_params is not None,
+                "kv_quant": self.kv_quant,
             }
             if self._spec_rounds:
                 # Mean accepted tokens per draft round, of gamma+1 possible.
@@ -683,17 +718,21 @@ class ContinuousBatcher:
             # exactly the pool's lane count so positions map to the same
             # lanes (both write at position % S).
             c1 = init_cache(self.cfg, 1, self.max_len, dtype=self._compute_dtype,
-                            max_chunk=self.prefill_chunk)
+                            max_chunk=self.prefill_chunk,
+                            kv_quant=self.kv_quant)
         else:
             # Bucket the cache size to prefill_chunk multiples so compiled
             # (chunk_shape, cache_shape) pairs stay few.
             M = min(-(-pad // self.prefill_chunk) * self.prefill_chunk,
                     self.max_len)
             M = max(M, pad)
-            c1 = init_cache(self.cfg, 1, M, dtype=self._compute_dtype)
+            c1 = init_cache(self.cfg, 1, M, dtype=self._compute_dtype,
+                            kv_quant=self.kv_quant)
         if self._kv_sh is not None:
             c1_sh = KVCache(k=self._kv_sh, v=self._kv_sh, pos=self._rep,
-                            length=self._rep, ring=c1.ring)
+                            length=self._rep, ring=c1.ring,
+                            k_scale=self._kv_sh if self.kv_quant else None,
+                            v_scale=self._kv_sh if self.kv_quant else None)
             c1 = jax.device_put(c1, c1_sh)
         dc1 = None
         if self._draft_params is not None:
@@ -953,6 +992,13 @@ def _insert_prefill(cache: SlotCache, c1: KVCache, slot, true_len, ring: bool):
     v = lax.dynamic_update_slice(
         cache.v, c1.v.astype(cache.v.dtype), (0, slot, 0, 0, 0)
     )
+    ks, vs = cache.k_scale, cache.v_scale
+    if cache.quantized:
+        # A quantized pool requires a quantized ingestion cache (the
+        # batcher allocates both from one flag); codes and scales copy
+        # with the same slice placement.
+        ks = lax.dynamic_update_slice(ks, c1.k_scale, (0, slot, 0, 0, 0))
+        vs = lax.dynamic_update_slice(vs, c1.v_scale, (0, slot, 0, 0, 0))
     pos = cache.pos
     if ring:
         # Lane-aligned by construction (c1 ring size == pool lane count).
@@ -960,7 +1006,7 @@ def _insert_prefill(cache: SlotCache, c1: KVCache, slot, true_len, ring: bool):
     return SlotCache(
         k=k, v=v,
         lengths=cache.lengths.at[slot].set(true_len.astype(jnp.int32)),
-        pos=pos, ring=cache.ring,
+        pos=pos, ring=cache.ring, k_scale=ks, v_scale=vs,
     )
 
 
@@ -970,5 +1016,6 @@ def _reset_slot(cache: SlotCache, slot):
         pos = pos.at[slot].set(-1)
     return SlotCache(
         k=cache.k, v=cache.v, lengths=cache.lengths.at[slot].set(0),
-        pos=pos, ring=cache.ring,
+        pos=pos, ring=cache.ring, k_scale=cache.k_scale,
+        v_scale=cache.v_scale,
     )
