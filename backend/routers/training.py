@@ -53,6 +53,9 @@ class TrainingLaunchRequest(BaseModel):
     grad_clip_norm: float = Field(default=1.0, gt=0)
     optimizer_offload: str = "none"
     param_offload: str = "none"
+    # optimizer_offload="disk" only: spill directory for the memmap
+    # optimizer state (the reference's nvme_path).
+    optimizer_spill_dir: Optional[str] = None
     grad_allreduce_dtype: Optional[str] = None
     attention_impl: Literal["auto", "xla", "flash", "ring", "ulysses"] = "auto"
     # "auto" resolves at build time: 1f1b when the microbatch count
@@ -142,6 +145,7 @@ def _to_config(req: TrainingLaunchRequest) -> TPUTrainConfig:
             grad_clip_norm=req.grad_clip_norm,
             optimizer_offload=OffloadDevice(req.optimizer_offload),
             param_offload=OffloadDevice(req.param_offload),
+            optimizer_spill_dir=req.optimizer_spill_dir,
             grad_allreduce_dtype=(
                 Precision(req.grad_allreduce_dtype)
                 if req.grad_allreduce_dtype

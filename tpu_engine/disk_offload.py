@@ -1,0 +1,350 @@
+"""Disk-tier optimizer-state offload — the NVMe-offload analogue.
+
+The reference exposes ``OffloadDevice.NVME`` with pin/buffer knobs
+(``deepspeed_launcher.py:29-33,197-212``): optimizer state pages to
+local NVMe and a CPU-side Adam applies the update. SURVEY §2.3 noted
+TPU-VMs have no NVMe *API* equivalent — but they do have local disk,
+and the capability the knob buys (training a model whose optimizer
+state exceeds host+device memory) ports directly:
+
+- **master params, mu, nu live in fp32 memory-mapped files** under a
+  spill directory — zero bytes of HBM, zero bytes of *resident* host
+  RAM beyond the slab being updated (the page cache does the staging,
+  and ``posix_fadvise`` drives it);
+- **the device runs only forward/backward** on compute-dtype (bf16)
+  params — the jitted step computes and clips gradients and never sees
+  optimizer state at all;
+- **a fused host AdamW** walks the gradient leaves one at a time:
+  prefetch leaf i+1's slabs (``POSIX_FADV_WILLNEED`` — kernel
+  readahead runs while leaf i updates), update leaf i in place on the
+  memmap, write the new compute-dtype leaf back to device, then drop
+  leaf i's pages (``POSIX_FADV_DONTNEED``) so the spill never grows
+  the process's resident set.
+
+The update math mirrors this repo's optax chain exactly
+(``train.make_optimizer``: clip_by_global_norm on device →
+scale_by_adam(b1, b2, eps=1e-8) → add_decayed_weights(wd, kernel-mask)
+→ ``-lr`` apply), so disk-tier training is step-for-step comparable to
+the in-memory path — pinned by ``tests/test_disk_offload.py``.
+
+Persistence is a feature, not an accident: the spill directory survives
+the process, so a warm restart re-attaches to the exact optimizer
+moments (``attach=True`` path) — the disk tier doubles as an optimizer-
+state checkpoint that costs no save step.
+
+Single-process scope: every shard of every gradient must be addressable
+to this host (``build_train_program`` validates). Multi-host disk spill
+would shard the slab files per process — out of scope until a config
+needs it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+_META = "disk_adamw.json"
+
+
+def _advise(f, advice: int) -> None:
+    """Best-effort fadvise on an open memmap's file descriptor."""
+    try:
+        size = os.fstat(f.fileno()).st_size
+        os.posix_fadvise(f.fileno(), 0, size, advice)
+    except (OSError, AttributeError):  # non-POSIX or closed — advisory only
+        pass
+
+
+@dataclass
+class _Slab:
+    """One parameter leaf's on-disk state: master + mu + nu memmaps."""
+
+    path: str
+    shape: tuple[int, ...]
+    decay: bool
+    master: np.memmap
+    mu: np.memmap
+    nu: np.memmap
+
+    def files(self):
+        return (self.master, self.mu, self.nu)
+
+
+class DiskAdamW:
+    """AdamW whose entire state lives in fp32 memmaps under ``spill_dir``.
+
+    ``initialize(params_host)`` writes masters from a host tree and
+    zeroes the moments; if a matching spill already exists (same leaf
+    paths, shapes and hyperparameters) it re-attaches instead — the
+    moments persist across process restarts. ``update`` applies one
+    AdamW step in place, emitting each new master leaf as it lands.
+    """
+
+    def __init__(self, spill_dir: str, *, b1: float, b2: float,
+                 eps: float = 1e-8, weight_decay: float = 0.0):
+        self.dir = spill_dir
+        self.b1, self.b2, self.eps = float(b1), float(b2), float(eps)
+        self.weight_decay = float(weight_decay)
+        self.slabs: dict[str, _Slab] = {}
+        self.attached = False
+        # The step whose update the spill last absorbed (persisted in the
+        # meta file): lets a restart detect that the restored train state
+        # is OLDER than the spill (a rollback) and reseed masters from it.
+        self.step_on_disk: Optional[int] = None
+        # Adam bias-correction counter — SEPARATE from the train step:
+        # the LR schedule must keep the restored step across a reseed,
+        # while the zeroed moments must bias-correct from t=1 again.
+        self.moment_steps: int = 0
+
+    # -- layout --------------------------------------------------------------
+
+    def _meta(self) -> dict[str, Any]:
+        return {
+            "b1": self.b1, "b2": self.b2, "eps": self.eps,
+            "weight_decay": self.weight_decay,
+            "step": self.step_on_disk,
+            "moment_steps": self.moment_steps,
+            "leaves": {
+                p: {"shape": list(s.shape), "decay": s.decay}
+                for p, s in self.slabs.items()
+            },
+        }
+
+    def _write_meta(self, extra: Optional[dict[str, Any]] = None) -> None:
+        """Crash-atomic meta write (tmp + rename): a kill mid-write must
+        never leave truncated JSON — that would fail every later attach
+        instead of being refused like any other torn spill."""
+        meta = self._meta()
+        if extra:
+            meta.update(extra)
+        path = os.path.join(self.dir, _META)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, path)
+
+    def _slab_path(self, leaf_path: str, kind: str) -> str:
+        safe = leaf_path.replace("/", "__")
+        return os.path.join(self.dir, f"{safe}.{kind}.f32")
+
+    def _open_slabs(self, shapes: dict[str, tuple[int, ...]],
+                    decay_mask: dict[str, bool], mode: str) -> None:
+        for path, shape in shapes.items():
+            self.slabs[path] = _Slab(
+                path=path, shape=tuple(shape), decay=bool(decay_mask[path]),
+                master=np.memmap(self._slab_path(path, "master"), np.float32,
+                                 mode, shape=tuple(shape)),
+                mu=np.memmap(self._slab_path(path, "mu"), np.float32, mode,
+                             shape=tuple(shape)),
+                nu=np.memmap(self._slab_path(path, "nu"), np.float32, mode,
+                             shape=tuple(shape)),
+            )
+
+    def try_attach(self, shapes: dict[str, Any],
+                   decay_mask: dict[str, bool]) -> bool:
+        """Attach to an existing spill iff its meta matches this layout
+        and hyperparameters AND the spill is clean (no update died
+        mid-walk — a torn spill holds mixed-step state and is discarded
+        rather than silently resumed). Needs only SHAPES, so a warm
+        restart never materialises a throwaway random init."""
+        meta_path = os.path.join(self.dir, _META)
+        if not os.path.exists(meta_path):
+            return False
+        try:
+            with open(meta_path) as f:
+                have = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            return False  # unreadable meta == untrustworthy spill
+        want_leaves = {
+            p: {"shape": list(s), "decay": bool(decay_mask[p])}
+            for p, s in shapes.items()
+        }
+        if have.get("in_progress") is not None:
+            return False  # torn mid-update — not trustworthy
+        if have.get("leaves") != want_leaves or not all(
+            have.get(k) == getattr(self, k)
+            for k in ("b1", "b2", "eps", "weight_decay")
+        ):
+            return False
+        self.step_on_disk = have.get("step")
+        self.moment_steps = int(have.get("moment_steps", 0))
+        self._open_slabs({p: tuple(s) for p, s in shapes.items()},
+                         decay_mask, "r+")
+        self.attached = True
+        return True
+
+    def initialize(self, params_host: dict[str, np.ndarray],
+                   decay_mask: dict[str, bool]) -> bool:
+        """Create (or re-attach to) the spill. ``params_host`` maps leaf
+        path → fp32 ndarray. Returns True when an existing spill was
+        re-attached (masters/moments kept — the caller should trust the
+        DISK masters over its own init values)."""
+        os.makedirs(self.dir, exist_ok=True)
+        shapes = {p: tuple(np.shape(a)) for p, a in params_host.items()}
+        if not self.slabs and self.try_attach(shapes, decay_mask):
+            return True
+        self.slabs.clear()
+        self._open_slabs(shapes, decay_mask, "w+")
+        for path, arr in params_host.items():
+            slab = self.slabs[path]
+            slab.master[...] = np.asarray(arr, np.float32)
+            slab.mu[...] = 0.0
+            slab.nu[...] = 0.0
+            for f in slab.files():
+                f.flush()
+        self.step_on_disk = None
+        self._write_meta()
+        self.attached = False
+        return False
+
+    def masters(self) -> dict[str, np.ndarray]:
+        """Read back the fp32 master tree (copies, not memmap views)."""
+        return {p: np.array(s.master) for p, s in self.slabs.items()}
+
+    def reseed_masters(self, params_host: dict[str, np.ndarray],
+                       step: Optional[int] = None) -> None:
+        """Restart the trajectory from a (restored) param tree: masters
+        overwritten, moments ZEROED — exactly what loading a checkpoint
+        without optimizer state does. (Keeping moments "warm" across a
+        step discontinuity would apply the wrong Adam bias correction:
+        ``t`` restarts small while mu/nu stay converged, inflating the
+        corrected moments by up to 1/(1-b1).)"""
+        for path, arr in params_host.items():
+            slab = self.slabs[path]
+            slab.master[...] = np.asarray(arr, np.float32)
+            slab.mu[...] = 0.0
+            slab.nu[...] = 0.0
+            for f in slab.files():
+                f.flush()
+        self.step_on_disk = step
+        self.moment_steps = 0
+        self._write_meta()
+
+    # -- the update ----------------------------------------------------------
+
+    def update(self, grads: dict[str, Any], lr: float, step: int,
+               emit) -> None:
+        """One AdamW step over every leaf. ``grads`` maps leaf path →
+        device array (already clipped, fp32); ``step`` is the POST-update
+        TRAIN step (bookkeeping only — bias correction uses the internal
+        ``moment_steps`` counter, which survives restarts and resets with
+        the moments on reseed). ``emit(path, new_master_fp32)`` receives
+        each updated leaf immediately, so the caller can overlap the
+        device upload of leaf i with the disk update of leaf i+1.
+
+        Crash safety: the meta file carries an ``in_progress`` marker for
+        the duration of the walk — a spill whose process died mid-update
+        holds mixed-step slabs, and the marker makes the next
+        ``try_attach`` refuse it instead of silently resuming."""
+        b1, b2, eps, wd = self.b1, self.b2, self.eps, self.weight_decay
+        t_bias = self.moment_steps + 1
+        c1 = 1.0 - b1 ** t_bias
+        c2 = 1.0 - b2 ** t_bias
+        self._write_meta(extra={"in_progress": step})
+        order = list(self.slabs)
+        # Kick kernel readahead for the first leaf's slabs, then always
+        # stay one leaf ahead of the update loop.
+        if order:
+            for f in self.slabs[order[0]].files():
+                _advise(f, os.POSIX_FADV_WILLNEED)
+        for i, path in enumerate(order):
+            if i + 1 < len(order):
+                for f in self.slabs[order[i + 1]].files():
+                    _advise(f, os.POSIX_FADV_WILLNEED)
+            slab = self.slabs[path]
+            g = np.asarray(jax.device_get(grads[path]), np.float32)
+            if g.shape != slab.shape:
+                raise ValueError(
+                    f"grad leaf {path} shape {g.shape} != master {slab.shape}"
+                )
+            mu, nu, w = slab.mu, slab.nu, slab.master
+            mu *= b1
+            mu += (1.0 - b1) * g
+            nu *= b2
+            nu += (1.0 - b2) * np.square(g)
+            u = (mu / c1) / (np.sqrt(nu / c2) + eps)
+            if slab.decay and wd:
+                u += wd * w
+            w -= lr * u
+            emit(path, w)
+            for f in slab.files():
+                f.flush()
+                _advise(f, os.POSIX_FADV_DONTNEED)
+        self.step_on_disk = step
+        self.moment_steps = t_bias
+        self._write_meta()  # clean meta — clears in_progress
+
+    def spill_bytes(self) -> int:
+        return sum(3 * int(np.prod(s.shape)) * 4 for s in self.slabs.values())
+
+
+# ---------------------------------------------------------------------------
+# Tree <-> path-keyed dict plumbing (the slab store is flat by design:
+# file names come from leaf paths)
+# ---------------------------------------------------------------------------
+
+
+def flatten_with_paths(tree: Any) -> dict[str, Any]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return {jax.tree_util.keystr(path): leaf for path, leaf in flat}
+
+
+def unflatten_like(tree: Any, flat: dict[str, Any]) -> Any:
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    return jax.tree_util.tree_unflatten(
+        treedef, [flat[jax.tree_util.keystr(p)] for p, _ in paths_leaves]
+    )
+
+
+class AsyncLeafUploader:
+    """Overlaps device uploads of updated leaves with the next leaf's
+    disk update: ``emit`` hands the fp32 master to ONE worker thread
+    (depth-1 queue) that casts + ``device_put``s with the leaf's
+    sharding while the main thread walks on. The bounded queue is the
+    point: at most two leaf copies are ever resident (one queued, one
+    uploading) — an unbounded fan-out would buffer the whole fp32
+    master tree in host RAM, the very thing the disk tier exists to
+    avoid. ``result()`` joins and returns the new leaf dict."""
+
+    def __init__(self, shardings: dict[str, Any], dtype):
+        import queue
+
+        self._sh = shardings
+        self._dtype = dtype
+        self._out: dict[str, Any] = {}
+        self._err: Optional[BaseException] = None
+        self._q: "queue.Queue[Optional[tuple[str, np.ndarray]]]" = \
+            queue.Queue(maxsize=1)
+        self._worker = threading.Thread(target=self._drain, daemon=True)
+        self._worker.start()
+
+    def _drain(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            path, arr = item
+            try:
+                self._out[path] = jax.device_put(
+                    arr.astype(self._dtype), self._sh[path]
+                )
+            except BaseException as e:  # noqa: BLE001 — rethrown in result()
+                self._err = e
+
+    def emit(self, path: str, master: np.ndarray) -> None:
+        # Copy now: the memmap buffer is reused/advised-away immediately.
+        # Blocks when a copy is already queued — bounded residency.
+        self._q.put((path, np.asarray(master, dtype=np.float32).copy()))
+
+    def result(self) -> dict[str, Any]:
+        self._q.put(None)
+        self._worker.join()
+        if self._err is not None:
+            raise self._err
+        return self._out

@@ -27,8 +27,10 @@ axes given the stage, and the launcher applies the resulting
 
 CPU offload (reference ``deepspeed_launcher.py:29-33,197-212``) maps to JAX
 host memory kinds: optimizer state can live in ``pinned_host`` memory and is
-streamed to device inside the update. NVMe offload has no TPU-VM equivalent
-(documented out of scope, SURVEY.md §2.3).
+streamed to device inside the update. NVMe offload maps to the disk tier
+(``optimizer_offload="disk"`` + ``optimizer_spill_dir``): fp32 masters and
+Adam moments in memory-mapped spill files, a fused host AdamW with
+fadvise-driven slab prefetch (``tpu_engine/disk_offload.py``).
 """
 
 from __future__ import annotations
@@ -55,11 +57,17 @@ class ShardingStage(IntEnum):
 class OffloadDevice(str, Enum):
     """Mirrors reference ``OffloadDevice`` (``deepspeed_launcher.py:29-33``).
 
-    ``nvme`` is intentionally absent: no TPU-VM equivalent.
+    ``disk`` is the NVMe tier's TPU-VM port: optimizer state (fp32
+    masters + Adam moments) lives in memory-mapped files under
+    ``optimizer_spill_dir``, the device holds compute-dtype params only,
+    and a fused host AdamW streams slabs with fadvise-driven prefetch
+    (``tpu_engine/disk_offload.py``). Valid for ``optimizer_offload``
+    only — params cannot spill to disk (they are read every step).
     """
 
     NONE = "none"
     HOST = "host"  # pinned host memory (the TPU analogue of CPU offload)
+    DISK = "disk"  # memory-mapped spill files (the NVMe-offload analogue)
 
 
 class Precision(str, Enum):
@@ -291,6 +299,11 @@ class TPUTrainConfig(BaseModel):
     # Offload (reference :39-40,197-212).
     optimizer_offload: OffloadDevice = OffloadDevice.NONE
     param_offload: OffloadDevice = OffloadDevice.NONE
+    # Disk tier only: where the optimizer spill files live (reference
+    # ``nvme_path``, ``deepspeed_launcher.py:200``). Required when
+    # optimizer_offload == disk; persists across restarts (warm
+    # re-attach of exact Adam moments).
+    optimizer_spill_dir: Optional[str] = None
 
     # Collective-communication tuning (reference overlap_comm /
     # bucket-size knobs, ``deepspeed_launcher.py:133-142`` → XLA flags;
@@ -421,6 +434,51 @@ class TPUTrainConfig(BaseModel):
             raise ValueError(
                 f"grad_allreduce_dtype={self.grad_allreduce_dtype.value!r} must "
                 f"be 'fp32' or match precision={self.precision.value!r}"
+            )
+        return self
+
+    @model_validator(mode="after")
+    def _validate_disk_offload(self) -> "TPUTrainConfig":
+        """The disk tier is a host-side fused AdamW over memmap slabs —
+        combinations that cannot ride that path fail at config time."""
+        if self.optimizer_offload != OffloadDevice.DISK:
+            if self.optimizer_spill_dir is not None:
+                raise ValueError(
+                    "optimizer_spill_dir only applies with "
+                    "optimizer_offload='disk'"
+                )
+            if self.param_offload == OffloadDevice.DISK:
+                raise ValueError(
+                    "param_offload='disk' is not supported: params are read "
+                    "every forward pass — spill optimizer state instead "
+                    "(optimizer_offload='disk')"
+                )
+            return self
+        if self.optimizer_spill_dir is None:
+            raise ValueError(
+                "optimizer_offload='disk' requires optimizer_spill_dir "
+                "(the reference's nvme_path)"
+            )
+        if self.optimizer != "adamw":
+            raise ValueError(
+                "optimizer_offload='disk' supports optimizer='adamw' only "
+                "(the host update implements the AdamW chain)"
+            )
+        if self.moment_dtype is not None:
+            raise ValueError(
+                "moment_dtype targets device/host memory; disk-tier moments "
+                "live in fp32 spill files — drop moment_dtype"
+            )
+        if self.param_offload != OffloadDevice.NONE:
+            raise ValueError(
+                "optimizer_offload='disk' with param_offload is not "
+                "supported (the disk tier already keeps only compute-dtype "
+                "params on device)"
+            )
+        if self.lora_rank is not None:
+            raise ValueError(
+                "optimizer_offload='disk' with LoRA is pointless (adapter "
+                "state is rank-sized) and unsupported"
             )
         return self
 

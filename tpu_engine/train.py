@@ -69,6 +69,18 @@ def make_schedule(cfg: TPUTrainConfig) -> optax.Schedule:
     return optax.join_schedules([warm, tail], boundaries=[warmup])
 
 
+def kernel_decay_mask(params: Any) -> Any:
+    """Path-based weight-decay mask: matmul kernels and LoRA adapter
+    factors decay; norm scales and embeddings do not. ndim alone cannot
+    distinguish them — the stacked layout makes per-layer norm scales
+    [L, D]. ONE definition, shared by the optax chain and the disk-tier
+    host AdamW (their masks must never drift)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: getattr(path[-1], "key", None) in ("kernel", "A", "B"),
+        params,
+    )
+
+
 def make_optimizer(cfg: TPUTrainConfig) -> tuple[optax.GradientTransformation, optax.Schedule]:
     """The configured optimizer (AdamW matches the reference's block,
     ``:156-164``; Adafactor/Lion are the TPU-era memory-efficient options).
@@ -104,17 +116,9 @@ def make_optimizer(cfg: TPUTrainConfig) -> tuple[optax.GradientTransformation, o
         scaler = optax.scale_by_adam(
             b1=cfg.beta1, b2=cfg.beta2, eps=1e-8, mu_dtype=mu_dtype
         )
-    # Path-based decay mask: matmul kernels and LoRA adapter factors decay;
-    # norm scales and embeddings do not. ndim alone cannot distinguish them
-    # — the stacked layout makes per-layer norm scales [L, D].
-    def _kernels_only(params):
-        return jax.tree_util.tree_map_with_path(
-            lambda path, _: getattr(path[-1], "key", None) in ("kernel", "A", "B"),
-            params,
-        )
-
     decay = optax.add_decayed_weights(
-        cfg.weight_decay, mask=None if cfg.decay_all_params else _kernels_only
+        cfg.weight_decay,
+        mask=None if cfg.decay_all_params else kernel_decay_mask,
     )
     tx = optax.chain(
         optax.clip_by_global_norm(cfg.grad_clip_norm), scaler, decay
@@ -272,6 +276,9 @@ class TrainProgram:
     # The RESOLVED pipeline schedule ("gpipe" | "1f1b") — config "auto"
     # is decided at build time (see build_train_program's selection rule).
     pipeline_schedule: str = "gpipe"
+    # Disk-tier only: the live DiskAdamW spill store (spill_bytes(),
+    # step_on_disk, masters() for export). None on in-memory programs.
+    disk_store: Any = None
 
     @property
     def mesh(self) -> Mesh:
@@ -442,6 +449,24 @@ def build_train_program(
         raise ValueError(
             "param_offload=host requires a backend with pinned_host memory "
             "support (TPU, or the JAX CPU backend)"
+        )
+
+    # Disk-tier optimizer offload (the NVMe analogue): the jitted step
+    # computes + clips gradients only; masters and Adam moments live in
+    # memmap spill files and a fused host AdamW applies the update
+    # (tpu_engine/disk_offload.py). Config-level combos are validated by
+    # TPUTrainConfig; runtime-shaped ones here.
+    disk_tier = cfg.optimizer_offload == OffloadDevice.DISK
+    if disk_tier and pipe_size > 1:
+        raise ValueError(
+            "optimizer_offload='disk' with pipeline parallelism is not "
+            "supported (the host update walks the flat gradient tree)"
+        )
+    if disk_tier and jax.process_count() > 1:
+        raise ValueError(
+            "optimizer_offload='disk' is single-process: every gradient "
+            "shard must be addressable to the spilling host (multi-host "
+            "spill would shard the slab files per process)"
         )
 
     logical = tfm.logical_axes(model_cfg)
@@ -1028,6 +1053,16 @@ def build_train_program(
             out_shardings=full_param_sh,
         )
 
+    if disk_tier:
+        return _assemble_disk_tier(
+            cfg, model_cfg, runtime, mesh, schedule, grad_fn,
+            _cast_for_grad, _reduce_grads, eval_step,
+            param_sh=param_sh, grad_sh=grad_sh, replicated=replicated,
+            batch_sharding=batch_sharding,
+            compute_dtype=compute_dtype, master_dtype=master_dtype,
+            pipe_schedule=pipe_schedule,
+        )
+
     return TrainProgram(
         config=cfg,
         model_config=model_cfg,
@@ -1040,6 +1075,206 @@ def build_train_program(
         base_params=base_params if use_lora else None,
         merged_params=merged_fn,
         pipeline_schedule=pipe_schedule,
+    )
+
+
+def _assemble_disk_tier(
+    cfg, model_cfg, runtime, mesh, schedule, grad_fn,
+    _cast_for_grad, _reduce_grads, eval_step, *,
+    param_sh, grad_sh, replicated, batch_sharding,
+    compute_dtype, master_dtype, pipe_schedule,
+) -> TrainProgram:
+    """Disk-tier (NVMe-analogue) program: device = forward/backward/clip
+    on compute-dtype params; host = fused AdamW over memmap spill slabs
+    (``tpu_engine/disk_offload.py``). The train state carries NO
+    optimizer state and the params at COMPUTE dtype — HBM holds exactly
+    what the forward pass reads.
+
+    Rollback/restore semantics: the spill persists its applied-step
+    count; when the incoming state's step disagrees (supervisor rollback,
+    or a restart restored an older checkpoint), masters reseed from the
+    restored params and the Adam moments stay warm — the same behavior
+    as loading a checkpoint without optimizer state.
+    """
+    import numpy as np
+
+    from tpu_engine import disk_offload as dsk
+
+    state_shardings = {
+        "params": param_sh,
+        "step": replicated,
+        "lr_scale": replicated,
+    }
+    flat_param_sh = dsk.flatten_with_paths(param_sh)
+
+    def _to_compute(params):
+        return jax.tree.map(
+            lambda a: a.astype(compute_dtype)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a,
+            params,
+        )
+
+    def _decay_mask(params):
+        if cfg.decay_all_params:
+            return jax.tree.map(lambda _: True, params)
+        return kernel_decay_mask(params)
+
+    store = dsk.DiskAdamW(
+        cfg.optimizer_spill_dir, b1=cfg.beta1, b2=cfg.beta2, eps=1e-8,
+        weight_decay=cfg.weight_decay,
+    )
+
+    _abs_params = jax.eval_shape(
+        lambda r: tfm.init_params(r, model_cfg, dtype=master_dtype),
+        jax.random.PRNGKey(0),
+    )
+    _flat_shapes = {
+        p: tuple(s.shape)
+        for p, s in dsk.flatten_with_paths(_abs_params).items()
+    }
+    _flat_mask = dsk.flatten_with_paths(_decay_mask(_abs_params))
+
+    def _ensure_store(params) -> bool:
+        """Attach if a clean matching spill exists (shape-only check — no
+        device fetch); otherwise seed a fresh spill from ``params``."""
+        if store.try_attach(_flat_shapes, _flat_mask):
+            return True
+        flat = {
+            p: np.asarray(jax.device_get(leaf), np.float32)
+            for p, leaf in dsk.flatten_with_paths(params).items()
+        }
+        return store.initialize(flat, _flat_mask)
+
+    def _params_from_masters():
+        return dsk.unflatten_like(_abs_params, {
+            p: jax.device_put(m.astype(compute_dtype), flat_param_sh[p])
+            for p, m in store.masters().items()
+        })
+
+    def disk_init(rng):
+        def pure(r):
+            return {
+                "params": _to_compute(
+                    tfm.init_params(r, model_cfg, dtype=master_dtype)
+                ),
+                "step": jnp.zeros((), jnp.int32),
+                "lr_scale": jnp.ones((), jnp.float32),
+            }
+
+        if isinstance(rng, jax.core.Tracer):
+            # eval_shape path (the supervisor derives state shapes by
+            # tracing init) — no host I/O under a tracer.
+            return pure(rng)
+        if store.slabs or store.try_attach(_flat_shapes, _flat_mask):
+            # A matching clean spill exists: ITS masters are the truth
+            # (warm restart) — no throwaway random init, no D2H fetch.
+            params = _params_from_masters()
+        else:
+            masters = jax.jit(
+                lambda r: tfm.init_params(r, model_cfg, dtype=master_dtype),
+                out_shardings=param_sh,
+            )(rng)
+            _ensure_store(masters)
+            params = jax.jit(
+                _to_compute, donate_argnums=(0,), out_shardings=param_sh
+            )(masters)
+        return {
+            "params": params,
+            "step": jax.device_put(jnp.zeros((), jnp.int32), replicated),
+            "lr_scale": jax.device_put(jnp.ones((), jnp.float32), replicated),
+        }
+
+    def grad_step(state, batch):
+        params_g = _cast_for_grad(state["params"])
+        accum = batch.shape[0]
+        denom = jnp.maximum(
+            jnp.sum((batch[:, :, 1:] >= 0).astype(jnp.float32)), 1.0
+        )
+
+        def accum_body(carry, tokens):
+            loss_acc, grad_acc = carry
+            loss, grads = grad_fn(params_g, tokens, True, denom=denom,
+                                  aux_weight=1.0 / accum)
+            grads = _reduce_grads(grads)
+            return (loss_acc + loss, jax.tree.map(jnp.add, grad_acc, grads)), None
+
+        zero = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), state["params"]
+        )
+        zero = jax.lax.with_sharding_constraint(zero, grad_sh)
+        (loss, grads), _ = jax.lax.scan(
+            accum_body, (jnp.zeros((), jnp.float32), zero), batch
+        )
+        grad_norm = optax.global_norm(grads)
+        # optax.clip_by_global_norm semantics: scale = min(1, clip/norm).
+        scale = jnp.minimum(
+            1.0, cfg.grad_clip_norm / jnp.maximum(grad_norm, 1e-12)
+        )
+        grads = jax.tree.map(lambda g: g * scale, grads)
+        lr = schedule(state["step"]).astype(jnp.float32) * state["lr_scale"]
+        metrics = {
+            "loss": loss,
+            "grad_norm": grad_norm,
+            "learning_rate": lr,
+            "step": state["step"] + 1,
+        }
+        return grads, metrics
+
+    jit_grad = jax.jit(
+        grad_step,
+        in_shardings=(state_shardings, batch_sharding),
+        out_shardings=(grad_sh, None),
+    )
+
+    def disk_step(state, batch):
+        grads, metrics = jit_grad(state, batch)
+        t = int(state["step"]) + 1
+        if not store.slabs:
+            _ensure_store(state["params"])  # restored-without-init path
+        # ONE discontinuity check covering every path — lazy attach,
+        # warm init-attach, in-process rollback, restored checkpoint at
+        # a different step: the spill's applied-step must be exactly the
+        # incoming state's step, else the state's weights are the truth
+        # and the trajectory restarts from them (masters reseeded,
+        # moments zeroed, bias-correction counter reset — the LR
+        # schedule keeps the state's step).
+        if store.step_on_disk is not None and store.step_on_disk != t - 1:
+            store.reseed_masters(
+                {p: np.asarray(jax.device_get(leaf), np.float32)
+                 for p, leaf in
+                 dsk.flatten_with_paths(state["params"]).items()},
+                step=t - 1,
+            )
+        uploader = dsk.AsyncLeafUploader(flat_param_sh, compute_dtype)
+        store.update(
+            dsk.flatten_with_paths(grads),
+            float(metrics["learning_rate"]), t, uploader.emit,
+        )
+        new_params = dsk.unflatten_like(state["params"], uploader.result())
+        new_state = {
+            "params": new_params,
+            "step": metrics["step"],
+            "lr_scale": state["lr_scale"],
+        }
+        return new_state, metrics
+
+    jit_eval = jax.jit(
+        eval_step,
+        in_shardings=(state_shardings, batch_sharding),
+        out_shardings=None,
+    )
+
+    return TrainProgram(
+        config=cfg,
+        model_config=model_cfg,
+        runtime=runtime,
+        state_shardings=state_shardings,
+        batch_sharding=batch_sharding,
+        init=disk_init,
+        step=disk_step,
+        eval_step=jit_eval,
+        pipeline_schedule=pipe_schedule,
+        disk_store=store,
     )
 
 
