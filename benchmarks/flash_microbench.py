@@ -16,10 +16,19 @@ import time
 
 
 def main() -> None:
+    import sys
+
     import jax
     import jax.numpy as jnp
 
+    from tpu_engine.ops import _flash_pallas
     from tpu_engine.ops.flash_attention import mha
+
+    # --bwd-block N: sweep the backward tile cap (see _flash_bwd).
+    if "--bwd-block" in sys.argv:
+        cap = int(sys.argv[sys.argv.index("--bwd-block") + 1])
+        _flash_pallas._BWD_BLOCK_CAP = cap
+        print(json.dumps({"bwd_block_cap": cap}))
 
     shapes = [
         # (tag, BH, S, D, window)  — BH = batch × heads after GQA expand

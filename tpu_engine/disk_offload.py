@@ -172,28 +172,42 @@ class DiskAdamW:
             for k in ("b1", "b2", "eps", "weight_decay")
         ):
             return False
+        try:
+            self._open_slabs({p: tuple(s) for p, s in shapes.items()},
+                             decay_mask, "r+")
+        except (FileNotFoundError, ValueError, OSError):
+            # Meta survived but slab files are missing/truncated (partial
+            # cleanup or copy) — an untrustworthy spill falls back to
+            # fresh init like every other one.
+            self.slabs.clear()
+            return False
         self.step_on_disk = have.get("step")
         self.moment_steps = int(have.get("moment_steps", 0))
-        self._open_slabs({p: tuple(s) for p, s in shapes.items()},
-                         decay_mask, "r+")
         self.attached = True
         return True
 
-    def initialize(self, params_host: dict[str, np.ndarray],
-                   decay_mask: dict[str, bool]) -> bool:
+    def initialize(self, params_host: Any,
+                   decay_mask: dict[str, bool],
+                   shapes: Optional[dict[str, tuple[int, ...]]] = None) -> bool:
         """Create (or re-attach to) the spill. ``params_host`` maps leaf
-        path → fp32 ndarray. Returns True when an existing spill was
-        re-attached (masters/moments kept — the caller should trust the
-        DISK masters over its own init values)."""
+        path → fp32 ndarray, OR is a callable ``path -> ndarray`` fetched
+        one leaf at a time (bounded host residency — the tier's whole
+        point; pass ``shapes`` alongside). Returns True when an existing
+        spill was re-attached (masters/moments kept — the caller should
+        trust the DISK masters over its own init values)."""
         os.makedirs(self.dir, exist_ok=True)
-        shapes = {p: tuple(np.shape(a)) for p, a in params_host.items()}
+        fetch = params_host if callable(params_host) else params_host.get
+        if shapes is None:
+            if callable(params_host):
+                raise ValueError("callable params_host requires shapes")
+            shapes = {p: tuple(np.shape(a)) for p, a in params_host.items()}
         if not self.slabs and self.try_attach(shapes, decay_mask):
             return True
         self.slabs.clear()
         self._open_slabs(shapes, decay_mask, "w+")
-        for path, arr in params_host.items():
+        for path in shapes:
             slab = self.slabs[path]
-            slab.master[...] = np.asarray(arr, np.float32)
+            slab.master[...] = np.asarray(fetch(path), np.float32)
             slab.mu[...] = 0.0
             slab.nu[...] = 0.0
             for f in slab.files():
@@ -204,20 +218,37 @@ class DiskAdamW:
         return False
 
     def masters(self) -> dict[str, np.ndarray]:
-        """Read back the fp32 master tree (copies, not memmap views)."""
+        """Read back the fp32 master tree (copies, not memmap views).
+        Materialises every leaf — callers with bounded-residency needs
+        should iterate ``slabs`` and copy one master at a time."""
         return {p: np.array(s.master) for p, s in self.slabs.items()}
 
-    def reseed_masters(self, params_host: dict[str, np.ndarray],
-                       step: Optional[int] = None) -> None:
+    def reseed_masters(self, params_host: Any,
+                       step: Optional[int] = None,
+                       cast_dtype: Any = None) -> None:
         """Restart the trajectory from a (restored) param tree: masters
         overwritten, moments ZEROED — exactly what loading a checkpoint
         without optimizer state does. (Keeping moments "warm" across a
         step discontinuity would apply the wrong Adam bias correction:
         ``t`` restarts small while mu/nu stay converged, inflating the
-        corrected moments by up to 1/(1-b1).)"""
-        for path, arr in params_host.items():
-            slab = self.slabs[path]
-            slab.master[...] = np.asarray(arr, np.float32)
+        corrected moments by up to 1/(1-b1).)
+
+        ``params_host`` is a dict OR a callable ``path -> ndarray``
+        (leaf-at-a-time, bounded residency). ``cast_dtype``: the compute
+        dtype the incoming params were truncated to (e.g. bfloat16) —
+        where the existing fp32 master still rounds to exactly the
+        incoming value, the MASTER is kept, so a reseed from a state
+        that never actually diverged (warm re-attach without a restored
+        step counter) does not silently shave the masters to bf16."""
+        fetch = params_host if callable(params_host) else params_host.get
+        for path, slab in self.slabs.items():
+            incoming = np.asarray(fetch(path), np.float32)
+            if cast_dtype is not None:
+                rounded = np.asarray(slab.master).astype(cast_dtype)
+                keep = rounded.astype(np.float32) == incoming
+                slab.master[...] = np.where(keep, slab.master, incoming)
+            else:
+                slab.master[...] = incoming
             slab.mu[...] = 0.0
             slab.nu[...] = 0.0
             for f in slab.files():
@@ -342,9 +373,16 @@ class AsyncLeafUploader:
         # Blocks when a copy is already queued — bounded residency.
         self._q.put((path, np.asarray(master, dtype=np.float32).copy()))
 
+    def close(self) -> None:
+        """Stop the worker without raising — the failure-path companion
+        to ``result()`` (a caller whose disk update threw must not leak a
+        worker blocked on the queue forever)."""
+        if self._worker.is_alive():
+            self._q.put(None)
+            self._worker.join()
+
     def result(self) -> dict[str, Any]:
-        self._q.put(None)
-        self._worker.join()
+        self.close()
         if self._err is not None:
             raise self._err
         return self._out

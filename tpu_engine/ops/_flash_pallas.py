@@ -37,6 +37,17 @@ class FlashUnsupported(Exception):
     """Raised (at trace time) when a shape/config can't use the flash kernel."""
 
 
+# Backward tile cap for LONG sequences (see _flash_bwd); module-level so
+# the microbench can sweep it. Swept on chip (round 4, flash_microbench
+# --bwd-block): at seq >= 4096 the 1024 tile beats the old blanket 512
+# cap (fwd+bwd 4.87->4.64 ms @ seq4096, 14.57->14.44 @ 8192, 12.39->
+# 12.31 windowed — the 4-tile f32 working set is 16 MiB, inside v5e
+# VMEM), but at seq 2048 the bigger tile LOSES 8.7% (1.80->1.96 ms — a
+# 2x2 outer grid leaves the pipeline too few blocks), so short
+# sequences keep 512.
+_BWD_BLOCK_CAP = 1024
+
+
 def _pick_block(s: int) -> int:
     for b in (1024, 512, 256, 128, 64):
         if s % b == 0 and s // b >= 2:
@@ -377,9 +388,16 @@ def _flash_bwd(block: int, interpret: bool, window: int, res, do,
     BH, S, D = q.shape
     scale = 1.0 / (D ** 0.5)
     # The backward holds ~4 [BQ, BK] f32 tiles live at once (s/p, dp, ds)
-    # plus four input blocks and two accumulators — cap the tile so the
-    # whole working set stays comfortably inside VMEM.
-    bb = min(block, 512)
+    # plus four input blocks and two accumulators. Tile choice is
+    # sequence-dependent (swept on chip, see _BWD_BLOCK_CAP): long
+    # sequences take the big tile, short ones keep enough outer-grid
+    # blocks to fill the pipeline.
+    bb = min(block, _BWD_BLOCK_CAP if S >= 4096 else 512)
+    # Power-of-two floor: ``block`` is a power of two dividing S, so any
+    # power of two <= block divides S too. A swept/overridden cap that is
+    # not a power of two (e.g. --bwd-block 768) would otherwise truncate
+    # the grid and leave tail rows of dq/dk/dv unwritten.
+    bb = 1 << (bb.bit_length() - 1)
     n_blk = S // bb
 
     do32 = do.astype(jnp.float32)

@@ -69,6 +69,42 @@ def make_schedule(cfg: TPUTrainConfig) -> optax.Schedule:
     return optax.join_schedules([warm, tail], boundaries=[warmup])
 
 
+def accumulate_grads(grad_fn, reduce_grads, params_g, params_like, batch,
+                     grad_sh):
+    """Gradient accumulation over ``batch`` [accum, B, S]: the masked-SFT
+    global-denominator scan shared by the in-memory train step and the
+    disk-tier grad step — ONE definition so the two paths' objectives
+    cannot silently diverge. Returns (summed loss, summed fp32 grads)."""
+    accum = batch.shape[0]
+    # Batch-wide valid-target count (masked SFT targets excluded): each
+    # microbatch contributes raw sums / this denominator, so the summed
+    # loss and grads realise the global mean.
+    denom = jnp.maximum(
+        jnp.sum((batch[:, :, 1:] >= 0).astype(jnp.float32)), 1.0
+    )
+
+    def accum_body(carry, tokens):
+        loss_acc, grad_acc = carry
+        loss, grads = grad_fn(params_g, tokens, True, denom=denom,
+                              aux_weight=1.0 / accum)
+        # Stage >= 2: the constraint to fsdp shards makes XLA
+        # reduce-scatter instead of all-reduce (ZeRO-2 semantics);
+        # reduce_grads routes the collective through the configured
+        # comm dtype, accumulation stays fp32.
+        grads = reduce_grads(grads)
+        grad_acc = jax.tree.map(jnp.add, grad_acc, grads)
+        return (loss_acc + loss, grad_acc), None
+
+    zero_grads = jax.tree.map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params_like
+    )
+    zero_grads = jax.lax.with_sharding_constraint(zero_grads, grad_sh)
+    (loss, grad_sum), _ = jax.lax.scan(
+        accum_body, (jnp.zeros((), jnp.float32), zero_grads), batch
+    )
+    return loss, grad_sum
+
+
 def kernel_decay_mask(params: Any) -> Any:
     """Path-based weight-decay mask: matmul kernels and LoRA adapter
     factors decay; norm scales and embeddings do not. ndim alone cannot
@@ -931,32 +967,9 @@ def build_train_program(
             loss, grads = pipe_grad_fn(params_g, batch)
             grads = _reduce_grads(grads)
         else:
-            accum = batch.shape[0]
-            # Batch-wide valid-target count (masked SFT targets excluded):
-            # each microbatch contributes raw sums / this denominator, so
-            # the summed loss and grads realise the global mean.
-            denom = jnp.maximum(
-                jnp.sum((batch[:, :, 1:] >= 0).astype(jnp.float32)), 1.0
+            loss, grads = accumulate_grads(
+                grad_fn, _reduce_grads, params_g, params, batch, grad_sh
             )
-
-            def accum_body(carry, tokens):
-                loss_acc, grad_acc = carry
-                loss, grads = grad_fn(params_g, tokens, True, denom=denom,
-                                      aux_weight=1.0 / accum)
-                # Stage >= 2: the constraint to fsdp shards makes XLA
-                # reduce-scatter instead of all-reduce (ZeRO-2 semantics);
-                # _reduce_grads routes the collective through the configured
-                # comm dtype, accumulation stays fp32.
-                grads = _reduce_grads(grads)
-                grad_acc = jax.tree.map(jnp.add, grad_acc, grads)
-                return (loss_acc + loss, grad_acc), None
-
-            zero_grads = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
-            zero_grads = jax.lax.with_sharding_constraint(zero_grads, grad_sh)
-            (loss, grad_sum), _ = jax.lax.scan(
-                accum_body, (jnp.zeros((), jnp.float32), zero_grads), batch
-            )
-            grads = grad_sum
         grad_norm = optax.global_norm(grads)
 
         # Offloaded subtrees stream through device memory for the update
@@ -1092,9 +1105,12 @@ def _assemble_disk_tier(
 
     Rollback/restore semantics: the spill persists its applied-step
     count; when the incoming state's step disagrees (supervisor rollback,
-    or a restart restored an older checkpoint), masters reseed from the
-    restored params and the Adam moments stay warm — the same behavior
-    as loading a checkpoint without optimizer state.
+    a restart that restored an older checkpoint, or a fresh run reusing
+    a spill dir), masters reseed from the restored params with the Adam
+    moments ZEROED and the bias-correction counter reset — exactly the
+    behavior of loading a checkpoint without optimizer state. Where a
+    master still rounds to the incoming compute-dtype value it is kept
+    at full precision (see ``reseed_masters`` ``cast_dtype``).
     """
     import numpy as np
 
@@ -1134,22 +1150,29 @@ def _assemble_disk_tier(
     }
     _flat_mask = dsk.flatten_with_paths(_decay_mask(_abs_params))
 
+    def _leaf_fetcher(params):
+        """path → fp32 host ndarray, ONE leaf at a time — the full fp32
+        tree must never be host-resident at once (the tier targets models
+        where it cannot be)."""
+        flat = dsk.flatten_with_paths(params)
+        return lambda p: np.asarray(jax.device_get(flat[p]), np.float32)
+
     def _ensure_store(params) -> bool:
         """Attach if a clean matching spill exists (shape-only check — no
         device fetch); otherwise seed a fresh spill from ``params``."""
         if store.try_attach(_flat_shapes, _flat_mask):
             return True
-        flat = {
-            p: np.asarray(jax.device_get(leaf), np.float32)
-            for p, leaf in dsk.flatten_with_paths(params).items()
-        }
-        return store.initialize(flat, _flat_mask)
+        return store.initialize(_leaf_fetcher(params), _flat_mask,
+                                shapes=_flat_shapes)
 
     def _params_from_masters():
-        return dsk.unflatten_like(_abs_params, {
-            p: jax.device_put(m.astype(compute_dtype), flat_param_sh[p])
-            for p, m in store.masters().items()
-        })
+        # Leaf-at-a-time: copy one master slab, cast, device_put, drop.
+        leaves = {}
+        for p, slab in store.slabs.items():
+            leaves[p] = jax.device_put(
+                np.array(slab.master).astype(compute_dtype), flat_param_sh[p]
+            )
+        return dsk.unflatten_like(_abs_params, leaves)
 
     def disk_init(rng):
         def pure(r):
@@ -1186,24 +1209,8 @@ def _assemble_disk_tier(
 
     def grad_step(state, batch):
         params_g = _cast_for_grad(state["params"])
-        accum = batch.shape[0]
-        denom = jnp.maximum(
-            jnp.sum((batch[:, :, 1:] >= 0).astype(jnp.float32)), 1.0
-        )
-
-        def accum_body(carry, tokens):
-            loss_acc, grad_acc = carry
-            loss, grads = grad_fn(params_g, tokens, True, denom=denom,
-                                  aux_weight=1.0 / accum)
-            grads = _reduce_grads(grads)
-            return (loss_acc + loss, jax.tree.map(jnp.add, grad_acc, grads)), None
-
-        zero = jax.tree.map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), state["params"]
-        )
-        zero = jax.lax.with_sharding_constraint(zero, grad_sh)
-        (loss, grads), _ = jax.lax.scan(
-            accum_body, (jnp.zeros((), jnp.float32), zero), batch
+        loss, grads = accumulate_grads(
+            grad_fn, _reduce_grads, params_g, state["params"], batch, grad_sh
         )
         grad_norm = optax.global_norm(grads)
         # optax.clip_by_global_norm semantics: scale = min(1, clip/norm).
@@ -1239,17 +1246,23 @@ def _assemble_disk_tier(
         # moments zeroed, bias-correction counter reset — the LR
         # schedule keeps the state's step).
         if store.step_on_disk is not None and store.step_on_disk != t - 1:
+            # cast_dtype: where a master still rounds to exactly the
+            # incoming (compute-dtype-truncated) value, keep the fp32
+            # master — a reseed from a state that never diverged (warm
+            # re-attach without a restored step counter) must not shave
+            # master precision to bf16.
             store.reseed_masters(
-                {p: np.asarray(jax.device_get(leaf), np.float32)
-                 for p, leaf in
-                 dsk.flatten_with_paths(state["params"]).items()},
-                step=t - 1,
+                _leaf_fetcher(state["params"]), step=t - 1,
+                cast_dtype=compute_dtype,
             )
         uploader = dsk.AsyncLeafUploader(flat_param_sh, compute_dtype)
-        store.update(
-            dsk.flatten_with_paths(grads),
-            float(metrics["learning_rate"]), t, uploader.emit,
-        )
+        try:
+            store.update(
+                dsk.flatten_with_paths(grads),
+                float(metrics["learning_rate"]), t, uploader.emit,
+            )
+        finally:
+            uploader.close()  # never leak the worker on an update failure
         new_params = dsk.unflatten_like(state["params"], uploader.result())
         new_state = {
             "params": new_params,
