@@ -54,6 +54,10 @@ class ServingStartRequest(BaseModel):
     # per-(lane, head) scales — half the serving-pool HBM. Independent
     # of (and composable with) weight quantization.
     kv_cache: Optional[str] = Field(default=None, pattern="^int8$")
+    # Prompt-prefix KV cache budget in tokens (0 = off): admissions whose
+    # prompt shares a cached chunk-boundary prefix (e.g. a system prompt)
+    # paste its KV and prefill only their suffix. LRU within the budget.
+    prefix_cache_tokens: int = Field(default=0, ge=0)
 
 
 class ServingSubmitRequest(BaseModel):
@@ -175,6 +179,7 @@ async def start_server(request: web.Request) -> web.Response:
                     chunk_steps=req.decode_chunk_steps,
                     prefill_chunk=req.prefill_chunk, mesh=mesh,
                     kv_quant=req.kv_cache == "int8",
+                    prefix_cache_tokens=req.prefix_cache_tokens,
                 )
             except ValueError as e:
                 raise ApiError(422, str(e))

@@ -555,3 +555,156 @@ def test_kv_quant_speculative_serving():
         streams[srv] = [srv.result(r)["tokens"] for r in rids]
     assert streams[plain] == streams[spec]
     assert spec.stats()["spec_accept_rate"] > 0.9  # draft == target
+
+
+def test_prefix_cache_streams_identical_and_hits():
+    """Shared system prompt: streams with the prefix cache must be
+    token-identical to streams without it, and the warm admission must
+    actually HIT (its shared chunks never re-prefill)."""
+    cfg = tfm.MODEL_CONFIGS["gpt-tiny"]
+    params = tfm.init_params(jax.random.PRNGKey(3), cfg, dtype=jnp.float32)
+    rng = np.random.default_rng(11)
+    system = rng.integers(1, cfg.vocab_size, 40).tolist()  # > 2 chunks of 16
+    prompts = [system + rng.integers(1, cfg.vocab_size, n).tolist()
+               for n in (5, 9, 3)]
+
+    def serve(**kw):
+        srv = ContinuousBatcher(params, cfg, max_slots=2, max_len=128,
+                                compute_dtype=jnp.float32, prefill_pad_to=16,
+                                prefill_chunk=16, chunk_steps=3, **kw)
+        rids = [srv.submit(p, max_new_tokens=6) for p in prompts]
+        for _ in range(80):
+            if all(srv.result(r)["status"] == "done" for r in rids):
+                break
+            srv.step()
+        return srv, [srv.result(r)["tokens"] for r in rids]
+
+    _, cold = serve()
+    srv, warm = serve(prefix_cache_tokens=512)
+    assert warm == cold
+    st = srv.stats()["prefix_cache"]
+    assert st["hits"] >= 2, st           # prompts 2 and 3 reuse the prefix
+    assert st["entries"] >= 1 and st["tokens"] <= 512
+    # And everything still matches per-request generate().
+    for p, toks in zip(prompts, warm):
+        assert toks == _ref_greedy(params, cfg, p, 6)
+
+
+def test_prefix_cache_exact_match_only():
+    """A prompt whose first chunk differs by ONE token must miss."""
+    cfg = tfm.MODEL_CONFIGS["gpt-tiny"]
+    params = tfm.init_params(jax.random.PRNGKey(3), cfg, dtype=jnp.float32)
+    srv = ContinuousBatcher(params, cfg, max_slots=2, max_len=128,
+                            compute_dtype=jnp.float32, prefill_pad_to=16,
+                            prefill_chunk=16, chunk_steps=2,
+                            prefix_cache_tokens=256)
+    base = list(range(1, 35))
+    variant = [99] + base[1:]  # differs at token 0
+    r1 = srv.submit(base, max_new_tokens=4)
+    for _ in range(40):
+        srv.step()
+        if srv.result(r1)["status"] == "done":
+            break
+    r2 = srv.submit(variant, max_new_tokens=4)
+    for _ in range(40):
+        srv.step()
+        if srv.result(r2)["status"] == "done":
+            break
+    st = srv.stats()["prefix_cache"]
+    assert st["hits"] == 0
+    assert srv.result(r2)["tokens"] == _ref_greedy(params, cfg, variant, 4)
+
+
+def test_prefix_cache_eviction_budget():
+    cfg = tfm.MODEL_CONFIGS["gpt-tiny"]
+    params = tfm.init_params(jax.random.PRNGKey(3), cfg, dtype=jnp.float32)
+    srv = ContinuousBatcher(params, cfg, max_slots=1, max_len=128,
+                            compute_dtype=jnp.float32, prefill_pad_to=16,
+                            prefill_chunk=16, chunk_steps=2,
+                            prefix_cache_tokens=48)  # at most 3 chunks
+    rng = np.random.default_rng(5)
+    for i in range(4):  # distinct 33-token prompts -> 2 fresh chunks each
+        p = rng.integers(1, cfg.vocab_size, 33).tolist()
+        r = srv.submit(p, max_new_tokens=2)
+        for _ in range(40):
+            srv.step()
+            if srv.result(r)["status"] == "done":
+                break
+    st = srv.stats()["prefix_cache"]
+    assert st["tokens"] <= 48, st
+
+
+def test_prefix_cache_composes_with_kv_quant_and_sampling():
+    cfg = tfm.MODEL_CONFIGS["gpt-tiny"]
+    params = tfm.init_params(jax.random.PRNGKey(3), cfg, dtype=jnp.float32)
+    system = list(range(1, 36))
+    p1, p2 = system + [7, 8], system + [9]
+
+    def serve(**kw):
+        srv = ContinuousBatcher(params, cfg, max_slots=2, max_len=96,
+                                compute_dtype=jnp.float32, prefill_pad_to=16,
+                                prefill_chunk=16, chunk_steps=2,
+                                kv_quant=True, **kw)
+        a = srv.submit(p1, max_new_tokens=5)
+        b = srv.submit(p2, max_new_tokens=5, temperature=0.6)
+        for _ in range(60):
+            srv.step()
+            if all(srv.result(r)["status"] == "done" for r in (a, b)):
+                break
+        return srv, srv.result(a)["tokens"], srv.result(b)["tokens"]
+
+    _, a0, b0 = serve()
+    srv, a1, b1 = serve(prefix_cache_tokens=256)
+    assert (a1, b1) == (a0, b0)
+    assert srv.stats()["prefix_cache"]["hits"] >= 1
+
+
+def test_prefix_cache_guards():
+    cfg = tfm.MODEL_CONFIGS["gpt-tiny"].with_(sliding_window=12)
+    params = tfm.init_params(jax.random.PRNGKey(3), cfg, dtype=jnp.float32)
+    with pytest.raises(ValueError, match="sliding-window"):
+        ContinuousBatcher(params, cfg, max_slots=2, max_len=128,
+                          compute_dtype=jnp.float32, prefill_chunk=16,
+                          prefix_cache_tokens=128)
+    cfg2 = tfm.MODEL_CONFIGS["gpt-tiny"]
+    params2 = tfm.init_params(jax.random.PRNGKey(3), cfg2, dtype=jnp.float32)
+    with pytest.raises(ValueError, match="speculative"):
+        ContinuousBatcher(params2, cfg2, max_slots=2, max_len=64,
+                          compute_dtype=jnp.float32,
+                          draft_params=params2, draft_cfg=cfg2,
+                          prefix_cache_tokens=128)
+
+
+def test_prefix_cache_chain_dedup_policy():
+    """A cold walk's nested boundary entries collapse to the longest
+    (unhit parents are subsumed); a parent another request actually HIT
+    is protected from the chain-drop."""
+    from tpu_engine.serving import _PrefixCache
+
+    class _E:  # stands in for a KVCache slice
+        def __init__(self, n):
+            self.max_len = n
+
+    sys_toks = tuple(range(48))
+
+    # Cold walk of a 48-token prefix: 16 -> 32 -> 48 collapses to {48}.
+    c = _PrefixCache(budget_tokens=1024, chunk=16)
+    for L in (16, 32, 48):
+        c.insert(sys_toks[:L], _E(L))
+    assert sorted(len(k) for k in c._entries) == [48]
+    assert c.tokens == 48
+
+    # Same walk, but the 32-boundary gets a HIT before 48 inserts: the
+    # hit parent survives the chain-drop (it is independently useful).
+    c = _PrefixCache(budget_tokens=1024, chunk=16)
+    c.insert(sys_toks[:16], _E(16))
+    c.insert(sys_toks[:32], _E(32))
+    L, _ = c.lookup(list(sys_toks[:32]) + [7])
+    assert L == 32
+    c.insert(sys_toks[:48], _E(48))
+    assert sorted(len(k) for k in c._entries) == [32, 48]
+
+    # wants(): duplicate keys and over-budget prefixes are refused before
+    # any device work.
+    assert not c.wants(sys_toks[:48])
+    assert not _PrefixCache(budget_tokens=8, chunk=16).wants(sys_toks[:16])

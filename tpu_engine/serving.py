@@ -397,6 +397,112 @@ def speculative_round(
     return tgt, n_acc, cache, draft_cache
 
 
+def _slice_prefix(c1: KVCache, L: int) -> KVCache:
+    """First ``L`` lanes of a single-row ingestion cache — the stored
+    form of a prefix-cache entry (non-ring caches only: lane == position)."""
+    return KVCache(
+        k=c1.k[:, :, :L], v=c1.v[:, :, :L], pos=c1.pos[:L],
+        length=jnp.asarray(L, jnp.int32), ring=False,
+        k_scale=None if c1.k_scale is None else c1.k_scale[:, :, :L],
+        v_scale=None if c1.v_scale is None else c1.v_scale[:, :, :L],
+    )
+
+
+def _paste_prefix(c1: KVCache, entry: KVCache) -> KVCache:
+    """Write a cached prefix's lanes into a fresh ingestion cache and set
+    its length to the prefix length — the prompt's remaining chunks then
+    prefill from there."""
+    def put(dst, src):
+        return lax.dynamic_update_slice(dst, src.astype(dst.dtype),
+                                        (0, 0, 0, 0, 0))
+
+    return KVCache(
+        k=put(c1.k, entry.k), v=put(c1.v, entry.v),
+        pos=lax.dynamic_update_slice(c1.pos, entry.pos, (0,)),
+        length=entry.length, ring=False,
+        k_scale=None if c1.k_scale is None else put(c1.k_scale, entry.k_scale),
+        v_scale=None if c1.v_scale is None else put(c1.v_scale, entry.v_scale),
+    )
+
+
+@dataclass
+class _PrefixEntry:
+    kv: KVCache
+    hits: int = 0
+
+
+class _PrefixCache:
+    """LRU cache of prompt-prefix KV (host-side bookkeeping; entries are
+    device-resident :class:`KVCache` slices).
+
+    Keys are exact token tuples at ``prefill_chunk`` boundaries — chunked
+    prefill means a cached prefix resumes cleanly at a chunk edge.
+    Requests sharing a system prompt pay its prefill once; later
+    admissions paste the cached lanes and ingest only their suffix.
+    Budgeted in TOKENS (eviction drops least-recently-used entries until
+    a new entry fits).
+
+    Redundancy control: a prompt's walk inserts every full-chunk
+    boundary, so a chain 256→512→…→N would hold O(N²) overlapping
+    lanes. On each insert the immediate PARENT entry (one chunk
+    shorter) is dropped if it has never been hit — a cold walk
+    collapses to its single longest prefix, while a parent another
+    request actually reuses (the hot system prompt under a longer
+    unique-suffix boundary) is protected by its hit count."""
+
+    def __init__(self, budget_tokens: int, chunk: int):
+        self.budget = int(budget_tokens)
+        self.chunk = int(chunk)
+        self._entries: "collections.OrderedDict[tuple, _PrefixEntry]" = \
+            collections.OrderedDict()
+        self.tokens = 0
+        self.hits = 0
+        self.misses = 0
+
+    def lookup(self, prompt: list[int]) -> tuple[int, Optional[KVCache]]:
+        """Longest cached chunk-boundary prefix STRICTLY before the
+        prompt's last token (the final chunk must still run — its logits
+        seed the first generated token). Returns (length, entry|None)."""
+        max_l = ((len(prompt) - 1) // self.chunk) * self.chunk
+        for L in range(max_l, 0, -self.chunk):
+            entry = self._entries.get(tuple(prompt[:L]))
+            if entry is not None:
+                self._entries.move_to_end(tuple(prompt[:L]))
+                entry.hits += 1
+                self.hits += 1
+                return L, entry.kv
+        self.misses += 1
+        return 0, None
+
+    def wants(self, prefix: tuple) -> bool:
+        """True iff ``insert`` would store this key — checked BEFORE the
+        caller pays the device slice, so rejected boundaries cost no
+        copies."""
+        return len(prefix) <= self.budget and prefix not in self._entries
+
+    def _drop(self, key: tuple) -> None:
+        old = self._entries.pop(key)
+        self.tokens -= old.kv.max_len
+
+    def insert(self, prefix: tuple, entry: KVCache) -> None:
+        L = len(prefix)
+        if not self.wants(prefix):
+            return
+        parent = prefix[:L - self.chunk]
+        if parent in self._entries and self._entries[parent].hits == 0:
+            self._drop(parent)  # subsumed, never independently reused
+        while self.tokens + L > self.budget and self._entries:
+            self._drop(next(iter(self._entries)))
+        self._entries[prefix] = _PrefixEntry(kv=entry)
+        self.tokens += L
+
+    def stats(self) -> dict[str, int]:
+        return {
+            "entries": len(self._entries), "tokens": self.tokens,
+            "hits": self.hits, "misses": self.misses,
+        }
+
+
 @dataclass
 class Request:
     """One generation request's lifecycle (host-side bookkeeping)."""
@@ -427,6 +533,7 @@ class _PrefillState:
     toks: np.ndarray    # [1, padded] int32 — prompt, zero-padded
     consumed: int = 0
     dc1: Optional[KVCache] = None
+    prefix_checked: bool = False
 
     @property
     def padded(self) -> int:
@@ -465,6 +572,7 @@ class ContinuousBatcher:
         draft_cfg: Optional[ModelConfig] = None,
         spec_gamma: int = 4,
         kv_quant: bool = False,
+        prefix_cache_tokens: int = 0,
     ):
         self.params = params
         self.cfg = cfg
@@ -557,6 +665,36 @@ class ContinuousBatcher:
                 _insert_prefill, donate_argnums=(0,), static_argnums=(4,),
             )
             self._draft_reset = jax.jit(_reset_slot, donate_argnums=(0,))
+
+        # -- prompt-prefix KV cache (shared system prompts) -----------------
+        self._prefix_cache: Optional[_PrefixCache] = None
+        if prefix_cache_tokens:
+            if self._cache.ring:
+                raise ValueError(
+                    "prefix_cache_tokens does not support sliding-window "
+                    "models (ring lanes wrap — a stored prefix's lanes are "
+                    "not position-stable)"
+                )
+            if draft_params is not None:
+                raise ValueError(
+                    "prefix_cache_tokens with speculative serving is not "
+                    "supported (the draft cache would miss the prefix and "
+                    "desynchronise)"
+                )
+            self._prefix_cache = _PrefixCache(prefix_cache_tokens,
+                                              self.prefill_chunk)
+            # Slice/paste shapes are static per (cache size, L) pair; L is
+            # always a prefill_chunk multiple, so compiled variants stay few.
+            self._slice_prefix = jax.jit(_slice_prefix, static_argnums=(1,))
+            self._paste_prefix = jax.jit(
+                _paste_prefix, donate_argnums=(0,),
+                out_shardings=None if mesh is None else KVCache(
+                    k=self._kv_sh, v=self._kv_sh, pos=self._rep,
+                    length=self._rep, ring=False,
+                    k_scale=self._kv_sh if self.kv_quant else None,
+                    v_scale=self._kv_sh if self.kv_quant else None,
+                ),
+            )
 
         self._decode = jax.jit(
             partial(decode_chunk, cfg=cfg, n_steps=self.chunk_steps,
@@ -693,6 +831,8 @@ class ContinuousBatcher:
                 "speculative": self._draft_params is not None,
                 "kv_quant": self.kv_quant,
             }
+            if self._prefix_cache is not None:
+                out["prefix_cache"] = self._prefix_cache.stats()
             if self._spec_rounds:
                 # Mean accepted tokens per draft round, of gamma+1 possible.
                 out["spec_accept_rate"] = round(
@@ -743,6 +883,18 @@ class ContinuousBatcher:
     def _advance_prefill(self, st: _PrefillState) -> bool:
         """Ingest ONE bounded chunk; True when the prompt is fully in and
         its K/V rows have been copied into the slot."""
+        if self._prefix_cache is not None and not st.prefix_checked:
+            # Lookup at FIRST advance, not at admission: prefills drain
+            # one chunk per engine step in admission order, so a burst of
+            # same-prefix admissions still hits entries the first prompt
+            # creates (admission-time lookup would see an empty cache).
+            st.prefix_checked = True
+            hit_len, entry = self._prefix_cache.lookup(st.req.prompt)
+            if entry is not None:
+                # Paste the cached lanes; ingestion resumes at the chunk
+                # edge — the shared prefix's forward never reruns.
+                st.c1 = self._paste_prefix(st.c1, entry)
+                st.consumed = hit_len
         t0 = st.consumed
         t1 = min(t0 + self.prefill_chunk, st.padded)
         chunk = jnp.asarray(st.toks[:, t0:t1])
@@ -756,6 +908,19 @@ class ContinuousBatcher:
         if st.dc1 is not None:  # speculative: the draft ingests the prompt too
             st.dc1 = self._draft_prefill_fn(self._draft_params, chunk, st.dc1)
         st.consumed = t1
+        if (
+            self._prefix_cache is not None
+            and t1 <= P_len
+            and t1 % self.prefill_chunk == 0
+            # wants() first: a rejected boundary (over budget, already
+            # cached) must not pay the device slice.
+            and self._prefix_cache.wants(tuple(st.req.prompt[:t1]))
+        ):
+            # Full-chunk prefix of REAL tokens: snapshot its lanes for
+            # later admissions sharing it (LRU, token-budgeted).
+            self._prefix_cache.insert(
+                tuple(st.req.prompt[:t1]), self._slice_prefix(st.c1, t1)
+            )
         if t0 <= P_len - 1 < t1:
             self._pending_first_logits[st.slot] = np.asarray(last_row)
         if st.consumed < st.padded:
