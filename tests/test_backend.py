@@ -643,7 +643,8 @@ def test_prometheus_metrics_endpoint(client):
     assert "tpu_engine_serving_up 0" in body
     r3 = client.post("/api/v1/serving/start",
                      json={"model_name": "gpt-tiny", "max_slots": 2,
-                           "max_len": 64})
+                           "max_len": 64, "kv_cache": "int8",
+                           "prefix_cache_tokens": 256})
     assert r3.status_code == 200, r3.text
     try:
         body = client.get("/metrics").text
@@ -651,6 +652,9 @@ def test_prometheus_metrics_endpoint(client):
         assert "tpu_engine_serving_slots 2" in body
         assert "tpu_engine_serving_chunk_steps" in body
         assert "tpu_engine_serving_sharded 0" in body
+        assert "tpu_engine_serving_kv_quant 1" in body
+        assert "tpu_engine_serving_prefix_cache_entries 0" in body
+        assert "tpu_engine_serving_prefix_cache_misses_total 0" in body
     finally:
         client.post("/api/v1/serving/stop")
     # Proper exposition format: versioned content type, HELP/TYPE per

@@ -462,12 +462,17 @@ class _PrefixCache:
     def lookup(self, prompt: list[int]) -> tuple[int, Optional[KVCache]]:
         """Longest cached chunk-boundary prefix STRICTLY before the
         prompt's last token (the final chunk must still run — its logits
-        seed the first generated token). Returns (length, entry|None)."""
-        max_l = ((len(prompt) - 1) // self.chunk) * self.chunk
+        seed the first generated token). Returns (length, entry|None).
+        Probe depth is capped at the budget (no longer entry can exist),
+        so the host work is budget-bounded, not prompt-length-bounded."""
+        max_l = min(((len(prompt) - 1) // self.chunk) * self.chunk,
+                    (self.budget // self.chunk) * self.chunk)
+        head = tuple(prompt[:max_l])
         for L in range(max_l, 0, -self.chunk):
-            entry = self._entries.get(tuple(prompt[:L]))
+            key = head[:L]
+            entry = self._entries.get(key)
             if entry is not None:
-                self._entries.move_to_end(tuple(prompt[:L]))
+                self._entries.move_to_end(key)
                 entry.hits += 1
                 self.hits += 1
                 return L, entry.kv
@@ -908,19 +913,25 @@ class ContinuousBatcher:
         if st.dc1 is not None:  # speculative: the draft ingests the prompt too
             st.dc1 = self._draft_prefill_fn(self._draft_params, chunk, st.dc1)
         st.consumed = t1
-        if (
-            self._prefix_cache is not None
-            and t1 <= P_len
-            and t1 % self.prefill_chunk == 0
-            # wants() first: a rejected boundary (over budget, already
-            # cached) must not pay the device slice.
-            and self._prefix_cache.wants(tuple(st.req.prompt[:t1]))
-        ):
-            # Full-chunk prefix of REAL tokens: snapshot its lanes for
-            # later admissions sharing it (LRU, token-budgeted).
-            self._prefix_cache.insert(
-                tuple(st.req.prompt[:t1]), self._slice_prefix(st.c1, t1)
-            )
+        if self._prefix_cache is not None:
+            # Insert ONLY at the walk's last cacheable boundary (largest
+            # full chunk of REAL tokens within the budget): intermediate
+            # boundaries would be chain-dropped by the very next insert
+            # anyway (lookups happen at first advance and prefills drain
+            # head-of-line, so no hit can land mid-walk) — slicing them
+            # would add O(N²/chunk) discarded HBM copies to this
+            # request's own TTFT. Cross-walk behavior is unchanged: a
+            # later request sharing a SHORTER prefix re-creates that
+            # boundary on its own walk.
+            c = self.prefill_chunk
+            last = min((P_len // c) * c,
+                       (self._prefix_cache.budget // c) * c)
+            if t1 == last and self._prefix_cache.wants(
+                tuple(st.req.prompt[:t1])
+            ):
+                self._prefix_cache.insert(
+                    tuple(st.req.prompt[:t1]), self._slice_prefix(st.c1, t1)
+                )
         if t0 <= P_len - 1 < t1:
             self._pending_first_logits[st.slot] = np.asarray(last_row)
         if st.consumed < st.padded:
