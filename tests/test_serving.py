@@ -675,36 +675,24 @@ def test_prefix_cache_guards():
                           prefix_cache_tokens=128)
 
 
-def test_prefix_cache_chain_dedup_policy():
-    """A cold walk's nested boundary entries collapse to the longest
-    (unhit parents are subsumed); a parent another request actually HIT
-    is protected from the chain-drop."""
+def test_prefix_cache_store_policy():
+    """One entry per walk (the caller stores only its last cacheable
+    boundary): wants() refuses duplicates and over-budget prefixes before
+    any device work, and eviction is LRU within the token budget."""
     from tpu_engine.serving import _PrefixCache
 
     class _E:  # stands in for a KVCache slice
         def __init__(self, n):
             self.max_len = n
 
-    sys_toks = tuple(range(48))
-
-    # Cold walk of a 48-token prefix: 16 -> 32 -> 48 collapses to {48}.
-    c = _PrefixCache(budget_tokens=1024, chunk=16)
-    for L in (16, 32, 48):
-        c.insert(sys_toks[:L], _E(L))
-    assert sorted(len(k) for k in c._entries) == [48]
-    assert c.tokens == 48
-
-    # Same walk, but the 32-boundary gets a HIT before 48 inserts: the
-    # hit parent survives the chain-drop (it is independently useful).
-    c = _PrefixCache(budget_tokens=1024, chunk=16)
-    c.insert(sys_toks[:16], _E(16))
-    c.insert(sys_toks[:32], _E(32))
-    L, _ = c.lookup(list(sys_toks[:32]) + [7])
-    assert L == 32
+    sys_toks = tuple(range(64))
+    c = _PrefixCache(budget_tokens=96, chunk=16)
     c.insert(sys_toks[:48], _E(48))
-    assert sorted(len(k) for k in c._entries) == [32, 48]
-
-    # wants(): duplicate keys and over-budget prefixes are refused before
-    # any device work.
-    assert not c.wants(sys_toks[:48])
-    assert not _PrefixCache(budget_tokens=8, chunk=16).wants(sys_toks[:16])
+    assert not c.wants(sys_toks[:48])          # duplicate refused
+    assert not c.wants(tuple(range(100, 228)))  # 128 > budget refused
+    # LRU eviction: inserting 64 on a 96 budget evicts the older 48.
+    c.insert(tuple(range(200, 264)), _E(64))
+    assert c.tokens == 64 and len(c._entries) == 1
+    # Budget-capped lookup: a long prompt probes only up to the budget.
+    L, e = c.lookup(list(range(200, 264)) + list(range(500, 600)))
+    assert L == 64 and e is not None

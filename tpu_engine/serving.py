@@ -425,12 +425,6 @@ def _paste_prefix(c1: KVCache, entry: KVCache) -> KVCache:
     )
 
 
-@dataclass
-class _PrefixEntry:
-    kv: KVCache
-    hits: int = 0
-
-
 class _PrefixCache:
     """LRU cache of prompt-prefix KV (host-side bookkeeping; entries are
     device-resident :class:`KVCache` slices).
@@ -442,18 +436,18 @@ class _PrefixCache:
     Budgeted in TOKENS (eviction drops least-recently-used entries until
     a new entry fits).
 
-    Redundancy control: a prompt's walk inserts every full-chunk
-    boundary, so a chain 256→512→…→N would hold O(N²) overlapping
-    lanes. On each insert the immediate PARENT entry (one chunk
-    shorter) is dropped if it has never been hit — a cold walk
-    collapses to its single longest prefix, while a parent another
-    request actually reuses (the hot system prompt under a longer
-    unique-suffix boundary) is protected by its hit count."""
+    Redundancy control lives at the CALLER: each prefill walk stores one
+    entry — its last cacheable boundary — so a cold N-token prefix costs
+    one slice of N lanes, never an O(N²) chain of nested copies. (Walks
+    are strictly serial — head-of-line prefill — and each walk's lookup
+    probes every shallower boundary before its insert, so a nested
+    parent entry is always the one the walk just hit, never a redundant
+    leftover.)"""
 
     def __init__(self, budget_tokens: int, chunk: int):
         self.budget = int(budget_tokens)
         self.chunk = int(chunk)
-        self._entries: "collections.OrderedDict[tuple, _PrefixEntry]" = \
+        self._entries: "collections.OrderedDict[tuple, KVCache]" = \
             collections.OrderedDict()
         self.tokens = 0
         self.hits = 0
@@ -473,9 +467,8 @@ class _PrefixCache:
             entry = self._entries.get(key)
             if entry is not None:
                 self._entries.move_to_end(key)
-                entry.hits += 1
                 self.hits += 1
-                return L, entry.kv
+                return L, entry
         self.misses += 1
         return 0, None
 
@@ -487,18 +480,15 @@ class _PrefixCache:
 
     def _drop(self, key: tuple) -> None:
         old = self._entries.pop(key)
-        self.tokens -= old.kv.max_len
+        self.tokens -= old.max_len
 
     def insert(self, prefix: tuple, entry: KVCache) -> None:
         L = len(prefix)
         if not self.wants(prefix):
             return
-        parent = prefix[:L - self.chunk]
-        if parent in self._entries and self._entries[parent].hits == 0:
-            self._drop(parent)  # subsumed, never independently reused
         while self.tokens + L > self.budget and self._entries:
             self._drop(next(iter(self._entries)))
-        self._entries[prefix] = _PrefixEntry(kv=entry)
+        self._entries[prefix] = entry
         self.tokens += L
 
     def stats(self) -> dict[str, int]:

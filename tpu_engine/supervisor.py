@@ -859,6 +859,15 @@ class TrainingJob:
     # -- views ---------------------------------------------------------------
 
     def describe(self) -> dict[str, Any]:
+        spill = None
+        store = getattr(self.program, "disk_store", None) if self.program else None
+        if store is not None:
+            try:
+                spill = store.spill_bytes()
+            except RuntimeError:
+                # The train thread may be repopulating the slab dict
+                # (attach/reseed) — a transient miss, not an error.
+                spill = None
         return {
             "job_id": self.job_id,
             "status": self.status.value,
@@ -878,6 +887,7 @@ class TrainingJob:
             "monitor": self.monitor.get_summary(),
             "profile": self.profiler.summary() if self.profiler is not None else None,
             "eval": self.eval_summary(),
+            "disk_spill_bytes": spill,
         }
 
     def eval_summary(self) -> Optional[dict[str, Any]]:
