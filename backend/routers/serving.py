@@ -23,10 +23,14 @@ from backend.http import ApiError, json_response, parse_body
 class ServingStartRequest(BaseModel):
     model_config = ConfigDict(extra="forbid")
 
-    # Weight source: a supervised job id (its CURRENT params) or a model
-    # name (fresh deterministic init — test/demo use).
+    # Weight source (exactly one): a supervised job id (its CURRENT
+    # params), a model name (fresh deterministic init — test/demo use),
+    # or an int8 serving snapshot directory written by
+    # /training/jobs/{id}/export {"format": "int8"} (quantize once,
+    # serve many times — the snapshot is self-describing).
     job_id: Optional[str] = None
     model_name: Optional[str] = None
+    snapshot_dir: Optional[str] = None
     max_slots: int = Field(default=4, ge=1, le=64)
     max_len: int = Field(default=1024, ge=8)
     # Tokens per device dispatch (host round-trip amortisation) — greedy
@@ -85,8 +89,13 @@ def _shutdown_locked() -> None:
 
 async def start_server(request: web.Request) -> web.Response:
     req = await parse_body(request, ServingStartRequest)
-    if (req.job_id is None) == (req.model_name is None):
-        raise ApiError(422, "provide exactly one of job_id / model_name")
+    n_sources = sum(
+        s is not None for s in (req.job_id, req.model_name, req.snapshot_dir)
+    )
+    if n_sources != 1:
+        raise ApiError(
+            422, "provide exactly one of job_id / model_name / snapshot_dir"
+        )
 
     def _start():
         import jax
@@ -131,6 +140,55 @@ async def start_server(request: web.Request) -> web.Response:
                     )
                     params = jax.device_put(
                         params, named_shardings(mesh, qspecs))
+        elif req.snapshot_dir is not None:
+            import os as _os
+
+            from tpu_engine.quant import (
+                load_quantized, load_quantized_config, quantize_params,
+                quantize_pspecs,
+            )
+
+            if req.quantize is not None:
+                raise ApiError(
+                    422, "snapshot_dir weights are already quantized; "
+                         "drop the quantize field"
+                )
+            if not _os.path.exists(
+                _os.path.join(req.snapshot_dir, "quant_snapshot.json")
+            ):
+                raise ApiError(
+                    404, f"no quantized snapshot at '{req.snapshot_dir}'"
+                )
+            cfg = load_quantized_config(req.snapshot_dir)
+            if cfg is None:
+                raise ApiError(
+                    422, "snapshot has no recorded model_config (written "
+                         "by an older save_quantized?)"
+                )
+            qsh = None
+            if req.tensor_parallel > 1 or req.fsdp > 1:
+                from tpu_engine.mesh_runtime import MeshConfig, build_mesh
+                from tpu_engine.models.transformer import (
+                    init_params, logical_axes,
+                )
+                from tpu_engine.sharding import (
+                    ShardingStage, named_shardings, param_pspecs,
+                )
+                try:
+                    mesh = build_mesh(MeshConfig(
+                        fsdp=req.fsdp, model=req.tensor_parallel,
+                    ))
+                except ValueError as e:
+                    raise ApiError(422, str(e))
+                abs_q = jax.eval_shape(quantize_params, jax.eval_shape(
+                    lambda k: init_params(k, cfg), jax.random.PRNGKey(0)
+                ))
+                qsh = named_shardings(mesh, quantize_pspecs(
+                    param_pspecs(logical_axes(cfg),
+                                 ShardingStage.FULL_PARTITIONING),
+                    abs_q,
+                ))
+            params = load_quantized(req.snapshot_dir, shardings=qsh)
         else:
             cfg = tfm.MODEL_CONFIGS.get(req.model_name)
             if cfg is None:
@@ -195,7 +253,9 @@ async def start_server(request: web.Request) -> web.Response:
     return json_response({
         "started": True, "model": name, "max_slots": req.max_slots,
         "max_len": req.max_len, "sharded": sharded,
-        "quantize": req.quantize,
+        # Snapshot weights arrive already int8-quantized — report the
+        # precision actually being served, not the request field.
+        "quantize": "int8" if req.snapshot_dir is not None else req.quantize,
     })
 
 

@@ -385,21 +385,29 @@ async def list_job_checkpoints(request: web.Request) -> web.Response:
 
 class ExportRequest(BaseModel):
     out_dir: str
+    # "hf": transformers-loadable checkpoint (the default);
+    # "int8": weight-only-quantized serving snapshot (self-describing —
+    # serve it back via /serving/start {"snapshot_dir": ...}).
+    format: Literal["hf", "int8"] = "hf"
 
 
 async def export_job_checkpoint(request: web.Request) -> web.Response:
-    """Export the job's current weights as an HF LlamaForCausalLM
-    checkpoint directory (LoRA jobs export base+adapters merged)."""
+    """Export the job's current weights: an HF LlamaForCausalLM
+    checkpoint directory (LoRA jobs export base+adapters merged), or an
+    int8-quantized serving snapshot."""
     job_id = request.match_info["job_id"]
     job = state.launcher.get_job(job_id)
     if job is None:
         raise ApiError(404, f"job '{job_id}' not found")
     req = await parse_body(request, ExportRequest)
+    fn = (job.export_quantized_snapshot if req.format == "int8"
+          else job.export_hf_checkpoint)
     try:
-        path, step = await asyncio.to_thread(job.export_hf_checkpoint, req.out_dir)
+        path, step = await asyncio.to_thread(fn, req.out_dir)
     except (RuntimeError, ValueError) as e:
         raise ApiError(422, str(e))
-    return json_response({"job_id": job_id, "step": step, "path": path})
+    return json_response({"job_id": job_id, "step": step, "path": path,
+                          "format": req.format})
 
 
 async def generate_from_job(request: web.Request) -> web.Response:

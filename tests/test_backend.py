@@ -890,3 +890,72 @@ def test_serving_quantized_over_http(client):
         "/api/v1/serving/start",
         json={"model_name": "gpt-tiny", "quantize": "int4"},
     ).status_code == 422
+
+
+def test_quantized_snapshot_export_and_serve(client, tmp_path):
+    """Round 4: train -> export {"format": "int8"} -> serve from the
+    self-describing snapshot; the served stream matches generate() on the
+    loaded snapshot tree."""
+    r = client.post(
+        "/api/v1/training/launch",
+        json={
+            "model_name": "gpt-tiny", "micro_batch_size": 2, "seq_len": 32,
+            "precision": "fp32", "total_steps": 2, "warmup_steps": 1,
+            "activation_checkpointing": False, "dry_run": False,
+        },
+    )
+    assert r.status_code == 200, r.text
+    job_id = r.json()["job_id"]
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        if client.get(f"/api/v1/training/jobs/{job_id}").json()["status"] in (
+            "completed", "failed",
+        ):
+            break
+        time.sleep(1)
+
+    snap = str(tmp_path / "snap")
+    r = client.post(f"/api/v1/training/jobs/{job_id}/export",
+                    json={"out_dir": snap, "format": "int8"})
+    assert r.status_code == 200, r.text
+    assert r.json()["format"] == "int8"
+
+    # Serving from the snapshot needs no model_name and no quantize flag.
+    assert client.post("/api/v1/serving/start",
+                       json={"snapshot_dir": snap, "quantize": "int8"}
+                       ).status_code == 422
+    assert client.post("/api/v1/serving/start",
+                       json={"snapshot_dir": str(tmp_path / "nope")}
+                       ).status_code == 404
+    r = client.post("/api/v1/serving/start",
+                    json={"snapshot_dir": snap, "max_slots": 2,
+                          "max_len": 64})
+    assert r.status_code == 200, r.text
+    assert r.json()["model"] == "gpt-tiny"
+    try:
+        prompt = [5, 6, 7, 8]
+        rid = client.post(
+            "/api/v1/serving/submit",
+            json={"prompt": prompt, "max_new_tokens": 6},
+        ).json()["request_id"]
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            body = client.get(f"/api/v1/serving/result/{rid}").json()
+            if body["status"] in ("done", "failed"):
+                break
+            time.sleep(0.2)
+        assert body["status"] == "done", body
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tpu_engine.generate import generate
+        from tpu_engine.quant import load_quantized, load_quantized_config
+
+        cfg = load_quantized_config(snap)
+        tree = load_quantized(snap)
+        ref = generate(tree, jnp.asarray([prompt], jnp.int32), cfg,
+                       max_new_tokens=6)
+        assert body["tokens"] == np.asarray(ref)[0, len(prompt):].tolist()
+    finally:
+        client.post("/api/v1/serving/stop")

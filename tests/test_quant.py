@@ -219,3 +219,70 @@ def test_quantized_pspec_mirror_shards_on_mesh():
         if b.result(rid)["status"] == "done":
             break
     assert b.result(rid)["tokens"] == np.asarray(ref)[0, len(prompt):].tolist()
+
+
+def test_quantized_snapshot_roundtrip(tmp_path):
+    """save_quantized / load_quantized: bit-identical tree back (codes,
+    scales, and full-precision leaves incl. bf16), streams unchanged."""
+    from tpu_engine.quant import load_quantized, save_quantized
+
+    cfg, params = _params("gpt2-tiny")  # biases + tied head in the tree
+    params = jax.tree.map(lambda a: a.astype(jnp.bfloat16), params)
+    qparams = quantize_params(params)
+    save_quantized(qparams, str(tmp_path / "snap"))
+    loaded = load_quantized(str(tmp_path / "snap"))
+
+    a_leaves = jax.tree.leaves(qparams)
+    b_leaves = jax.tree.leaves(loaded)
+    assert len(a_leaves) == len(b_leaves)
+    for a, b in zip(a_leaves, b_leaves):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    prompt = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
+    ref = generate(qparams, prompt, cfg, max_new_tokens=8)
+    got = generate(loaded, prompt, cfg, max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_quantized_snapshot_sharded_load(tmp_path):
+    from tpu_engine.mesh_runtime import MeshConfig, build_mesh
+    from tpu_engine.models.transformer import logical_axes
+    from tpu_engine.quant import load_quantized, save_quantized
+    from tpu_engine.serving import ContinuousBatcher
+    from tpu_engine.sharding import (
+        ShardingStage, named_shardings, param_pspecs,
+    )
+
+    cfg, params = _params()
+    qparams = quantize_params(params)
+    save_quantized(qparams, str(tmp_path / "snap"))
+
+    mesh = build_mesh(MeshConfig(fsdp=2, model=4))
+    qsh = named_shardings(mesh, quantize_pspecs(
+        param_pspecs(logical_axes(cfg), ShardingStage.FULL_PARTITIONING),
+        qparams,
+    ))
+    loaded = load_quantized(str(tmp_path / "snap"), shardings=qsh)
+    qk = loaded["layers"]["q"]["kernel"]
+    assert qk.q.sharding.spec[-1] == "model"
+
+    prompt = [3, 1, 4, 1, 5]
+    ref = generate(qparams, jnp.asarray([prompt], jnp.int32), cfg,
+                   max_new_tokens=6)
+    srv = ContinuousBatcher(loaded, cfg, max_slots=2, max_len=64,
+                            chunk_steps=3, mesh=mesh)
+    rid = srv.submit(prompt, max_new_tokens=6)
+    for _ in range(60):
+        srv.step()
+        if srv.result(rid)["status"] == "done":
+            break
+    assert srv.result(rid)["tokens"] == np.asarray(ref)[0, len(prompt):].tolist()
+
+
+def test_save_quantized_rejects_plain_tree(tmp_path):
+    from tpu_engine.quant import save_quantized
+
+    _, params = _params()
+    with pytest.raises(ValueError, match="no QuantWeight"):
+        save_quantized(params, str(tmp_path / "snap"))

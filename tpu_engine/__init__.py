@@ -58,9 +58,12 @@ from tpu_engine.generate import (
 from tpu_engine.quant import (
     QuantWeight,
     dequantize_weight,
+    load_quantized,
+    load_quantized_config,
     quantize_params,
     quantize_pspecs,
     quantize_weight,
+    save_quantized,
 )
 
 __version__ = "0.1.0"

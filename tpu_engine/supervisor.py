@@ -856,6 +856,26 @@ class TrainingJob:
                 params = jax.device_get(params)
         return save_hf_checkpoint(params, self.program.model_config, out_dir), step
 
+    def export_quantized_snapshot(self, out_dir: str) -> tuple[str, int]:
+        """Quantize the job's current weights (weight-only int8,
+        ``tpu_engine/quant.py``) and persist them as a self-describing
+        serving snapshot — quantize once, serve many times
+        (``/serving/start {"snapshot_dir": ...}`` or
+        ``quant.load_quantized``). Returns ``(out_dir, step)``."""
+        from tpu_engine.quant import quantize_params, save_quantized
+
+        if self.program is None or self._state is None:
+            raise RuntimeError("job has no initialized state to export")
+        # _params_snapshot takes the state lock itself (and returns
+        # donation-safe buffers); the step is read after — a running job
+        # may be off by the in-flight step, same as the generate path.
+        params = self._params_snapshot()
+        step = self.current_step
+        qparams = quantize_params(params)
+        return save_quantized(
+            qparams, out_dir, model_config=self.program.model_config
+        ), step
+
     # -- views ---------------------------------------------------------------
 
     def describe(self) -> dict[str, Any]:
