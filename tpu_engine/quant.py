@@ -217,13 +217,36 @@ def save_quantized(qparams: dict[str, Any], out_dir: str,
         manifest["model_config"] = _dc.asdict(model_config)
     n_quant = 0
 
+    _CHUNK_BYTES = 128 * 2**20
+
     def record(path: str, arr, kind: str) -> None:
         fname = path.replace("/", "__") + ".npy"
-        host = np.asarray(arr)
-        np.save(os.path.join(out_dir, fname), host)
+        fpath = os.path.join(out_dir, fname)
+        shape = tuple(arr.shape)
+        nbytes = int(np.prod(shape or (1,))) * jnp.dtype(arr.dtype).itemsize
+        if nbytes > _CHUNK_BYTES and shape and shape[0] > 1:
+            # Big stacked leaves (a 7B gate kernel is ~1.4 GB) fetch in
+            # bounded slices along the leading dim: one giant device→host
+            # transfer can stall remote runtimes, and the host never
+            # needs more than a chunk resident. The memmap writes the
+            # same .npy format np.save would.
+            rows = max(1, shape[0] * _CHUNK_BYTES // nbytes)
+            first = np.asarray(arr[:1])
+            out = np.lib.format.open_memmap(
+                fpath, mode="w+", dtype=first.dtype, shape=shape
+            )
+            out[:1] = first
+            for i in range(1, shape[0], rows):
+                out[i:i + rows] = np.asarray(arr[i:i + rows])
+            out.flush()
+            host_dtype = first.dtype
+        else:
+            host = np.asarray(arr)
+            np.save(fpath, host)
+            host_dtype = host.dtype
         manifest["leaves"][path] = {
-            "file": fname, "kind": kind, "dtype": str(host.dtype),
-            "shape": list(host.shape),
+            "file": fname, "kind": kind, "dtype": str(host_dtype),
+            "shape": list(shape),
         }
 
     def walk(node, prefix: str) -> None:
