@@ -17,6 +17,7 @@ import time
 
 from aiohttp import web
 
+from backend import openapi
 from backend.http import cors_middleware, error_middleware, json_response
 from backend.routers import metrics, monitoring, profiling, serving, topology, tpu, training
 
@@ -56,6 +57,11 @@ async def root(request: web.Request) -> web.Response:
                 "jax.profiler trace capture, per-step wall-clock breakdown, "
                 "and structured JSONL metrics logs",
                 "Prometheus /metrics exporting both telemetry planes",
+                "continuous-batching serving with SSE token streaming, "
+                "prompt-prefix KV reuse, int8 weights/KV, and speculative "
+                "decoding",
+                "OpenAPI 3.1 schema (/openapi.json) and self-contained "
+                "/docs page",
             ],
             "endpoints": {
                 "tpu": "/api/v1/tpu",
@@ -64,6 +70,8 @@ async def root(request: web.Request) -> web.Response:
                 "topology": "/api/v1/topology",
                 "profile": "/api/v1/profile",
                 "metrics": "/metrics",
+                "openapi": "/openapi.json",
+                "docs": "/docs",
             },
         }
     )
@@ -99,6 +107,8 @@ def create_app() -> web.Application:
     metrics.setup(app)
     app.router.add_get("/", root)
     app.router.add_get("/health", health_check)
+    openapi.setup(app, title="tpu-distributed-llm-training-manager",
+                  version=VERSION)
     return app
 
 

@@ -16,6 +16,7 @@ from aiohttp import web
 from pydantic import BaseModel
 
 from backend import state
+from backend.openapi import body
 from backend.http import ApiError, json_response, parse_body
 from tpu_engine.loss_monitor import MonitorConfig, SpikeAlert, TrainingMetrics
 
@@ -56,6 +57,7 @@ def _reject_supervised_write(job_id: str) -> None:
         )
 
 
+@body(CreateMonitorRequest)
 async def create_monitor(request: web.Request) -> web.Response:
     """Create (or return) a monitor for a job (reference ``monitoring.py:49-64``)."""
     req = await parse_body(request, CreateMonitorRequest)
@@ -66,6 +68,7 @@ async def create_monitor(request: web.Request) -> web.Response:
     )
 
 
+@body(IngestRequest)
 async def ingest_metrics(request: web.Request) -> web.Response:
     """Batch metrics ingest → alerts (reference ``monitoring.py:67-80``)."""
     req = await parse_body(request, IngestRequest)
@@ -77,6 +80,7 @@ async def ingest_metrics(request: web.Request) -> web.Response:
     return json_response(alerts)
 
 
+@body(IngestSingleRequest)
 async def ingest_single_metric(request: web.Request) -> web.Response:
     """Single-step ingest (reference ``monitoring.py:83-101``)."""
     req = await parse_body(request, IngestSingleRequest)

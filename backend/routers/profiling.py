@@ -20,6 +20,7 @@ from aiohttp import web
 from pydantic import BaseModel, Field
 
 from backend import state
+from backend.openapi import body
 from backend.http import ApiError, json_response, parse_body
 from tpu_engine.profiler import TraceSession
 
@@ -35,6 +36,7 @@ class TraceStartRequest(BaseModel):
     )
 
 
+@body(TraceStartRequest)
 async def trace_start(request: web.Request) -> web.Response:
     req = await parse_body(request, TraceStartRequest)
     log_dir = req.log_dir or tempfile.mkdtemp(prefix="tpu_trace_")

@@ -11,6 +11,7 @@ from aiohttp import web
 
 from backend import state
 from backend.http import ApiError, json_response
+from backend.openapi import response
 from tpu_engine.tpu_manager import TPUFleetStatus
 
 
@@ -24,11 +25,13 @@ def _fleet_or_mock() -> TPUFleetStatus:
         return state.manager.get_mock_fleet()
 
 
+@response(TPUFleetStatus, "Fleet status")
 async def get_fleet_status(request: web.Request) -> web.Response:
     """Live fleet telemetry (mock fallback when no runtime is available)."""
     return json_response(_fleet_or_mock())
 
 
+@response(TPUFleetStatus, "Mock fleet status")
 async def get_mock_fleet(request: web.Request) -> web.Response:
     """Hand-built v5e-8 fixture fleet (reference ``gpu.py:22-25``)."""
     return json_response(state.manager.get_mock_fleet())

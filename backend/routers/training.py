@@ -16,6 +16,7 @@ from aiohttp import web
 from pydantic import BaseModel, ConfigDict, Field
 
 from backend import state
+from backend.openapi import body
 from backend.http import ApiError, json_response, parse_body
 from tpu_engine.mesh_runtime import MeshConfig
 from tpu_engine.sharding import OffloadDevice, Precision, ShardingStage, TPUTrainConfig
@@ -174,6 +175,7 @@ def _to_config(req: TrainingLaunchRequest) -> TPUTrainConfig:
         raise ApiError(422, str(e))
 
 
+@body(TrainingLaunchRequest)
 async def launch_training(request: web.Request) -> web.Response:
     """Launch (or dry-run) a supervised in-process training job
     (reference ``launch_training``, ``training.py:56-80``)."""
@@ -188,6 +190,7 @@ async def launch_training(request: web.Request) -> web.Response:
     return json_response(result)
 
 
+@body(PresetLaunchRequest)
 async def launch_from_preset(request: web.Request) -> web.Response:
     """Launch from a named preset with overrides (reference ``training.py:83-97``)."""
     req = await parse_body(request, PresetLaunchRequest)
@@ -234,6 +237,7 @@ async def list_presets(request: web.Request) -> web.Response:
     )
 
 
+@body(TrainingLaunchRequest)
 async def generate_config(request: web.Request) -> web.Response:
     """Plan generation without launching (reference ``training.py:121-153``)."""
     req = await parse_body(request, TrainingLaunchRequest)
@@ -391,6 +395,7 @@ class ExportRequest(BaseModel):
     format: Literal["hf", "int8"] = "hf"
 
 
+@body(ExportRequest)
 async def export_job_checkpoint(request: web.Request) -> web.Response:
     """Export the job's current weights: an HF LlamaForCausalLM
     checkpoint directory (LoRA jobs export base+adapters merged), or an
@@ -410,6 +415,7 @@ async def export_job_checkpoint(request: web.Request) -> web.Response:
                           "format": req.format})
 
 
+@body(GenerateRequest)
 async def generate_from_job(request: web.Request) -> web.Response:
     """Qualitative sampling while (or after) a job trains — runs on a
     consistent snapshot of the job's weights."""

@@ -8,6 +8,11 @@ lower) doesn't drift across benchmarks/ and tests/.
 
 from __future__ import annotations
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 from typing import Any, Optional
 
 import jax

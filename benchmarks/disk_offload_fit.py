@@ -23,6 +23,11 @@ else.
 
 from __future__ import annotations
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import json
 import tempfile
 import time
@@ -46,21 +51,28 @@ def main() -> None:
         print(json.dumps({"skipped": "needs a local TPU"}))
         return
 
+    # micro_batch 8: enough device compute per step that the DPU overlap
+    # regime is visible — with micro=1 the host walk dominates (265 s vs
+    # ~15 s device through the tunnel) and hiding the device step is
+    # marginal by construction. DPU's win is bounded by
+    # (host+device)/max(host, device) in every regime; the bench reports
+    # both sides so the ratio is interpretable on local silicon too.
     base = dict(
-        model_name=MODEL, mesh=MeshConfig(), micro_batch_size=1,
+        model_name=MODEL, mesh=MeshConfig(), micro_batch_size=8,
         gradient_accumulation_steps=1, seq_len=2048,
         precision=Precision.BF16, total_steps=10, warmup_steps=2,
         activation_checkpointing=True,
     )
 
     out = {}
-    for mode in ("in_memory", "disk"):
+    for mode in ("in_memory", "disk", "disk_overlap"):
         kw = dict(base)
         spill = None
-        if mode == "disk":
+        if mode.startswith("disk"):
             spill = tempfile.mkdtemp(prefix="spill_")
             kw.update(optimizer_offload=OffloadDevice.DISK,
-                      optimizer_spill_dir=spill)
+                      optimizer_spill_dir=spill,
+                      disk_update_overlap=mode == "disk_overlap")
         prog = build_train_program(TPUTrainConfig(**kw))
         state = prog.init(jax.random.PRNGKey(0))
         batch = prog.synthetic_batch(0)
@@ -69,10 +81,18 @@ def main() -> None:
         state, _ = prog.step(state, batch)
         jax.block_until_ready(state["params"])
         warm_s = time.time() - t0
+        # Steady state over several steps; the overlap mode's walks drain
+        # in the background, so the flush at the end charges the final
+        # in-flight walk to the measured window (pipeline fill + drain
+        # both inside the timing — honest steady-state amortisation).
+        n_meas = 2
         t0 = time.time()
-        state, metrics = prog.step(state, batch)
+        for _ in range(n_meas):
+            state, metrics = prog.step(state, batch)
+        if prog.flush is not None:
+            state = prog.flush(state)
         jax.block_until_ready(state["params"])
-        step_s = time.time() - t0
+        step_s = (time.time() - t0) / n_meas
 
         state_gib = sum(
             leaf.size * leaf.dtype.itemsize
@@ -84,7 +104,7 @@ def main() -> None:
             "warm_step_s": round(warm_s, 2),
             "loss": round(float(metrics["loss"]), 3),
         }
-        if mode == "disk":
+        if mode.startswith("disk"):
             # The host update's device_get is a real sync, so wall time
             # is meaningful here; the in-memory step is async through
             # the tunnel (block_until_ready returns at enqueue — the
@@ -94,7 +114,7 @@ def main() -> None:
                 prog.disk_store.spill_bytes() / GIB, 2
             )
         out[mode] = row
-        print(json.dumps(row))
+        print(json.dumps(row), flush=True)
     print(json.dumps({
         "metric": "disk_tier_device_state_shrink",
         "in_memory_gib": out["in_memory"]["device_state_gib"],
@@ -102,6 +122,15 @@ def main() -> None:
         "shrink": round(
             out["in_memory"]["device_state_gib"]
             / max(out["disk"]["device_state_gib"], 1e-9), 2
+        ),
+    }))
+    print(json.dumps({
+        "metric": "disk_tier_overlap_speedup",
+        "serial_step_s": out["disk"]["step_wall_s"],
+        "overlap_step_s": out["disk_overlap"]["step_wall_s"],
+        "speedup": round(
+            out["disk"]["step_wall_s"]
+            / max(out["disk_overlap"]["step_wall_s"], 1e-9), 2
         ),
     }))
 

@@ -23,6 +23,11 @@ Each mode prints JSON lines; paste the summary into benchmarks/RESULTS.md.
 
 from __future__ import annotations
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 import json
 import time

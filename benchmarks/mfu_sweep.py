@@ -8,11 +8,14 @@ Usage: python benchmarks/mfu_sweep.py            # run all variants
 
 from __future__ import annotations
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 import json
-import os
 import subprocess
-import sys
 import time
 
 VARIANTS: dict[str, dict] = {

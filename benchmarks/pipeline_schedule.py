@@ -21,6 +21,11 @@ Run: ``python benchmarks/pipeline_schedule.py --aot|--wall``
 
 from __future__ import annotations
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 import json
 import time

@@ -8,6 +8,11 @@ GPU sizing was never validated anywhere.
 
 from __future__ import annotations
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import json
 import time
 
@@ -32,6 +37,15 @@ CANDIDATES = [
     ("70b-v5e64-fsdp64", "llama-70b", "v5e:8x8", dict(data=1, fsdp=64), 1, 4096,
      {"optimizer_offload": "host", "param_offload": "host",
       "loss_chunk_size": 1024}),
+    # The 8x7b MoE preset's declared slice (round-4 verdict weakness 2:
+    # the ONLY preset never AOT-fit-verified): experts ride the "model"
+    # axis (EP), attention is TP over the same axis, fsdp=4 shards the
+    # rest — 32 chips (v5e:4x8).
+    ("8x7b-v5e32-ep8", "moe-8x7b", "v5e:4x8", dict(data=1, fsdp=4, model=8),
+     1, 4096, {"optimizer_offload": "host"}),
+    ("8x7b-v5e32-ep8-chunk", "moe-8x7b", "v5e:4x8",
+     dict(data=1, fsdp=4, model=8), 1, 4096,
+     {"optimizer_offload": "host", "loss_chunk_size": 1024}),
 ]
 
 

@@ -19,8 +19,12 @@ Two measurements (run: ``python benchmarks/quant_serving.py [7b|1b]``):
 
 from __future__ import annotations
 
-import json
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import json
 import time
 
 import jax

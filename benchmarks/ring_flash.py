@@ -13,6 +13,11 @@ Run: ``python benchmarks/ring_flash.py``   (results in RESULTS.md)
 
 from __future__ import annotations
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import json
 import time
 

@@ -21,6 +21,11 @@ Run: ``python benchmarks/serving_throughput.py``.
 
 from __future__ import annotations
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import json
 import time
 

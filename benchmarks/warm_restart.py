@@ -10,12 +10,15 @@ Usage (on a TPU host):  python benchmarks/warm_restart.py [--model llama-1b]
 
 from __future__ import annotations
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 import json
-import os
 import shutil
 import subprocess
-import sys
 import tempfile
 
 _CHILD = r"""

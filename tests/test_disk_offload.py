@@ -202,6 +202,125 @@ def test_disk_adamw_spill_accounting(tmp_path):
     assert store3.initialize(params, {"w": True}) is False
 
 
+def test_overlap_semantics(tmp_path):
+    """Delayed parameter update (``disk_update_overlap``): the returned
+    state lags the host walk by exactly one step — step k returns params
+    P_{k-1} — and ``flush`` folds the in-flight walk in. The FIRST walk
+    is identical to the serial tier (both compute g1 on P0), which pins
+    the pipelined path against the serial one where they must agree."""
+    ov = build_train_program(_cfg(tmp_path / "a", disk_update_overlap=True))
+    assert ov.flush is not None
+    s0 = ov.init(jax.random.PRNGKey(ov.config.seed))
+    p0 = jax.device_get(s0["params"])
+
+    s1, m1 = ov.step(s0, ov.synthetic_batch(0))
+    # Step 1 returns P0 verbatim (its walk is still in flight).
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+        jax.device_get(s1["params"]), p0,
+    )
+    assert int(s1["step"]) == 1
+
+    s2, _ = ov.step(s1, ov.synthetic_batch(1))
+    # Step 2 returns P1 = adam(P0, g1) — identical to the serial tier's
+    # first step (same seed, same batch, g1 computed on P0 either way).
+    serial = build_train_program(_cfg(tmp_path / "b"))
+    r0 = serial.init(jax.random.PRNGKey(serial.config.seed))
+    r1, _ = serial.step(r0, serial.synthetic_batch(0))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=0, atol=0),
+        jax.device_get(s2["params"]), jax.device_get(r1["params"]),
+    )
+
+    # flush folds the in-flight walk (update 2): params change, the spill
+    # says step 2 was applied, and flushed params == the disk masters.
+    flushed = ov.flush(s2)
+    assert ov.disk_store.step_on_disk == 2
+    masters = ov.disk_store.masters()
+    from tpu_engine.disk_offload import flatten_with_paths
+
+    flat = flatten_with_paths(jax.device_get(flushed["params"]))
+    for path, w in masters.items():
+        np.testing.assert_allclose(
+            flat[path], w.astype(flat[path].dtype), rtol=0, atol=0)
+    # flush is idempotent.
+    again = ov.flush(flushed)
+    assert again is flushed or jax.tree.all(jax.tree.map(
+        lambda a, b: bool((np.asarray(a) == np.asarray(b)).all()),
+        again["params"], flushed["params"],
+    ))
+
+
+def test_overlap_discards_walk_on_rollback(tmp_path):
+    """Feeding a state that is NOT the continuation of the in-flight walk
+    (supervisor rollback) abandons the walk and reseeds: moments zeroed,
+    trajectory restarts from the incoming params."""
+    ov = build_train_program(_cfg(tmp_path / "a", disk_update_overlap=True))
+    s0 = ov.init(jax.random.PRNGKey(0))
+    s1, _ = ov.step(s0, ov.synthetic_batch(0))
+    s2, _ = ov.step(s1, ov.synthetic_batch(1))   # walk 2 in flight
+    # Roll back to s1 (step label 1); pending walk says step 2 -> discard.
+    s_rb, _ = ov.step(s1, ov.synthetic_batch(2))
+    flushed = ov.flush(s_rb)
+    assert int(flushed["step"]) == 2
+    # The reseed zeroed moments: bias correction restarted (the walk for
+    # the rollback step ran with moment_steps 1).
+    assert ov.disk_store.moment_steps == 1
+    assert ov.disk_store.step_on_disk == 2
+    # Training continues cleanly after the discard.
+    s3, m = ov.step(flushed, ov.synthetic_batch(3))
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_overlap_losses_decrease(tmp_path):
+    ov = build_train_program(_cfg(tmp_path / "a", disk_update_overlap=True))
+    _, losses = _run(ov, 6)
+    assert losses[-1] < losses[0]
+    assert all(np.isfinite(l) for l in losses)
+
+
+def test_overlap_supervised_job_checkpoint_consistent(tmp_path):
+    """Through the supervisor: checkpoints of an overlap job are flushed
+    (params include every update the step label claims), so a resume
+    continues without a reseed discontinuity."""
+    from tpu_engine.launcher import TPULauncher
+
+    cfg = _cfg(
+        tmp_path / "spill", total_steps=4, log_every_steps=1,
+        disk_update_overlap=True,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_interval_steps=2,
+    )
+    launcher = TPULauncher()
+    res = launcher.launch(cfg, dry_run=False, block=True)
+    job = launcher.get_job(res.job_id)
+    assert job.status == "completed", job.error
+    assert job.current_step == 4
+    # The final save was flushed: the spill's applied step matches.
+    assert job.program.disk_store.step_on_disk == 4
+    # Saved params equal the disk masters at step 4 (flushed, not stale).
+    from tpu_engine.checkpoint import abstract_state_like
+
+    step, restored = job.ckpt.restore(
+        abstract_state_like(
+            job.program.state_shardings,
+            jax.eval_shape(lambda: job.program.init(jax.random.PRNGKey(0))),
+        ),
+    )
+    assert step == 4
+    from tpu_engine.disk_offload import flatten_with_paths
+
+    flat = flatten_with_paths(jax.device_get(restored["params"]))
+    for path, w in job.program.disk_store.masters().items():
+        np.testing.assert_allclose(
+            flat[path], w.astype(flat[path].dtype), rtol=0, atol=0)
+
+
+def test_overlap_config_validation(tmp_path):
+    with pytest.raises(ValueError, match="disk_update_overlap"):
+        _cfg(disk_update_overlap=True)  # no disk offload -> invalid
+
+
 def test_disk_tier_supervised_job(tmp_path):
     """End-to-end through the launcher/supervisor: the disk-tier program
     survives eval_shape(init) (the supervisor traces init for checkpoint

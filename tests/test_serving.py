@@ -590,8 +590,174 @@ def test_prefix_cache_streams_identical_and_hits():
         assert toks == _ref_greedy(params, cfg, p, 6)
 
 
+def test_prefix_cache_partial_chunk_reuse():
+    """Token-granular reuse (round-4 verdict weakness 6): a prompt
+    diverging MID-chunk from a stored prefix reuses every full grain of
+    the shared tokens instead of zero, and streams stay identical to a
+    cache-off server."""
+    cfg = tfm.MODEL_CONFIGS["gpt-tiny"]
+    params = tfm.init_params(jax.random.PRNGKey(3), cfg, dtype=jnp.float32)
+    rng = np.random.default_rng(17)
+    base = rng.integers(1, cfg.vocab_size, 40).tolist()
+    p1 = base + [5, 6]
+    # Shares 38 of base's 40 tokens — diverges inside the third chunk.
+    p2 = base[:38] + [(base[38] + 1) % cfg.vocab_size] + [9, 10, 11]
+
+    def serve(**kw):
+        srv = ContinuousBatcher(params, cfg, max_slots=1, max_len=128,
+                                compute_dtype=jnp.float32, prefill_pad_to=16,
+                                prefill_chunk=16, chunk_steps=2, **kw)
+        out = []
+        for p in (p1, p2):
+            r = srv.submit(p, max_new_tokens=5)
+            for _ in range(60):
+                srv.step()
+                if srv.result(r)["status"] == "done":
+                    break
+            out.append(srv.result(r)["tokens"])
+        return srv, out
+
+    _, cold = serve()
+    srv, warm = serve(prefix_cache_tokens=512)
+    assert warm == cold
+    st = srv.stats()["prefix_cache"]
+    # p2 reuses floor(38/16)*16 = 32 of p1's stored 32-token boundary.
+    assert st["hits"] >= 1, st
+    for p, toks in zip((p1, p2), warm):
+        assert toks == _ref_greedy(params, cfg, p, 5)
+
+
+def test_prefix_cache_aligned_resubmit_hits():
+    """Round-4 advisor finding: an identical CHUNK-ALIGNED prompt
+    resubmitted must hit (the old boundary-keyed lookup probed only
+    strictly-shorter boundaries, so these missed forever)."""
+    cfg = tfm.MODEL_CONFIGS["gpt-tiny"]
+    params = tfm.init_params(jax.random.PRNGKey(3), cfg, dtype=jnp.float32)
+    srv = ContinuousBatcher(params, cfg, max_slots=1, max_len=128,
+                            compute_dtype=jnp.float32, prefill_pad_to=16,
+                            prefill_chunk=16, chunk_steps=2,
+                            prefix_cache_tokens=256)
+    prompt = list(range(1, 33))  # exactly 2 chunks of 16
+    streams = []
+    for _ in range(2):
+        r = srv.submit(prompt, max_new_tokens=4)
+        for _ in range(40):
+            srv.step()
+            if srv.result(r)["status"] == "done":
+                break
+        streams.append(srv.result(r)["tokens"])
+    st = srv.stats()["prefix_cache"]
+    assert st["hits"] >= 1, st  # reuses floor(31/16)*16 = 16 tokens
+    assert streams[0] == streams[1] == _ref_greedy(params, cfg, prompt, 4)
+
+
+def test_wait_tokens_incremental():
+    """The streaming primitive: wait_tokens unblocks on PARTIAL progress
+    (each emission batch), not only on completion, and the accumulated
+    increments equal the final polled result."""
+    cfg = tfm.MODEL_CONFIGS["gpt-tiny"]
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    srv = ContinuousBatcher(params, cfg, max_slots=1, max_len=96,
+                            compute_dtype=jnp.float32, prefill_pad_to=16,
+                            prefill_chunk=16, chunk_steps=2)
+    stop = threading.Event()
+    t = threading.Thread(target=srv.serve_forever, args=(stop,), daemon=True)
+    t.start()
+    try:
+        rid = srv.submit([1, 2, 3], max_new_tokens=12)
+        with pytest.raises(KeyError):
+            srv.wait_tokens(9999)
+        got: list[int] = []
+        snapshots = 0
+        while True:
+            snap = srv.wait_tokens(rid, have=len(got), timeout=30.0)
+            if len(snap["tokens"]) > len(got):
+                snapshots += 1
+                got = list(snap["tokens"])
+            if snap["status"] in ("done", "failed"):
+                break
+        assert snap["status"] == "done"
+        # chunk_steps=2 over 12 tokens → progress arrived in >= 3 batches.
+        assert snapshots >= 3
+        assert got == srv.result(rid)["tokens"] and len(got) == 12
+    finally:
+        stop.set()
+        t.join(timeout=10)
+
+
+def test_clean_stop_terminates_inflight_requests():
+    """A clean server stop fails in-flight requests (terminal status), so
+    an open stream's wait_tokens returns instead of heartbeating forever
+    against a request no engine thread will ever advance."""
+    cfg = tfm.MODEL_CONFIGS["gpt-tiny"]
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    srv = ContinuousBatcher(params, cfg, max_slots=1, max_len=256,
+                            compute_dtype=jnp.float32, prefill_pad_to=16,
+                            prefill_chunk=16, chunk_steps=1)
+    stop = threading.Event()
+    t = threading.Thread(target=srv.serve_forever, args=(stop,), daemon=True)
+    t.start()
+    rid = srv.submit([1, 2, 3], max_new_tokens=200)  # long-running
+    srv.wait_tokens(rid, have=0, timeout=30.0)       # at least one token out
+    stop.set()
+    t.join(timeout=10)
+    res = srv.result(rid)
+    assert res["status"] == "failed"
+    assert "stopped" in res["error"]
+    # And a waiter blocked at stop time returns promptly with the terminal
+    # snapshot rather than timing out.
+    snap = srv.wait_tokens(rid, have=10**6, timeout=5.0)
+    assert snap["status"] == "failed"
+    # Post-stop submits are rejected — nothing will ever serve them.
+    with pytest.raises(RuntimeError, match="stopped"):
+        srv.submit([1, 2], max_new_tokens=2)
+
+
+def test_prefix_cache_inserts_boundary_after_partial_hit():
+    """A walk that STARTS mid-chunk (token-granular hit) still stores its
+    own chunk-boundary entry — the insert condition covers the boundary
+    (t0 < last <= t1) instead of requiring t1 == last, so a popular
+    prompt B diverging mid-chunk from cached prompt A gets its own entry
+    and later B-requests reuse B's full boundary, not just A's shared
+    grains."""
+    cfg = tfm.MODEL_CONFIGS["gpt-tiny"]
+    params = tfm.init_params(jax.random.PRNGKey(3), cfg, dtype=jnp.float32)
+    srv = ContinuousBatcher(params, cfg, max_slots=1, max_len=128,
+                            compute_dtype=jnp.float32, prefill_pad_to=16,
+                            prefill_chunk=16, chunk_steps=2,
+                            prefix_cache_tokens=512)
+    rng = np.random.default_rng(23)
+    a = rng.integers(1, cfg.vocab_size, 40).tolist()          # prompt A
+    b = a[:20] + [(a[20] + 1) % cfg.vocab_size] + \
+        rng.integers(1, cfg.vocab_size, 19).tolist()          # diverges @20
+
+    def run(p):
+        r = srv.submit(list(p), max_new_tokens=3)
+        for _ in range(60):
+            srv.step()
+            if srv.result(r)["status"] == "done":
+                break
+        return srv.result(r)["tokens"]
+
+    run(a)                                   # stores A[:32]
+    st0 = srv.stats()["prefix_cache"]
+    run(b)   # hits A at floor(20/16)*16=16, walk starts mid-chunk at 16
+    st1 = srv.stats()["prefix_cache"]
+    assert st1["hits"] == st0["hits"] + 1
+    # B's own boundary entry was stored despite the misaligned walk.
+    assert st1["entries"] == st0["entries"] + 1
+    # A later identical B reuses B's boundary (32 tokens, not A's 16).
+    run(b)
+    st2 = srv.stats()["prefix_cache"]
+    assert st2["hits"] == st1["hits"] + 1
+    assert st2["entries"] == st1["entries"]  # duplicate insert refused
+    # Streams must match the reference throughout.
+    assert run(b) == _ref_greedy(params, cfg, b, 3)
+
+
 def test_prefix_cache_exact_match_only():
-    """A prompt whose first chunk differs by ONE token must miss."""
+    """A prompt differing from every stored entry at token 0 must miss
+    (zero common prefix — token-granular reuse has nothing to paste)."""
     cfg = tfm.MODEL_CONFIGS["gpt-tiny"]
     params = tfm.init_params(jax.random.PRNGKey(3), cfg, dtype=jnp.float32)
     srv = ContinuousBatcher(params, cfg, max_slots=2, max_len=128,

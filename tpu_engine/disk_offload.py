@@ -369,6 +369,16 @@ class AsyncLeafUploader:
                 self._err = e
 
     def emit(self, path: str, master: np.ndarray) -> None:
+        # A failed upload poisons the whole walk — raise HERE, not at
+        # result(): letting the walk run to completion would write a
+        # clean meta at step t while the uploaded state is one step
+        # behind, and every later slab write is wasted work (round-4
+        # advisor finding). Aborting mid-walk leaves the in_progress
+        # marker, so the next attach refuses the torn spill and reseeds
+        # (masters kept where they still round to the incoming params;
+        # moments zeroed) — consistent, just not free.
+        if self._err is not None:
+            raise self._err
         # Copy now: the memmap buffer is reused/advised-away immediately.
         # Blocks when a copy is already queued — bounded residency.
         self._q.put((path, np.asarray(master, dtype=np.float32).copy()))
@@ -386,3 +396,41 @@ class AsyncLeafUploader:
         if self._err is not None:
             raise self._err
         return self._out
+
+
+class WalkInFlight:
+    """One ``DiskAdamW.update`` running on its own thread, paired with its
+    :class:`AsyncLeafUploader` — the host half of delayed-parameter-update
+    overlap (``disk_update_overlap``): while this walk drains, the main
+    thread returns to the train loop and the DEVICE computes the next
+    step's forward/backward. ``join`` returns the uploaded compute-dtype
+    leaf dict (or raises the walk's error); ``discard`` joins without
+    raising, for abandoning a walk after a rollback."""
+
+    def __init__(self, store: DiskAdamW, grads_flat: dict[str, Any],
+                 lr: float, step: int, shardings: dict[str, Any], dtype):
+        self.step = int(step)
+        self._up = AsyncLeafUploader(shardings, dtype)
+        self._err: Optional[BaseException] = None
+
+        def run() -> None:
+            try:
+                store.update(grads_flat, lr, self.step, self._up.emit)
+            except BaseException as e:  # noqa: BLE001 — rethrown in join()
+                self._err = e
+            finally:
+                self._up.close()
+
+        self._t = threading.Thread(target=run, daemon=True,
+                                   name=f"disk-walk-{step}")
+        self._t.start()
+
+    def join(self) -> dict[str, Any]:
+        self._t.join()
+        if self._err is not None:
+            raise self._err
+        return self._up.result()
+
+    def discard(self) -> None:
+        self._t.join()
+        self._up.close()

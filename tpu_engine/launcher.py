@@ -173,6 +173,13 @@ class TPULauncher:
                 # failing; None = exact-fit only.
                 "min_devices": config.elastic_min_devices,
                 "max_devices": config.elastic_max_devices,
+                # Effective-batch preservation across a resize (the
+                # reference's min/max batch elasticity): accumulation is
+                # rescaled to hold micro x accum x dp invariant; these
+                # bounds gate admission of the achieved batch.
+                "min_batch_size": config.elastic_min_batch_size,
+                "max_batch_size": config.elastic_max_batch_size,
+                "preserve_effective_batch": True,
                 "note": "TPU slices are fixed-shape; live resize is not a TPU concept "
                 "(reference elasticity block: deepspeed_launcher.py:226-238)",
             },

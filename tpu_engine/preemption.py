@@ -15,6 +15,15 @@ comments (``:25-29``). Here:
 - the emergency callback is supplied by the supervisor: synchronous Orbax
   save → mark job preempted → (optionally) exit. Auto-resume on restart is
   the supervisor's side (``tpu_engine/supervisor.py``).
+
+Cloud scope — GCP ONLY, deliberately. The reference stub's comments cite
+both the AWS instance-action URL and the GCP preempted URL
+(``spot_resiliency.py:25-29``); TPUs exist only in Google Cloud, so this
+TPU-native build polls the GCE endpoint and does not carry a dead AWS
+code path. Non-GCE environments (including any future AWS-hosted
+runtime) are still covered by the SIGTERM handler — every major cloud
+delivers spot/maintenance interruptions as SIGTERM with a grace window —
+and by the simulation seam for tests.
 """
 
 from __future__ import annotations

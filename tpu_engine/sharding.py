@@ -342,6 +342,15 @@ class TPUTrainConfig(BaseModel):
         "dots_with_no_batch_dims_saveable | everything_saveable | save_attn_out | "
         "save_qkv_attn_out",
     )
+    # Disk-tier overlap (ZeRO-Offload "delayed parameter update"): the
+    # device computes step N+1's forward/backward WHILE the host AdamW
+    # walk applies step N — gradients are one step stale (computed on
+    # params missing the in-flight update), the documented DPU tradeoff.
+    # Step time approaches max(device, host) instead of their sum. The
+    # supervisor flushes the in-flight walk before checkpoints/eval, so
+    # saved states are always step-consistent. Requires
+    # optimizer_offload='disk'.
+    disk_update_overlap: bool = False
     # Cross-entropy computed this many sequence positions at a time, so the
     # fp32 [B, S, vocab] logits tensor is never fully materialised. None =
     # single unchunked unembed+softmax. Must divide seq_len.
@@ -373,6 +382,23 @@ class TPUTrainConfig(BaseModel):
     # from checkpoint. None = exact-fit only (mismatch is an error).
     elastic_min_devices: Optional[int] = Field(default=None, ge=1)
     elastic_max_devices: Optional[int] = Field(default=None, ge=1)
+    # Admissible EFFECTIVE-batch bounds (reference elasticity min/max batch
+    # sizes, ``deepspeed_launcher.py:226-233`` — the second half of its
+    # elasticity declaration). An elastic mesh resize preserves the
+    # declared effective batch by rescaling gradient_accumulation_steps
+    # (ceil — never a silent shrink); these bounds then gate ADMISSION of
+    # the achieved batch: outside them, the resume fails rather than
+    # training at a batch the job never declared. None = preserve-only.
+    elastic_min_batch_size: Optional[int] = Field(default=None, ge=1)
+    elastic_max_batch_size: Optional[int] = Field(default=None, ge=1)
+    # The effective batch the job DECLARES (authoritative across process
+    # restarts). None = derived from this config at job construction —
+    # correct in-process, but a ``data=-1`` mesh resumed in a NEW process
+    # on a shrunken slice cannot reconstruct the launch-time world from
+    # the config alone (the -1 would re-resolve against the smaller
+    # world and silently bless the shrink); set this field explicitly for
+    # cross-process elasticity with -1 meshes.
+    elastic_target_batch_size: Optional[int] = Field(default=None, ge=1)
 
     # Persistent XLA compilation cache directory (None = env
     # JAX_COMPILATION_CACHE_DIR, else ~/.cache/tpu_engine/xla-cache): warm
@@ -420,6 +446,15 @@ class TPUTrainConfig(BaseModel):
                 "elastic_max_devices requires elastic_min_devices (the bounds "
                 "are one declaration: 'this job may run between X and Y chips')"
             )
+        if (
+            self.elastic_min_batch_size is not None
+            and self.elastic_max_batch_size is not None
+            and self.elastic_max_batch_size < self.elastic_min_batch_size
+        ):
+            raise ValueError(
+                f"elastic_max_batch_size={self.elastic_max_batch_size} < "
+                f"elastic_min_batch_size={self.elastic_min_batch_size}"
+            )
         return self
 
     @model_validator(mode="after")
@@ -445,6 +480,11 @@ class TPUTrainConfig(BaseModel):
             if self.optimizer_spill_dir is not None:
                 raise ValueError(
                     "optimizer_spill_dir only applies with "
+                    "optimizer_offload='disk'"
+                )
+            if self.disk_update_overlap:
+                raise ValueError(
+                    "disk_update_overlap only applies with "
                     "optimizer_offload='disk'"
                 )
             if self.param_offload == OffloadDevice.DISK:

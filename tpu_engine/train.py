@@ -315,6 +315,11 @@ class TrainProgram:
     # Disk-tier only: the live DiskAdamW spill store (spill_bytes(),
     # step_on_disk, masters() for export). None on in-memory programs.
     disk_store: Any = None
+    # Disk-tier overlap only: joins the in-flight host walk and returns a
+    # step-consistent state (params include every applied update). The
+    # supervisor calls this before checkpoint saves and eval; no-op
+    # (returns its argument) when nothing is in flight. None elsewhere.
+    flush: Optional[Callable[[Any], Any]] = None
 
     @property
     def mesh(self) -> Mesh:
@@ -1233,11 +1238,11 @@ def _assemble_disk_tier(
         out_shardings=(grad_sh, None),
     )
 
-    def disk_step(state, batch):
-        grads, metrics = jit_grad(state, batch)
-        t = int(state["step"]) + 1
-        if not store.slabs:
-            _ensure_store(state["params"])  # restored-without-init path
+    # Delayed-parameter-update overlap (``disk_update_overlap``): the one
+    # in-flight host walk. Only the engine thread touches this.
+    pending: list[Any] = [None]
+
+    def _check_discontinuity(state, t):
         # ONE discontinuity check covering every path — lazy attach,
         # warm init-attach, in-process rollback, restored checkpoint at
         # a different step: the spill's applied-step must be exactly the
@@ -1255,6 +1260,13 @@ def _assemble_disk_tier(
                 _leaf_fetcher(state["params"]), step=t - 1,
                 cast_dtype=compute_dtype,
             )
+
+    def disk_step(state, batch):
+        grads, metrics = jit_grad(state, batch)
+        t = int(state["step"]) + 1
+        if not store.slabs:
+            _ensure_store(state["params"])  # restored-without-init path
+        _check_discontinuity(state, t)
         uploader = dsk.AsyncLeafUploader(flat_param_sh, compute_dtype)
         try:
             store.update(
@@ -1271,6 +1283,68 @@ def _assemble_disk_tier(
         }
         return new_state, metrics
 
+    def disk_step_overlap(state, batch):
+        """Delayed parameter update (ZeRO-Offload DPU analogue): dispatch
+        this step's forward/backward on the CURRENT (one-walk-stale)
+        params, join the PREVIOUS step's host walk, then hand this step's
+        gradients to a fresh background walk and return. Device compute
+        for step N+1 and the host AdamW for step N run concurrently —
+        step time approaches max(device, host) instead of their sum.
+        Tradeoff (documented on the config field): gradients are computed
+        on params missing the in-flight update — one step of staleness,
+        pinned exactly by ``test_disk_offload.py::test_overlap_semantics``.
+        """
+        # Async dispatch: the device starts on this step's grads NOW and
+        # crunches while the host joins the previous walk below.
+        grads, metrics = jit_grad(state, batch)
+        t = int(state["step"]) + 1
+        if not store.slabs:
+            _ensure_store(state["params"])
+        prev = pending[0]
+        pending[0] = None
+        prev_leaves = None
+        if prev is not None:
+            if prev.step == int(state["step"]):
+                prev_leaves = prev.join()       # host walk N ∥ device grads N+1
+            else:
+                # The incoming state is NOT the continuation of the
+                # in-flight walk (supervisor rollback / restored
+                # checkpoint): the walk's trajectory is abandoned.
+                prev.discard()
+        _check_discontinuity(state, t)
+        # float(lr) blocks until jit_grad is done — by now the previous
+        # walk has already been joined, so nothing serialises behind it.
+        pending[0] = dsk.WalkInFlight(
+            store, dsk.flatten_with_paths(grads),
+            float(metrics["learning_rate"]), t,
+            flat_param_sh, compute_dtype,
+        )
+        params = state["params"] if prev_leaves is None else \
+            dsk.unflatten_like(state["params"], prev_leaves)
+        new_state = {
+            "params": params,   # stale by exactly the in-flight walk
+            "step": metrics["step"],
+            "lr_scale": state["lr_scale"],
+        }
+        return new_state, metrics
+
+    def disk_flush(state):
+        """Join the in-flight walk and return a step-consistent state
+        (its ``step`` already counts the walk's update; only the params
+        were lagging). No-op when nothing is in flight."""
+        walk = pending[0]
+        if walk is None:
+            return state
+        pending[0] = None
+        if walk.step != int(state["step"]):
+            walk.discard()  # flushing a state the walk does not continue
+            return state
+        leaves = walk.join()
+        return {
+            **state,
+            "params": dsk.unflatten_like(state["params"], leaves),
+        }
+
     jit_eval = jax.jit(
         eval_step,
         in_shardings=(state_shardings, batch_sharding),
@@ -1284,10 +1358,11 @@ def _assemble_disk_tier(
         state_shardings=state_shardings,
         batch_sharding=batch_sharding,
         init=disk_init,
-        step=disk_step,
+        step=disk_step_overlap if cfg.disk_update_overlap else disk_step,
         eval_step=jit_eval,
         pipeline_schedule=pipe_schedule,
         disk_store=store,
+        flush=disk_flush if cfg.disk_update_overlap else None,
     )
 
 
