@@ -46,6 +46,14 @@ CANDIDATES = [
     ("8x7b-v5e32-ep8-chunk", "moe-8x7b", "v5e:4x8",
      dict(data=1, fsdp=4, model=8), 1, 4096,
      {"optimizer_offload": "host", "loss_chunk_size": 1024}),
+    # 4.7 GiB over on 32 chips (measured above) — two escape paths:
+    ("8x7b-v5e32-ep8-paramhost", "moe-8x7b", "v5e:4x8",
+     dict(data=1, fsdp=4, model=8), 1, 4096,
+     {"optimizer_offload": "host", "param_offload": "host",
+      "loss_chunk_size": 1024}),
+    ("8x7b-v5e64-ep8", "moe-8x7b", "v5e:8x8",
+     dict(data=1, fsdp=8, model=8), 1, 4096,
+     {"optimizer_offload": "host", "loss_chunk_size": 1024}),
 ]
 
 

@@ -273,6 +273,8 @@ class DiskAdamW:
         the duration of the walk — a spill whose process died mid-update
         holds mixed-step slabs, and the marker makes the next
         ``try_attach`` refuse it instead of silently resuming."""
+        import queue
+
         b1, b2, eps, wd = self.b1, self.b2, self.eps, self.weight_decay
         t_bias = self.moment_steps + 1
         c1 = 1.0 - b1 ** t_bias
@@ -284,29 +286,74 @@ class DiskAdamW:
         if order:
             for f in self.slabs[order[0]].files():
                 _advise(f, os.POSIX_FADV_WILLNEED)
-        for i, path in enumerate(order):
-            if i + 1 < len(order):
-                for f in self.slabs[order[i + 1]].files():
-                    _advise(f, os.POSIX_FADV_WILLNEED)
-            slab = self.slabs[path]
-            g = np.asarray(jax.device_get(grads[path]), np.float32)
-            if g.shape != slab.shape:
-                raise ValueError(
-                    f"grad leaf {path} shape {g.shape} != master {slab.shape}"
-                )
-            mu, nu, w = slab.mu, slab.nu, slab.master
-            mu *= b1
-            mu += (1.0 - b1) * g
-            nu *= b2
-            nu += (1.0 - b2) * np.square(g)
-            u = (mu / c1) / (np.sqrt(nu / c2) + eps)
-            if slab.decay and wd:
-                u += wd * w
-            w -= lr * u
-            emit(path, w)
-            for f in slab.files():
-                f.flush()
-                _advise(f, os.POSIX_FADV_DONTNEED)
+        # One-leaf-ahead gradient D2H: a fetcher thread pulls leaf i+1
+        # off the device while the main thread's numpy update crunches
+        # leaf i — the transfer and the math overlap instead of strictly
+        # alternating. In the SERIAL walk regime (the default) the gets
+        # contend with nothing — the device finished this step's compute
+        # before the walk starts; under ``disk_update_overlap`` they
+        # share the wire with step N+1's execution (see that config
+        # field's measured caveat). The depth-1 queue bounds residency at
+        # two gradient leaves, same as the upload side
+        # (AsyncLeafUploader); ``abort`` poisons the fetcher if the walk
+        # dies mid-update, so a failure never strands a thread blocked on
+        # the queue pinning the whole device gradient tree.
+        fetched: "queue.Queue" = queue.Queue(maxsize=1)
+        abort = threading.Event()
+
+        def _put(item) -> bool:
+            while not abort.is_set():
+                try:
+                    fetched.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def _fetch() -> None:
+            try:
+                for p in order:
+                    if not _put(
+                        (p, np.asarray(jax.device_get(grads[p]), np.float32))
+                    ):
+                        return
+                _put(None)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                _put(e)
+
+        fetcher = threading.Thread(target=_fetch, daemon=True,
+                                   name="disk-grad-fetch")
+        fetcher.start()
+        try:
+            for i, path in enumerate(order):
+                if i + 1 < len(order):
+                    for f in self.slabs[order[i + 1]].files():
+                        _advise(f, os.POSIX_FADV_WILLNEED)
+                slab = self.slabs[path]
+                item = fetched.get()
+                if isinstance(item, BaseException):
+                    raise item
+                _, g = item
+                if g.shape != slab.shape:
+                    raise ValueError(
+                        f"grad leaf {path} shape {g.shape} != master {slab.shape}"
+                    )
+                mu, nu, w = slab.mu, slab.nu, slab.master
+                mu *= b1
+                mu += (1.0 - b1) * g
+                nu *= b2
+                nu += (1.0 - b2) * np.square(g)
+                u = (mu / c1) / (np.sqrt(nu / c2) + eps)
+                if slab.decay and wd:
+                    u += wd * w
+                w -= lr * u
+                emit(path, w)
+                for f in slab.files():
+                    f.flush()
+                    _advise(f, os.POSIX_FADV_DONTNEED)
+        finally:
+            abort.set()
+            fetcher.join()
         self.step_on_disk = step
         self.moment_steps = t_bias
         self._write_meta()  # clean meta — clears in_progress

@@ -346,10 +346,15 @@ class TPUTrainConfig(BaseModel):
     # device computes step N+1's forward/backward WHILE the host AdamW
     # walk applies step N — gradients are one step stale (computed on
     # params missing the in-flight update), the documented DPU tradeoff.
-    # Step time approaches max(device, host) instead of their sum. The
-    # supervisor flushes the in-flight walk before checkpoints/eval, so
-    # saved states are always step-consistent. Requires
-    # optimizer_offload='disk'.
+    # Step time approaches max(device, host) instead of their sum — ON
+    # LOCAL SILICON. Measure before enabling: through a REMOTE/tunneled
+    # runtime the walk's gradient device_gets queue BEHIND the next
+    # step's execution and the "overlap" inverts (0.48x measured,
+    # benchmarks/RESULTS.md round 5); the serial walk's built-in
+    # one-leaf-ahead gradient prefetch is the transfer/compute overlap
+    # that wins in every regime. The supervisor flushes the in-flight
+    # walk before checkpoints/eval, so saved states are always
+    # step-consistent. Requires optimizer_offload='disk'.
     disk_update_overlap: bool = False
     # Cross-entropy computed this many sequence positions at a time, so the
     # fp32 [B, S, vocab] logits tensor is never fully materialised. None =
@@ -621,11 +626,18 @@ def presets() -> dict[str, TPUTrainConfig]:
         "8x7b": TPUTrainConfig(  # Mixtral-style MoE: experts over "model" (EP)
             model_name="moe-8x7b",
             sharding_stage=ShardingStage.FULL_PARTITIONING,
-            mesh=MeshConfig(data=1, fsdp=4, model=8),
+            # v5e-64 (8x8): 12.57 GiB/device AOT-verified (round 5,
+            # benchmarks/preset_fit_sweep.py). The earlier fsdp=4 32-chip
+            # shape compiled 4.7 GiB OVER budget — exactly the
+            # never-validated-preset failure this repo criticises the
+            # reference for, caught by the same sweep that sizes the
+            # dense presets.
+            mesh=MeshConfig(data=1, fsdp=8, model=8),
             micro_batch_size=1,
             gradient_accumulation_steps=16,
             seq_len=4096,
             learning_rate=2e-4,
             optimizer_offload=OffloadDevice.HOST,
+            loss_chunk_size=1024,
         ),
     }
