@@ -21,7 +21,9 @@ from pydantic import BaseModel
 _PATH_PARAM = re.compile(r"\{(\w+)\}")
 
 # Path-parameter names that handlers parse as integers (everything else is
-# a free-form string, e.g. job ids).
+# a free-form string, e.g. job ids). Handlers can override per-route with
+# the :func:`pathparams` decorator — prefer that for new routes so the
+# declaration lives next to the code that parses the value.
 _INT_PARAMS = {"index", "request_id"}
 
 
@@ -32,6 +34,18 @@ def body(model: Type[BaseModel]):
 
     def deco(fn):
         fn.__openapi_request__ = model
+        return fn
+
+    return deco
+
+
+def pathparams(types: dict[str, str]):
+    """Annotate a handler's path-parameter JSON types, e.g.
+    ``@pathparams({"step": "integer"})`` — overrides the name-based
+    default for that handler's route."""
+
+    def deco(fn):
+        fn.__openapi_pathparams__ = dict(types)
         return fn
 
     return deco
@@ -106,12 +120,14 @@ def build_openapi(app: web.Application, *, title: str, version: str) -> dict:
         if description:
             op["description"] = description
         params = []
+        declared = getattr(handler, "__openapi_pathparams__", {})
         for name in _PATH_PARAM.findall(canonical):
+            ptype = declared.get(
+                name, "integer" if name in _INT_PARAMS else "string"
+            )
             params.append({
                 "name": name, "in": "path", "required": True,
-                "schema": {
-                    "type": "integer" if name in _INT_PARAMS else "string"
-                },
+                "schema": {"type": ptype},
             })
         if params:
             op["parameters"] = params

@@ -18,7 +18,7 @@ from aiohttp import web
 from pydantic import BaseModel, ConfigDict, Field
 
 from backend import state
-from backend.openapi import body
+from backend.openapi import body, pathparams
 from backend.http import ApiError, json_response, parse_body
 
 
@@ -300,6 +300,7 @@ async def submit(request: web.Request) -> web.Response:
     return json_response({"request_id": rid})
 
 
+@pathparams({"request_id": "integer"})
 async def result(request: web.Request) -> web.Response:
     srv = _require_server()
     try:
@@ -317,6 +318,7 @@ async def stats(request: web.Request) -> web.Response:
     return json_response(await asyncio.to_thread(srv.stats))
 
 
+@pathparams({"request_id": "integer"})
 async def stream(request: web.Request) -> web.StreamResponse:
     """Server-sent events: tokens reach the client AS EMITTED (round-4
     verdict weakness 4 — the engine's TTFT work never reached a client

@@ -293,11 +293,12 @@ class DiskAdamW:
         # contend with nothing — the device finished this step's compute
         # before the walk starts; under ``disk_update_overlap`` they
         # share the wire with step N+1's execution (see that config
-        # field's measured caveat). The depth-1 queue bounds residency at
-        # two gradient leaves, same as the upload side
-        # (AsyncLeafUploader); ``abort`` poisons the fetcher if the walk
-        # dies mid-update, so a failure never strands a thread blocked on
-        # the queue pinning the whole device gradient tree.
+        # field's measured caveat). The depth-1 queue bounds host
+        # residency at THREE gradient leaves (one being updated, one
+        # queued, one in the fetcher's in-flight device_get) — still
+        # O(leaf), never the tree; ``abort`` poisons the fetcher if the
+        # walk dies mid-update, so a failure never strands a thread
+        # blocked on the queue pinning the whole device gradient tree.
         fetched: "queue.Queue" = queue.Queue(maxsize=1)
         abort = threading.Event()
 

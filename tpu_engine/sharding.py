@@ -634,7 +634,10 @@ def presets() -> dict[str, TPUTrainConfig]:
             # dense presets.
             mesh=MeshConfig(data=1, fsdp=8, model=8),
             micro_batch_size=1,
-            gradient_accumulation_steps=16,
+            # fsdp doubled 4 -> 8 for the fit; accumulation halves so the
+            # effective batch stays 64 (micro 1 x accum 8 x dp 8) — the
+            # memory fix must not silently change training semantics.
+            gradient_accumulation_steps=8,
             seq_len=4096,
             learning_rate=2e-4,
             optimizer_offload=OffloadDevice.HOST,
