@@ -20,3 +20,20 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Drop compiled programs between test modules.
+
+    A single-process run of the FULL suite (fast + slow, 430 tests)
+    accumulates every module's jitted executables in the CPU client and
+    aborts (SIGABRT inside XLA:CPU execution) in the final module —
+    reproducible at ~the 420th test, gone when either half runs alone.
+    Per-module cache clearing bounds the accumulation; modules recompile
+    their own programs anyway (shapes differ across modules), so the
+    only cost is losing cross-module cache hits that barely exist."""
+    yield
+    jax.clear_caches()

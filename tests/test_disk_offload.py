@@ -202,6 +202,35 @@ def test_disk_adamw_spill_accounting(tmp_path):
     assert store3.initialize(params, {"w": True}) is False
 
 
+def test_disk_tier_stage2_sharded_grads(tmp_path):
+    """Stage-2 on a multi-device mesh: grads reduce-scatter over fsdp
+    while the params the slabs mirror stay replicated — the grad fetch
+    falls back to materialise+slice (single-process only; cross-process
+    stage-2 disk is rejected at build time). Step-for-step parity with
+    the in-memory stage-2 chain."""
+    kw = dict(mesh=MeshConfig(fsdp=4),
+              sharding_stage=ShardingStage.GRADIENT_PARTITIONING)
+    ref_prog = build_train_program(_cfg(**kw))
+    ref_state, ref_losses = _run(ref_prog, 3)
+    disk_prog = build_train_program(_cfg(tmp_path / "s2", **kw))
+    disk_state, disk_losses = _run(disk_prog, 3)
+    np.testing.assert_allclose(disk_losses, ref_losses, rtol=1e-6)
+    assert disk_prog.disk_store.step_on_disk == 3
+
+
+def test_multihost_disk_requires_stage3():
+    import jax
+
+    from unittest import mock
+
+    with mock.patch.object(jax, "process_count", return_value=2):
+        with pytest.raises(ValueError, match="sharding_stage=3"):
+            build_train_program(_cfg(
+                "/tmp/nope",
+                sharding_stage=ShardingStage.GRADIENT_PARTITIONING,
+            ))
+
+
 def test_overlap_semantics(tmp_path):
     """Delayed parameter update (``disk_update_overlap``): the returned
     state lags the host walk by exactly one step — step k returns params

@@ -46,7 +46,10 @@ def test_ragged_generation_releases_lock(monkeypatch):
 
     def slow_generate(*args, **kw):
         started.set()
-        assert release.wait(timeout=30), "test driver never released"
+        # Generous: the driver thread compiles a train step before
+        # releasing, which can exceed 30 s on a loaded host (e.g. a
+        # parallel pytest-xdist run oversubscribing the CPUs).
+        assert release.wait(timeout=180), "test driver never released"
         return real_generate(*args, **kw)
 
     monkeypatch.setattr(gen_mod, "generate", slow_generate)

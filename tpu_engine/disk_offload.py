@@ -32,10 +32,15 @@ the process, so a warm restart re-attaches to the exact optimizer
 moments (``attach=True`` path) — the disk tier doubles as an optimizer-
 state checkpoint that costs no save step.
 
-Single-process scope: every shard of every gradient must be addressable
-to this host (``build_train_program`` validates). Multi-host disk spill
-would shard the slab files per process — out of scope until a config
-needs it.
+Multi-host scope (round 5): AdamW is elementwise, so each process
+updates exactly the master SHARDS its devices hold — slab files are
+keyed per shard (``path@start-stop…``), each process spills under its
+own ``proc{k}/`` subdirectory, and the uploader stitches the updated
+local blocks back into global sharded arrays
+(``AsyncShardUploader.result``). No cross-host communication happens in
+the update at all; the gradient collectives already ran on device. The
+glue lives in ``train._assemble_disk_tier``; DeepSpeed's NVMe tier
+works multi-node the same way (per-rank partition files).
 """
 
 from __future__ import annotations
@@ -204,6 +209,21 @@ class DiskAdamW:
         if not self.slabs and self.try_attach(shapes, decay_mask):
             return True
         self.slabs.clear()
+        # Fresh seed: drop slab files from any PREVIOUS layout (e.g. the
+        # pre-round-5 full-leaf keying on a sharded host, or a different
+        # mesh shape) — a failed attach would otherwise leave them
+        # orphaned on disk forever, silently doubling spill usage.
+        want = {
+            self._slab_path(p, kind)
+            for p in shapes for kind in ("master", "mu", "nu")
+        }
+        for f in os.listdir(self.dir):
+            full = os.path.join(self.dir, f)
+            if f.endswith(".f32") and full not in want:
+                try:
+                    os.remove(full)
+                except OSError:
+                    pass
         self._open_slabs(shapes, decay_mask, "w+")
         for path in shapes:
             slab = self.slabs[path]
@@ -261,8 +281,9 @@ class DiskAdamW:
 
     def update(self, grads: dict[str, Any], lr: float, step: int,
                emit) -> None:
-        """One AdamW step over every leaf. ``grads`` maps leaf path →
-        device array (already clipped, fp32); ``step`` is the POST-update
+        """One AdamW step over every slab. ``grads`` maps slab key →
+        device array OR a callable returning the host block (the
+        shard-granular form — already clipped, fp32); ``step`` is the POST-update
         TRAIN step (bookkeeping only — bias correction uses the internal
         ``moment_steps`` counter, which survives restarts and resets with
         the moments on reseed). ``emit(path, new_master_fp32)`` receives
@@ -311,12 +332,18 @@ class DiskAdamW:
                     continue
             return False
 
+        def _host(v) -> np.ndarray:
+            # Slab keys may map to a deferred fetch (a callable pulling
+            # ONE addressable shard off its device — the multi-host /
+            # multi-device form) or to a whole device array.
+            if callable(v):
+                return np.asarray(v(), np.float32)
+            return np.asarray(jax.device_get(v), np.float32)
+
         def _fetch() -> None:
             try:
                 for p in order:
-                    if not _put(
-                        (p, np.asarray(jax.device_get(grads[p]), np.float32))
-                    ):
+                    if not _put((p, _host(grads[p]))):
                         return
                 _put(None)
             except BaseException as e:  # noqa: BLE001 — re-raised below
@@ -381,22 +408,33 @@ def unflatten_like(tree: Any, flat: dict[str, Any]) -> Any:
     )
 
 
-class AsyncLeafUploader:
-    """Overlaps device uploads of updated leaves with the next leaf's
-    disk update: ``emit`` hands the fp32 master to ONE worker thread
-    (depth-1 queue) that casts + ``device_put``s with the leaf's
-    sharding while the main thread walks on. The bounded queue is the
-    point: at most two leaf copies are ever resident (one queued, one
-    uploading) — an unbounded fan-out would buffer the whole fp32
-    master tree in host RAM, the very thing the disk tier exists to
-    avoid. ``result()`` joins and returns the new leaf dict."""
+class AsyncShardUploader:
+    """Overlaps device uploads of updated master SHARDS with the next
+    leaf's disk update: ``emit`` hands the fp32 block to ONE worker
+    thread (depth-1 queue) that casts + ``device_put``s it to every
+    device holding that shard while the main thread walks on. The
+    bounded queue is the point: at most two block copies are ever
+    resident (one queued, one uploading) — an unbounded fan-out would
+    buffer the whole fp32 master tree in host RAM, the very thing the
+    disk tier exists to avoid. ``result()`` joins and stitches each
+    leaf's per-device blocks into a global ``jax.Array`` with the leaf's
+    sharding — which is what makes the tier multi-host capable: every
+    process uploads only ITS shards, and the assembled global array
+    spans them all.
 
-    def __init__(self, shardings: dict[str, Any], dtype):
+    ``key_devices``: slab key → (leaf path, [devices holding the
+    shard]); ``leaf_shapes``/``leaf_shardings``: per leaf path."""
+
+    def __init__(self, key_devices: dict[str, tuple[str, list]],
+                 leaf_shapes: dict[str, tuple], leaf_shardings: dict[str, Any],
+                 dtype):
         import queue
 
-        self._sh = shardings
+        self._keys = key_devices
+        self._shapes = leaf_shapes
+        self._sh = leaf_shardings
         self._dtype = dtype
-        self._out: dict[str, Any] = {}
+        self._blocks: dict[str, list] = {}
         self._err: Optional[BaseException] = None
         self._q: "queue.Queue[Optional[tuple[str, np.ndarray]]]" = \
             queue.Queue(maxsize=1)
@@ -408,15 +446,17 @@ class AsyncLeafUploader:
             item = self._q.get()
             if item is None:
                 return
-            path, arr = item
+            key, arr = item
             try:
-                self._out[path] = jax.device_put(
-                    arr.astype(self._dtype), self._sh[path]
+                path, devices = self._keys[key]
+                block = arr.astype(self._dtype)
+                self._blocks.setdefault(path, []).extend(
+                    jax.device_put(block, d) for d in devices
                 )
             except BaseException as e:  # noqa: BLE001 — rethrown in result()
                 self._err = e
 
-    def emit(self, path: str, master: np.ndarray) -> None:
+    def emit(self, key: str, master: np.ndarray) -> None:
         # A failed upload poisons the whole walk — raise HERE, not at
         # result(): letting the walk run to completion would write a
         # clean meta at step t while the uploaded state is one step
@@ -429,7 +469,7 @@ class AsyncLeafUploader:
             raise self._err
         # Copy now: the memmap buffer is reused/advised-away immediately.
         # Blocks when a copy is already queued — bounded residency.
-        self._q.put((path, np.asarray(master, dtype=np.float32).copy()))
+        self._q.put((key, np.asarray(master, dtype=np.float32).copy()))
 
     def close(self) -> None:
         """Stop the worker without raising — the failure-path companion
@@ -440,15 +480,21 @@ class AsyncLeafUploader:
             self._worker.join()
 
     def result(self) -> dict[str, Any]:
+        """Join and assemble: leaf path → global sharded array."""
         self.close()
         if self._err is not None:
             raise self._err
-        return self._out
+        return {
+            path: jax.make_array_from_single_device_arrays(
+                self._shapes[path], self._sh[path], blocks
+            )
+            for path, blocks in self._blocks.items()
+        }
 
 
 class WalkInFlight:
     """One ``DiskAdamW.update`` running on its own thread, paired with its
-    :class:`AsyncLeafUploader` — the host half of delayed-parameter-update
+    :class:`AsyncShardUploader` — the host half of delayed-parameter-update
     overlap (``disk_update_overlap``): while this walk drains, the main
     thread returns to the train loop and the DEVICE computes the next
     step's forward/backward. ``join`` returns the uploaded compute-dtype
@@ -456,9 +502,9 @@ class WalkInFlight:
     raising, for abandoning a walk after a rollback."""
 
     def __init__(self, store: DiskAdamW, grads_flat: dict[str, Any],
-                 lr: float, step: int, shardings: dict[str, Any], dtype):
+                 lr: float, step: int, uploader: "AsyncShardUploader"):
         self.step = int(step)
-        self._up = AsyncLeafUploader(shardings, dtype)
+        self._up = uploader
         self._err: Optional[BaseException] = None
 
         def run() -> None:

@@ -20,6 +20,7 @@ launch of an external DeepSpeed script (``ai_engine/deepspeed_launcher.py:354``
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from functools import partial
 from typing import Any, Callable, Optional
@@ -503,11 +504,20 @@ def build_train_program(
             "optimizer_offload='disk' with pipeline parallelism is not "
             "supported (the host update walks the flat gradient tree)"
         )
-    if disk_tier and jax.process_count() > 1:
+    if (
+        disk_tier
+        and jax.process_count() > 1
+        and cfg.sharding_stage < ShardingStage.FULL_PARTITIONING
+    ):
+        # Multi-host spill updates each process's ADDRESSABLE master
+        # shards from the grad shards at the SAME indices — which holds
+        # at ZeRO-3 (grad and param pspecs coincide). Below it, grads may
+        # be reduce-scattered while params stay replicated (stage 2), and
+        # per-shard pairing breaks.
         raise ValueError(
-            "optimizer_offload='disk' is single-process: every gradient "
-            "shard must be addressable to the spilling host (multi-host "
-            "spill would shard the slab files per process)"
+            "optimizer_offload='disk' across processes requires "
+            "sharding_stage=3 (param and gradient shards must coincide "
+            "per host)"
         )
 
     logical = tfm.logical_axes(model_cfg)
@@ -1140,8 +1150,14 @@ def _assemble_disk_tier(
             return jax.tree.map(lambda _: True, params)
         return kernel_decay_mask(params)
 
+    # Each process spills under its own subdirectory — slab files hold
+    # only the shards ITS devices own (single-process runs keep the flat
+    # directory, so existing spills still re-attach).
+    spill_dir = cfg.optimizer_spill_dir
+    if jax.process_count() > 1:
+        spill_dir = os.path.join(spill_dir, f"proc{jax.process_index()}")
     store = dsk.DiskAdamW(
-        cfg.optimizer_spill_dir, b1=cfg.beta1, b2=cfg.beta2, eps=1e-8,
+        spill_dir, b1=cfg.beta1, b2=cfg.beta2, eps=1e-8,
         weight_decay=cfg.weight_decay,
     )
 
@@ -1149,18 +1165,111 @@ def _assemble_disk_tier(
         lambda r: tfm.init_params(r, model_cfg, dtype=master_dtype),
         jax.random.PRNGKey(0),
     )
+    _abs_flat = dsk.flatten_with_paths(_abs_params)
+    _flat_mask_by_leaf = dsk.flatten_with_paths(_decay_mask(_abs_params))
+
+    # ---- shard-granular slab layout (multi-host / multi-device) ----------
+    # Slabs are keyed per unique addressable shard of each leaf:
+    # ``path`` when one full-leaf shard (replicated or single device —
+    # backward-compatible with existing spills), ``path@a-b_c-d…``
+    # otherwise. AdamW is elementwise, so every shard updates
+    # independently; no cross-shard (or cross-host) communication exists
+    # in the walk at all.
+
+    def _suffix(shape, index) -> str:
+        if not index or all(
+            (s.start in (None, 0)) and (s.stop in (None, dim))
+            for s, dim in zip(index, shape)
+        ):
+            return ""
+        return "@" + "_".join(
+            f"{0 if s.start is None else s.start}-"
+            f"{dim if s.stop is None else s.stop}"
+            for s, dim in zip(index, shape)
+        )
+
+    def _index_shape(shape, index):
+        if not index:
+            return tuple(shape)
+        return tuple(
+            (dim if s.stop is None else s.stop)
+            - (0 if s.start is None else s.start)
+            for s, dim in zip(index, shape)
+        )
+
+    # key → (leaf path, suffix, index slices, [devices holding the shard])
+    _key_info: dict[str, tuple[str, str, tuple, list]] = {}
+    for _path, _abs in _abs_flat.items():
+        _shape = tuple(_abs.shape)
+        _by_sig: dict[str, tuple] = {}
+        for _dev, _idx in flat_param_sh[_path] \
+                .addressable_devices_indices_map(_shape).items():
+            _sig = _suffix(_shape, _idx)
+            if _sig in _by_sig:
+                _by_sig[_sig][1].append(_dev)
+            else:
+                _by_sig[_sig] = (_idx, [_dev])
+        for _sig, (_idx, _devs) in sorted(_by_sig.items()):
+            _key_info[_path + _sig] = (_path, _sig, tuple(_idx), _devs)
+
     _flat_shapes = {
-        p: tuple(s.shape)
-        for p, s in dsk.flatten_with_paths(_abs_params).items()
+        key: _index_shape(tuple(_abs_flat[path].shape), idx)
+        for key, (path, _, idx, _) in _key_info.items()
     }
-    _flat_mask = dsk.flatten_with_paths(_decay_mask(_abs_params))
+    _flat_mask = {
+        key: _flat_mask_by_leaf[path]
+        for key, (path, _, _, _) in _key_info.items()
+    }
+    _leaf_shapes = {p: tuple(a.shape) for p, a in _abs_flat.items()}
+
+    def _shard_host(arr, path: str, sig: str, idx: tuple) -> np.ndarray:
+        """The block of ``arr`` matching a slab key's index signature, as
+        a host fp32 array. Prefers a matching addressable shard (no
+        cross-device traffic); when the array's own sharding differs from
+        the slab layout (e.g. stage-2 grads are fsdp-sharded while the
+        params the slabs mirror are replicated), a single process falls
+        back to materialising the leaf and slicing — cross-process that
+        mismatch is rejected at build time."""
+        shape = tuple(arr.shape)
+        for s in arr.addressable_shards:
+            if _suffix(shape, s.index) == sig:
+                return np.asarray(jax.device_get(s.data), np.float32)
+        if jax.process_count() == 1:
+            return np.asarray(jax.device_get(arr), np.float32)[
+                tuple(idx) if idx else ()
+            ]
+        raise ValueError(
+            f"leaf {path}: no addressable shard matches slab key suffix "
+            f"{sig!r} (sharding changed under the spill?)"
+        )
 
     def _leaf_fetcher(params):
-        """path → fp32 host ndarray, ONE leaf at a time — the full fp32
+        """key → fp32 host block, ONE shard at a time — the full fp32
         tree must never be host-resident at once (the tier targets models
         where it cannot be)."""
         flat = dsk.flatten_with_paths(params)
-        return lambda p: np.asarray(jax.device_get(flat[p]), np.float32)
+
+        def fetch(key):
+            path, sig, idx, _ = _key_info[key]
+            return _shard_host(flat[path], path, sig, idx)
+
+        return fetch
+
+    def _grad_fetchers(grads):
+        """key → deferred host fetch of the matching gradient shard (the
+        walk's prefetch thread calls these one ahead of the update)."""
+        flat = dsk.flatten_with_paths(grads)
+        return {
+            key: (lambda a=flat[path], p=path, s=sig, i=idx:
+                  _shard_host(a, p, s, i))
+            for key, (path, sig, idx, _) in _key_info.items()
+        }
+
+    def _make_uploader():
+        return dsk.AsyncShardUploader(
+            {key: (path, devs) for key, (path, _, _, devs) in _key_info.items()},
+            _leaf_shapes, flat_param_sh, compute_dtype,
+        )
 
     def _ensure_store(params) -> bool:
         """Attach if a clean matching spill exists (shape-only check — no
@@ -1171,13 +1280,16 @@ def _assemble_disk_tier(
                                 shapes=_flat_shapes)
 
     def _params_from_masters():
-        # Leaf-at-a-time: copy one master slab, cast, device_put, drop.
-        leaves = {}
-        for p, slab in store.slabs.items():
-            leaves[p] = jax.device_put(
-                np.array(slab.master).astype(compute_dtype), flat_param_sh[p]
-            )
-        return dsk.unflatten_like(_abs_params, leaves)
+        # Shard-at-a-time through the SAME uploader the update walk uses
+        # (one implementation of the block-stitch): copy one master slab,
+        # cast, device_put to the shard's devices, assemble global arrays.
+        up = _make_uploader()
+        try:
+            for key, slab in store.slabs.items():
+                up.emit(key, slab.master)
+        finally:
+            up.close()
+        return dsk.unflatten_like(_abs_params, up.result())
 
     def disk_init(rng):
         def pure(r):
@@ -1267,10 +1379,10 @@ def _assemble_disk_tier(
         if not store.slabs:
             _ensure_store(state["params"])  # restored-without-init path
         _check_discontinuity(state, t)
-        uploader = dsk.AsyncLeafUploader(flat_param_sh, compute_dtype)
+        uploader = _make_uploader()
         try:
             store.update(
-                dsk.flatten_with_paths(grads),
+                _grad_fetchers(grads),
                 float(metrics["learning_rate"]), t, uploader.emit,
             )
         finally:
@@ -1315,9 +1427,8 @@ def _assemble_disk_tier(
         # float(lr) blocks until jit_grad is done — by now the previous
         # walk has already been joined, so nothing serialises behind it.
         pending[0] = dsk.WalkInFlight(
-            store, dsk.flatten_with_paths(grads),
-            float(metrics["learning_rate"]), t,
-            flat_param_sh, compute_dtype,
+            store, _grad_fetchers(grads),
+            float(metrics["learning_rate"]), t, _make_uploader(),
         )
         params = state["params"] if prev_leaves is None else \
             dsk.unflatten_like(state["params"], prev_leaves)
