@@ -98,17 +98,25 @@ def main() -> None:
         print(json.dumps({"skipped": "needs a local TPU"}))
         return
     moe = _measure(MOE, micro=8)
+    ragged = _measure(dict(MOE, name="moe-mid-ragged", moe_impl="ragged"),
+                      micro=8)
     dense = _measure(DENSE, micro=8)
-    print(json.dumps(moe))
-    print(json.dumps(dense))
+    print(json.dumps(moe), flush=True)
+    print(json.dumps(ragged), flush=True)
+    print(json.dumps(dense), flush=True)
     print(json.dumps({
         "metric": "moe_throughput",
         "moe_tokens_per_sec": moe["tokens_per_sec"],
         "moe_mfu_pct": moe["mfu_pct"],
+        "ragged_tokens_per_sec": ragged["tokens_per_sec"],
+        "ragged_mfu_pct": ragged["mfu_pct"],
         "dense_twin_tokens_per_sec": dense["tokens_per_sec"],
         "dense_twin_mfu_pct": dense["mfu_pct"],
-        "routing_tax": round(
+        "routing_tax_dense_dispatch": round(
             1 - moe["tokens_per_sec"] / dense["tokens_per_sec"], 3
+        ),
+        "routing_tax_ragged": round(
+            1 - ragged["tokens_per_sec"] / dense["tokens_per_sec"], 3
         ),
     }))
 

@@ -108,6 +108,19 @@ assert my_slabs, f"process {pid} spilled no master slabs"
 # Loss LAST on the line: the parent's parity check compares the final
 # token across processes.
 print(f"child {pid} slabs {len(my_slabs)} diskloss {dl:.4f}", flush=True)
+
+# Cross-host attach consensus: tear ONE host's spill (drop its meta) and
+# rebuild — BOTH hosts must reseed fresh (a warm host stitching its old
+# moments against a fresh host's zeroed ones would silently mix
+# trajectories). The allgather in train._all_hosts is what enforces it.
+if pid == 0:
+    os.remove(os.path.join(spill_dir, "proc0", "disk_adamw.json"))
+disk_prog2 = build_train_program(dcfg, runtime=MeshRuntime(dcfg.mesh))
+disk_state2 = disk_prog2.init(jax.random.PRNGKey(7))
+st2 = disk_prog2.disk_store
+assert not st2.attached, f"pid {pid}: attached warm despite peer's torn spill"
+assert st2.moment_steps == 0
+print(f"child {pid} consensus ok", flush=True)
 print(f"child {pid} ok", flush=True)
 """
 

@@ -126,5 +126,77 @@ def test_moe_training_end_to_end_with_expert_parallelism():
     assert losses[-1] < losses[0], losses
 
 
+def test_ragged_moe_matches_dense_when_nothing_drops():
+    """moe_impl='ragged' (sort + lax.ragged_dot, round 5) computes the
+    SAME function as dense dispatch whenever the capacity factor is
+    large enough that dense drops no token: both renormalise the top-k
+    gates to sum 1 and both pick experts greedily-by-probability (top_k
+    tie-break = lowest index, same as iterative argmax)."""
+    big_cf = CFG.with_(capacity_factor=8.0)       # nothing can drop
+    ragged = big_cf.with_(moe_impl="ragged")
+    params = tfm.init_params(jax.random.PRNGKey(0), CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, CFG.vocab_size)
+    ld, auxd = tfm.forward_and_aux(params, tokens, big_cf, compute_dtype=jnp.float32)
+    lr_, auxr = tfm.forward_and_aux(params, tokens, ragged, compute_dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(lr_), np.asarray(ld), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(float(auxr), float(auxd), rtol=1e-5)
+
+
+def test_ragged_moe_grads_and_training():
+    """Gradients reach every expert through the sort/gather/ragged_dot
+    chain, and end-to-end training decreases the loss."""
+    ragged = CFG.with_(moe_impl="ragged")
+    params = tfm.init_params(jax.random.PRNGKey(0), ragged)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, CFG.vocab_size)
+
+    def loss(p):
+        logits, aux = tfm.forward_and_aux(p, tokens, ragged, compute_dtype=jnp.float32)
+        tgt = tokens[:, 1:]
+        lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), -1)
+        ll = jnp.take_along_axis(lp, tgt[..., None], -1)
+        return -jnp.mean(ll) + 0.01 * aux
+
+    grads = jax.grad(loss)(params)
+    g = np.asarray(grads["layers"]["gate"]["kernel"])
+    assert (np.abs(g).sum(axis=(0, 2, 3)) > 0).all()
+    assert np.abs(np.asarray(grads["layers"]["router"]["kernel"])).sum() > 0
+
+    cfg = TPUTrainConfig(
+        model_name="moe-tiny",
+        sharding_stage=ShardingStage.FULL_PARTITIONING,
+        mesh=MeshConfig(data=2, fsdp=4),
+        micro_batch_size=2,
+        gradient_accumulation_steps=1,
+        seq_len=64,
+        precision="fp32",
+        total_steps=8,
+        warmup_steps=1,
+        learning_rate=5e-3,
+        activation_checkpointing=False,
+    )
+    prog = build_train_program(cfg, model_cfg=ragged)
+    state = prog.init(jax.random.PRNGKey(0))
+    batch = prog.synthetic_batch(0)
+    losses = []
+    for _ in range(8):
+        state, metrics = prog.step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_ragged_moe_rejects_expert_parallelism():
+    cfg = TPUTrainConfig(
+        model_name="moe-tiny",
+        sharding_stage=ShardingStage.FULL_PARTITIONING,
+        mesh=MeshConfig(data=2, fsdp=2, model=2),
+        micro_batch_size=2, seq_len=64, precision="fp32",
+    )
+    with pytest.raises(ValueError, match="ragged"):
+        build_train_program(
+            cfg, model_cfg=CFG.with_(moe_impl="ragged")
+        )
+
+
 # Compile-heavy module: excluded from the fast core run (pytest -m "not slow").
 pytestmark = pytest.mark.slow

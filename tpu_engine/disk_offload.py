@@ -193,20 +193,30 @@ class DiskAdamW:
 
     def initialize(self, params_host: Any,
                    decay_mask: dict[str, bool],
-                   shapes: Optional[dict[str, tuple[int, ...]]] = None) -> bool:
+                   shapes: Optional[dict[str, tuple[int, ...]]] = None,
+                   force_fresh: bool = False) -> bool:
         """Create (or re-attach to) the spill. ``params_host`` maps leaf
         path → fp32 ndarray, OR is a callable ``path -> ndarray`` fetched
         one leaf at a time (bounded host residency — the tier's whole
         point; pass ``shapes`` alongside). Returns True when an existing
         spill was re-attached (masters/moments kept — the caller should
-        trust the DISK masters over its own init values)."""
+        trust the DISK masters over its own init values).
+        ``force_fresh`` skips the attach attempt — the multi-host
+        consensus path uses it when ANOTHER host could not attach (all
+        hosts must reseed together or the stitched global state mixes
+        trajectories)."""
         os.makedirs(self.dir, exist_ok=True)
         fetch = params_host if callable(params_host) else params_host.get
         if shapes is None:
             if callable(params_host):
                 raise ValueError("callable params_host requires shapes")
             shapes = {p: tuple(np.shape(a)) for p, a in params_host.items()}
-        if not self.slabs and self.try_attach(shapes, decay_mask):
+        if force_fresh:
+            self.slabs.clear()
+            self.attached = False
+            self.step_on_disk = None
+            self.moment_steps = 0
+        elif not self.slabs and self.try_attach(shapes, decay_mask):
             return True
         self.slabs.clear()
         # Fresh seed: drop slab files from any PREVIOUS layout (e.g. the
@@ -435,6 +445,7 @@ class AsyncShardUploader:
         self._sh = leaf_shardings
         self._dtype = dtype
         self._blocks: dict[str, list] = {}
+        self._complete: dict[str, Any] = {}
         self._err: Optional[BaseException] = None
         self._q: "queue.Queue[Optional[tuple[str, np.ndarray]]]" = \
             queue.Queue(maxsize=1)
@@ -450,9 +461,18 @@ class AsyncShardUploader:
             try:
                 path, devices = self._keys[key]
                 block = arr.astype(self._dtype)
-                self._blocks.setdefault(path, []).extend(
-                    jax.device_put(block, d) for d in devices
-                )
+                sh = self._sh[path]
+                if len(devices) > 1 and len(devices) == len(
+                    sh.addressable_devices
+                ):
+                    # A fully-replicated single-shard leaf: one
+                    # sharding-aware transfer (the runtime broadcasts
+                    # on-device) instead of one H2D copy per device.
+                    self._complete[path] = jax.device_put(block, sh)
+                else:
+                    self._blocks.setdefault(path, []).extend(
+                        jax.device_put(block, d) for d in devices
+                    )
             except BaseException as e:  # noqa: BLE001 — rethrown in result()
                 self._err = e
 
@@ -484,12 +504,14 @@ class AsyncShardUploader:
         self.close()
         if self._err is not None:
             raise self._err
-        return {
+        out = {
             path: jax.make_array_from_single_device_arrays(
                 self._shapes[path], self._sh[path], blocks
             )
             for path, blocks in self._blocks.items()
         }
+        out.update(self._complete)
+        return out
 
 
 class WalkInFlight:

@@ -71,6 +71,17 @@ class ModelConfig:
     top_k: int = 2
     capacity_factor: float = 1.25
     router_aux_coef: float = 0.01
+    # MoE dispatch implementation:
+    # - "dense": Switch/MTF-style capacity-factor dense dispatch — all
+    #   routing work is einsum on the MXU, tokens over capacity DROP,
+    #   [B,S,E,C] dispatch/combine tensors cost ~O(S²) FLOPs at long
+    #   seq (measured 33% tax at seq 2048; ragged WINS at seq 8192 — RESULTS.md). The only
+    #   choice under expert parallelism (GSPMD partitions einsums).
+    # - "ragged": sort-by-expert + lax.ragged_dot grouped matmuls — no
+    #   capacity, no drops, dispatch/combine become gathers/scatters.
+    #   Single-shard experts only (ragged_dot is not GSPMD-partitionable
+    #   over the expert dim; validated at build).
+    moe_impl: str = "dense"
 
     # Per-head dim decoupled from d_model // n_heads (Gemma: 256). 0 = derived.
     head_dim_override: int = 0
@@ -484,6 +495,70 @@ def _attention(q, k, v, impl: str, mesh=None, window: int = 0):
                                force_xla=(impl != "flash"), window=window)
 
 
+def _moe_mlp_ragged(h, layer_params, cfg: ModelConfig):
+    """Top-k routed MoE via sort + grouped matmuls (``lax.ragged_dot``).
+
+    The dense-dispatch formulation's [B, S, E, C] dispatch/combine
+    einsums cost O(B·S²·cf·k/E·D) FLOPs — a 33% routing tax at seq 2048
+    that grows with sequence; this ragged path wins +19% at seq 8192
+    (measured crossover, RESULTS.md §MoE). Tokens are SORTED by
+    their assigned expert and each expert's contiguous row-group hits one
+    grouped matmul: the dispatch/combine become a gather and a
+    segment-sum (memory ops, not FLOPs), and there is NO capacity — no
+    token is ever dropped. Routing indices are integers (constant under
+    autodiff, the standard straight-through treatment); gradients flow
+    through the gather/scatter and ``ragged_dot``'s native transpose.
+
+    Single-shard experts only: ``ragged_dot`` is a custom primitive GSPMD
+    cannot partition over the expert dim, so expert parallelism keeps the
+    dense path (``build_train_program`` validates).
+    """
+    B, S, D = h.shape
+    E, K = cfg.n_experts, cfg.top_k
+    BS = B * S
+    x = h.reshape(BS, D)
+
+    router_logits = jnp.einsum(
+        "td,de->te", x, layer_params["router"]["kernel"],
+        preferred_element_type=jnp.float32,
+    )
+    probs = jax.nn.softmax(router_logits, axis=-1)       # [BS, E] fp32
+    gate_vals, expert_idx = lax.top_k(probs, K)          # [BS, K]
+    gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+
+    flat_expert = expert_idx.reshape(-1)                 # [BS*K]
+    order = jnp.argsort(flat_expert)                     # stable
+    tok = jnp.arange(BS * K, dtype=jnp.int32) // K       # slot → token
+    tok_sorted = tok[order]
+    xs = jnp.take(x, tok_sorted, axis=0)                 # [BS*K, D] gather
+    group_sizes = jnp.bincount(flat_expert, length=E).astype(jnp.int32)
+
+    def kern(name):
+        w = layer_params[name]["kernel"]
+        if isinstance(w, QuantWeight):
+            return dequantize_weight(w, h.dtype)
+        return w
+
+    g = lax.ragged_dot(xs, kern("gate"), group_sizes,
+                       preferred_element_type=h.dtype)
+    u = lax.ragged_dot(xs, kern("up"), group_sizes,
+                       preferred_element_type=h.dtype)
+    y = lax.ragged_dot(jax.nn.silu(g) * u, kern("down"), group_sizes,
+                       preferred_element_type=h.dtype)   # [BS*K, D]
+    w_sorted = gate_vals.reshape(-1)[order].astype(h.dtype)
+    out = jax.ops.segment_sum(
+        y * w_sorted[:, None], tok_sorted, num_segments=BS
+    )
+    out = out.reshape(B, S, D)
+
+    # Same load-balancing aux loss as the dense path (Switch eq. 4).
+    first_choice = jax.nn.one_hot(expert_idx[:, 0], E, dtype=jnp.float32)
+    f = jnp.mean(first_choice, axis=0)
+    p = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(f * p)
+    return out, aux
+
+
 def _moe_mlp(h, layer_params, cfg: ModelConfig):
     """Top-k routed mixture-of-experts MLP (Switch/MTF-style dense dispatch).
 
@@ -647,7 +722,12 @@ def _block(
 
     h = _norm(x, layer_params["mlp_norm"], cfg)
     if cfg.is_moe:
-        mlp_out, aux = _moe_mlp(h, layer_params, cfg)
+        if cfg.moe_impl not in ("dense", "ragged"):  # trace-time, free
+            raise ValueError(
+                f"moe_impl={cfg.moe_impl!r} unknown; use 'dense' or 'ragged'"
+            )
+        moe = _moe_mlp_ragged if cfg.moe_impl == "ragged" else _moe_mlp
+        mlp_out, aux = moe(h, layer_params, cfg)
         x = x + mlp_out
         return x, aux
     return x + _dense_mlp(h, layer_params, lora, lora_scale, cfg=cfg), jnp.zeros((), jnp.float32)

@@ -390,6 +390,19 @@ def build_train_program(
             "full-sequence context parallelism); use a mesh without a "
             "sequence axis, or set sliding_window=0"
         )
+    # Ragged MoE × expert parallelism: lax.ragged_dot is a primitive GSPMD
+    # cannot partition over the expert dim — sharded experts must keep the
+    # dense-dispatch einsum path. Reject at build, not at first trace.
+    if (
+        model_cfg.is_moe
+        and model_cfg.moe_impl == "ragged"
+        and mesh.shape.get("model", 1) > 1
+    ):
+        raise ValueError(
+            "moe_impl='ragged' does not support expert parallelism "
+            "(ragged_dot cannot shard over the expert dim); use "
+            "moe_impl='dense' on meshes with a model axis"
+        )
     # Mesh is threaded into the forward pass for sequence-parallel attention
     # (shard_map over the 'sequence' axis) and for the flash kernel on
     # multi-device meshes (Mosaic calls cannot be GSPMD-partitioned — the
@@ -1156,6 +1169,31 @@ def _assemble_disk_tier(
     spill_dir = cfg.optimizer_spill_dir
     if jax.process_count() > 1:
         spill_dir = os.path.join(spill_dir, f"proc{jax.process_index()}")
+        if jax.process_index() == 0 and os.path.isdir(cfg.optimizer_spill_dir):
+            # A dir previously used single-process holds FLAT slab files
+            # this multi-host run will never touch — clean them (proc 0
+            # only; they are stale for this layout either way).
+            for f in os.listdir(cfg.optimizer_spill_dir):
+                if f.endswith(".f32") or f == "disk_adamw.json":
+                    try:
+                        os.remove(os.path.join(cfg.optimizer_spill_dir, f))
+                    except OSError:
+                        pass
+
+    def _all_hosts(flag: bool) -> bool:
+        """Cross-process consensus on a local boolean (True only when
+        EVERY process reports True). Attach/reseed decisions must agree
+        cluster-wide: a host that attaches warm moments while another
+        reseeds fresh would stitch a global tree from divergent
+        trajectories — silently."""
+        if jax.process_count() == 1:
+            return flag
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([bool(flag)])
+        )
+        return bool(np.all(flags))
     store = dsk.DiskAdamW(
         spill_dir, b1=cfg.beta1, b2=cfg.beta2, eps=1e-8,
         weight_decay=cfg.weight_decay,
@@ -1272,12 +1310,16 @@ def _assemble_disk_tier(
         )
 
     def _ensure_store(params) -> bool:
-        """Attach if a clean matching spill exists (shape-only check — no
-        device fetch); otherwise seed a fresh spill from ``params``."""
-        if store.try_attach(_flat_shapes, _flat_mask):
+        """Attach if a clean matching spill exists ON EVERY HOST
+        (shape-only check — no device fetch); otherwise ALL hosts seed a
+        fresh spill from ``params`` (one host's lost/torn spill forces a
+        cluster-wide reseed — mixed warm/fresh moments would silently
+        diverge the stitched global state)."""
+        attached = bool(store.slabs) or store.try_attach(_flat_shapes, _flat_mask)
+        if _all_hosts(attached):
             return True
         return store.initialize(_leaf_fetcher(params), _flat_mask,
-                                shapes=_flat_shapes)
+                                shapes=_flat_shapes, force_fresh=True)
 
     def _params_from_masters():
         # Shard-at-a-time through the SAME uploader the update walk uses
@@ -1305,16 +1347,22 @@ def _assemble_disk_tier(
             # eval_shape path (the supervisor derives state shapes by
             # tracing init) — no host I/O under a tracer.
             return pure(rng)
-        if store.slabs or store.try_attach(_flat_shapes, _flat_mask):
-            # A matching clean spill exists: ITS masters are the truth
-            # (warm restart) — no throwaway random init, no D2H fetch.
+        if _all_hosts(
+            bool(store.slabs) or store.try_attach(_flat_shapes, _flat_mask)
+        ):
+            # A matching clean spill exists on EVERY host: its masters
+            # are the truth (warm restart) — no throwaway random init,
+            # no D2H fetch.
             params = _params_from_masters()
         else:
             masters = jax.jit(
                 lambda r: tfm.init_params(r, model_cfg, dtype=master_dtype),
                 out_shardings=param_sh,
             )(rng)
-            _ensure_store(masters)
+            # force_fresh: a host that COULD attach must still reseed
+            # when any peer cannot (cluster-wide agreement).
+            store.initialize(_leaf_fetcher(masters), _flat_mask,
+                             shapes=_flat_shapes, force_fresh=True)
             params = jax.jit(
                 _to_compute, donate_argnums=(0,), out_shardings=param_sh
             )(masters)
@@ -1362,12 +1410,15 @@ def _assemble_disk_tier(
         # and the trajectory restarts from them (masters reseeded,
         # moments zeroed, bias-correction counter reset — the LR
         # schedule keeps the state's step).
-        if store.step_on_disk is not None and store.step_on_disk != t - 1:
-            # cast_dtype: where a master still rounds to exactly the
-            # incoming (compute-dtype-truncated) value, keep the fp32
-            # master — a reseed from a state that never diverged (warm
-            # re-attach without a restored step counter) must not shave
-            # master precision to bf16.
+        needs = store.step_on_disk is not None and store.step_on_disk != t - 1
+        if not _all_hosts(not needs):
+            # Any ONE host's discontinuity reseeds every host — moments
+            # must restart together or the stitched state mixes Adam
+            # bias-correction counters. cast_dtype: where a master still
+            # rounds to exactly the incoming (compute-dtype-truncated)
+            # value, keep the fp32 master — a reseed from a state that
+            # never diverged (warm re-attach without a restored step
+            # counter) must not shave master precision to bf16.
             store.reseed_masters(
                 _leaf_fetcher(state["params"]), step=t - 1,
                 cast_dtype=compute_dtype,
