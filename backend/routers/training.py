@@ -67,6 +67,11 @@ class TrainingLaunchRequest(BaseModel):
         default=None, ge=0,
         description="sliding-window attention: None = model preset's window, "
         "0 = full causal, N = window of N keys")
+    moe_impl: Optional[Literal["dense", "ragged"]] = Field(
+        default=None,
+        description="MoE dispatch (MoE models only): dense = capacity-factor "
+        "einsum dispatch (expert-parallel shardable); ragged = sort + "
+        "ragged_dot, no token dropping, wins at long sequence")
     activation_checkpointing: bool = True
     elastic_min_devices: Optional[int] = Field(
         default=None, ge=1,
@@ -155,6 +160,7 @@ def _to_config(req: TrainingLaunchRequest) -> TPUTrainConfig:
             attention_impl=req.attention_impl,
             pipeline_schedule=req.pipeline_schedule,
             sliding_window=req.sliding_window,
+            moe_impl=req.moe_impl,
             activation_checkpointing=req.activation_checkpointing,
             elastic_min_devices=req.elastic_min_devices,
             elastic_max_devices=req.elastic_max_devices,

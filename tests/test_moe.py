@@ -185,6 +185,29 @@ def test_ragged_moe_grads_and_training():
     assert losses[-1] < losses[0], losses
 
 
+def test_moe_impl_config_override():
+    """moe_impl rides TPUTrainConfig (and the HTTP launch request) like
+    the attention_impl/sliding_window overrides: it re-targets the model
+    config at build time, and setting it on a dense model is an error."""
+    cfg = TPUTrainConfig(
+        model_name="moe-tiny",
+        sharding_stage=ShardingStage.FULL_PARTITIONING,
+        mesh=MeshConfig(data=2, fsdp=4),
+        micro_batch_size=2, seq_len=64, precision="fp32",
+        moe_impl="ragged",
+    )
+    prog = build_train_program(cfg)
+    assert prog.model_config.moe_impl == "ragged"
+    for impl in ("ragged", "dense"):  # 'dense' must not slip through the
+        #                               matches-the-default short-circuit
+        with pytest.raises(ValueError, match="dense model"):
+            build_train_program(TPUTrainConfig(
+                model_name="gpt-tiny", mesh=MeshConfig(data=-1),
+                micro_batch_size=2, seq_len=64, precision="fp32",
+                moe_impl=impl,
+            ))
+
+
 def test_ragged_moe_rejects_expert_parallelism():
     cfg = TPUTrainConfig(
         model_name="moe-tiny",

@@ -322,6 +322,12 @@ class TPUTrainConfig(BaseModel):
     # Sliding-window attention override: None = the model preset's own
     # window (e.g. mistral-7b → 4096); 0 = force full causal; N = window N.
     sliding_window: Optional[int] = Field(default=None, ge=0)
+    # MoE dispatch override (MoE models only): None = the model's own
+    # setting (dense). "dense" = capacity-factor dense dispatch (expert-
+    # parallel shardable); "ragged" = sort + lax.ragged_dot, no token
+    # dropping, wins at long sequence (measured crossover in
+    # benchmarks/RESULTS.md §MoE; single-shard experts only).
+    moe_impl: Optional[Literal["dense", "ragged"]] = None
 
     # LoRA fine-tuning: when lora_rank is set, only rank-sized adapters on
     # lora_targets train (tpu_engine/lora.py); the base model is frozen —

@@ -381,6 +381,17 @@ def build_train_program(
         model_cfg = model_cfg.with_(attention_impl=impl)
     if cfg.sliding_window is not None and model_cfg.sliding_window != cfg.sliding_window:
         model_cfg = model_cfg.with_(sliding_window=cfg.sliding_window)
+    if cfg.moe_impl is not None:
+        if not model_cfg.is_moe:
+            # Checked BEFORE the no-op short-circuit: moe_impl='dense'
+            # on a dense model must error like 'ragged' does, not be
+            # silently swallowed because it matches the default.
+            raise ValueError(
+                f"moe_impl={cfg.moe_impl!r} set on the dense model "
+                f"{model_cfg.name!r} (no experts to dispatch)"
+            )
+        if model_cfg.moe_impl != cfg.moe_impl:
+            model_cfg = model_cfg.with_(moe_impl=cfg.moe_impl)
     # Reject window × sequence-parallel here, at build time, rather than
     # letting the job fail at first-step trace deep inside _attention.
     if model_cfg.sliding_window and impl in ("ring", "ulysses"):
