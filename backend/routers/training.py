@@ -58,6 +58,27 @@ class TrainingLaunchRequest(BaseModel):
     # optimizer state (the reference's nvme_path).
     optimizer_spill_dir: Optional[str] = None
     grad_allreduce_dtype: Optional[str] = None
+    # ZeRO++-style collective compression (tpu_engine/comm_compress.py);
+    # stage-3 + (data, fsdp)-only meshes — see TPUTrainConfig validators.
+    comm_quant_weights: bool = Field(
+        default=False,
+        description="qwZ: the ZeRO-3 weight all-gather moves block-quantized "
+        "int8 codes + per-block fp32 scales instead of full-width values "
+        "(~3.9x fewer bytes at block 256)")
+    comm_secondary_weights: bool = Field(
+        default=False,
+        description="hpZ: steady-state gathers read a pre-quantized secondary "
+        "int8 replica refreshed once per optimizer step (requires "
+        "comm_quant_weights)")
+    comm_quant_grads: bool = Field(
+        default=False,
+        description="qgZ: hierarchical gradient reduction — fp32 psum within "
+        "each slice over ICI, stochastically-rounded int8 partials across "
+        "slices over DCN")
+    comm_quant_block_size: int = Field(
+        default=256, ge=8,
+        description="quantization block length along each tensor's last axis; "
+        "per-block fp32 scale overhead is 4/block_size bytes per element")
     attention_impl: Literal["auto", "xla", "flash", "ring", "ulysses"] = "auto"
     # "auto" resolves at build time: 1f1b when the microbatch count
     # exceeds the pipe-stage count (where its O(P) activation residency
@@ -157,6 +178,10 @@ def _to_config(req: TrainingLaunchRequest) -> TPUTrainConfig:
                 if req.grad_allreduce_dtype
                 else None
             ),
+            comm_quant_weights=req.comm_quant_weights,
+            comm_secondary_weights=req.comm_secondary_weights,
+            comm_quant_grads=req.comm_quant_grads,
+            comm_quant_block_size=req.comm_quant_block_size,
             attention_impl=req.attention_impl,
             pipeline_schedule=req.pipeline_schedule,
             sliding_window=req.sliding_window,

@@ -69,3 +69,25 @@ def test_supervisor_enables_without_crashing(tmp_path, monkeypatch):
     # CPU backend: skipped by design; the config threading is covered by
     # the force-path tests above.
     assert compile_cache.cache_dir_in_use() is None
+
+
+def test_enable_after_prior_compile_still_caches(tmp_path, monkeypatch):
+    """JAX memoizes a cache-unused verdict at the process's FIRST compile
+    (``is_cache_used``): a worker that jitted anything before calling
+    ``enable_compilation_cache`` — telemetry probe, eval_shape warm-up —
+    would silently get no cache. Enabling must clear the latch."""
+    from jax._src import compilation_cache as _cc
+
+    jax.config.update("jax_compilation_cache_dir", None)
+    _cc.reset_cache()  # pristine: no verdict yet
+    # First compile with no dir configured latches the cache-OFF verdict.
+    jax.jit(lambda x: x * 2)(jnp.ones(4)).block_until_ready()
+
+    d = str(tmp_path / "late-enable")
+    monkeypatch.setattr(compile_cache, "_enabled_dir", None)
+    assert compile_cache.enable_compilation_cache(d, force=True) == d
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.jit(lambda x: jnp.cos(x @ x).sum())(
+        jnp.ones((32, 32))
+    ).block_until_ready()
+    assert os.listdir(d), "cache-unused latch survived enable"

@@ -364,3 +364,106 @@ def test_disk_tier_supervised_job(tmp_path):
     assert job.current_step == 3
     assert job.program.disk_store.step_on_disk == 3
     assert job.program.disk_store.spill_bytes() > 0
+
+
+def test_is_replicated_upload_guard():
+    """The uploader's single-transfer broadcast path is only safe when the
+    emitted block IS the whole leaf and this process addresses every
+    device holding it. The original gate compared device counts only, so
+    on multi-host meshes a process's PARTIAL block (its local shard of a
+    leaf replicated across hosts) was broadcast as if it were the full
+    leaf."""
+    from tpu_engine.disk_offload import is_replicated_upload
+
+    # Single-process replicated leaf: block == leaf, all devices local.
+    assert is_replicated_upload((16, 4), (16, 4), 8, 8)
+    # Multi-host regression: the emitted block is this process's LOCAL
+    # slice — the shape mismatch must force the per-device path even
+    # when the leaf's devices all happen to be addressable here.
+    assert not is_replicated_upload((8, 4), (16, 4), 2, 2)
+    # Devices on other hosts hold replicas: no sharding-aware transfer
+    # from this process can cover them.
+    assert not is_replicated_upload((16, 4), (16, 4), 8, 4)
+    # Single-device leaves gain nothing from the broadcast path.
+    assert not is_replicated_upload((16, 4), (16, 4), 1, 1)
+
+
+def test_uploader_replicated_fast_path_and_sharded_stitch():
+    """Replicated leaves still take the one-transfer fast path after the
+    multi-host guard, and fsdp-sharded leaves stitch per-device blocks —
+    both reassemble the exact master values."""
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_engine.disk_offload import AsyncShardUploader
+
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs), ("fsdp",))
+    full = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+    per = 16 // len(devs)
+
+    key_devices = {"rep:0": ("rep", list(devs))}
+    for i, d in enumerate(devs):
+        key_devices[f"shard:{i}"] = ("shard", [d])
+    up = AsyncShardUploader(
+        key_devices,
+        {"rep": (16, 4), "shard": (16, 4)},
+        {"rep": NamedSharding(mesh, P()),
+         "shard": NamedSharding(mesh, P("fsdp"))},
+        jnp.float32,
+    )
+    up.emit("rep:0", full)
+    for i in range(len(devs)):
+        up.emit(f"shard:{i}", full[i * per:(i + 1) * per])
+    out = up.result()
+
+    assert "rep" in up._complete and "rep" not in up._blocks
+    assert "shard" in up._blocks and "shard" not in up._complete
+    np.testing.assert_array_equal(np.asarray(out["rep"]), full)
+    np.testing.assert_array_equal(np.asarray(out["shard"]), full)
+    assert out["rep"].sharding.is_fully_replicated
+
+
+def test_consensus_check_hoisted_from_hot_loop(tmp_path):
+    """The discontinuity consensus (a cross-host allgather per call) runs
+    once after attach and is then cached: steady sequential steps never
+    re-enter it. Rollbacks and fresh attaches invalidate the cache."""
+    prog = build_train_program(_cfg(tmp_path / "spill"))
+    state = prog.init(jax.random.PRNGKey(prog.config.seed))
+    assert prog.disk_store.consensus_checks == 0
+
+    saved = None
+    for i in range(4):
+        state, _ = prog.step(state, prog.synthetic_batch(i))
+        if i == 0:
+            saved = state
+    assert prog.disk_store.consensus_checks == 1  # first step only
+
+    # Supervisor rollback: the incoming state is older than the spill —
+    # cached continuity no longer holds, the consensus must rerun (and
+    # reseed), then steady state re-caches.
+    state, _ = prog.step(saved, prog.synthetic_batch(1))
+    assert prog.disk_store.consensus_checks == 2
+    state, _ = prog.step(state, prog.synthetic_batch(2))
+    assert prog.disk_store.consensus_checks == 2
+
+    # A fresh program attaching to the same spill re-establishes
+    # consensus exactly once.
+    prog2 = build_train_program(_cfg(tmp_path / "spill"))
+    state2 = prog2.init(jax.random.PRNGKey(prog2.config.seed))
+    state2 = dict(state2, step=state["step"])
+    for i in range(2):
+        state2, _ = prog2.step(state2, prog2.synthetic_batch(3 + i))
+    assert prog2.disk_store.consensus_checks == 1
+
+
+def test_consensus_cached_with_overlap(tmp_path):
+    """Same hoist under delayed-parameter-update overlap: the in-flight
+    walk marks its target step verified at dispatch, so the next
+    sequential step skips the consensus."""
+    prog = build_train_program(
+        _cfg(tmp_path / "spill", disk_update_overlap=True)
+    )
+    state, losses = _run(prog, 5)
+    assert prog.disk_store.consensus_checks == 1
+    assert np.isfinite(losses).all()

@@ -49,6 +49,36 @@ def xla_flags_for(cfg: TPUTrainConfig) -> str:
     return " ".join(parts)
 
 
+def compression_plan(cfg: TPUTrainConfig) -> dict:
+    """The comm-compression surface of ``cfg`` as a plan/launch-report
+    dict (tpu_engine/comm_compress.py): which ZeRO++ mechanisms are on,
+    the block size, and the analytic per-element wire reduction each one
+    buys (int8 codes + fp32/block scales vs. fp32 full-width). Purely
+    declarative — the mechanisms themselves are wired in train.py."""
+    from tpu_engine import comm_compress
+
+    plan: dict = {
+        "enabled": comm_compress.enabled(cfg),
+        "quant_weight_gather": cfg.comm_quant_weights,
+        "secondary_weight_partition": cfg.comm_secondary_weights,
+        "quant_grad_reduce": cfg.comm_quant_grads,
+        "block_size": cfg.comm_quant_block_size,
+    }
+    if plan["enabled"]:
+        factors = comm_compress.expected_volume_factors(
+            cfg.comm_quant_block_size
+        )
+        if cfg.comm_quant_weights:
+            plan["weight_gather_volume_factor"] = round(
+                factors["weight_gather"], 3
+            )
+        if cfg.comm_quant_grads:
+            plan["cross_slice_grad_volume_factor"] = round(
+                factors["grad_cross_slice"], 3
+            )
+    return plan
+
+
 def _backend_initialized() -> bool:
     import jax
 

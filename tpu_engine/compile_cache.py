@@ -64,10 +64,12 @@ def enable_compilation_cache(
     jax.config.update("jax_compilation_cache_dir", d)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    if prev is not None and prev != d:
-        # JAX's cache object binds to the directory it was first used with;
-        # re-pointing the config requires dropping it or writes keep going
-        # to the old path.
+    if prev != d:
+        # Two latches make a plain config update insufficient: the cache
+        # object binds to the directory it was first used with, and
+        # ``is_cache_used`` memoizes a cache-OFF verdict at the process's
+        # FIRST compile — so enabling after any earlier jit (telemetry
+        # probe, eval_shape warm-up) would silently cache nothing.
         try:
             from jax._src import compilation_cache as _cc
 

@@ -418,6 +418,25 @@ def unflatten_like(tree: Any, flat: dict[str, Any]) -> Any:
     )
 
 
+def is_replicated_upload(block_shape: tuple, leaf_shape: tuple,
+                         n_devices: int, n_addressable: int) -> bool:
+    """Whether an uploaded block may take the one-transfer replicated
+    fast path (``jax.device_put(block, sharding)``) instead of the
+    per-device block-stitch.
+
+    Spanning all ADDRESSABLE devices is necessary but not sufficient: on
+    a multi-host mesh a leaf can be replicated over this host's devices
+    while still globally SHARDED across hosts — its local block is then
+    a fraction of the leaf, and ``device_put(block, global_sharding)``
+    would quietly lay the shard out as if it were the whole array. The
+    block must also BE the full leaf."""
+    return (
+        n_devices > 1
+        and n_devices == n_addressable
+        and tuple(block_shape) == tuple(leaf_shape)
+    )
+
+
 class AsyncShardUploader:
     """Overlaps device uploads of updated master SHARDS with the next
     leaf's disk update: ``emit`` hands the fp32 block to ONE worker
@@ -462,8 +481,9 @@ class AsyncShardUploader:
                 path, devices = self._keys[key]
                 block = arr.astype(self._dtype)
                 sh = self._sh[path]
-                if len(devices) > 1 and len(devices) == len(
-                    sh.addressable_devices
+                if is_replicated_upload(
+                    block.shape, self._shapes[path], len(devices),
+                    len(sh.addressable_devices),
                 ):
                     # A fully-replicated single-shard leaf: one
                     # sharding-aware transfer (the runtime broadcasts

@@ -28,6 +28,7 @@ from typing import Any, Callable, Optional
 import jax
 from pydantic import BaseModel, Field
 
+from tpu_engine import comm
 from tpu_engine.mesh_runtime import MESH_AXES, MeshConfig
 from tpu_engine.models import transformer as tfm
 from tpu_engine.sharding import (
@@ -152,6 +153,9 @@ class TPULauncher:
                 "master_params": config.param_dtype.value,
                 "loss_scaling": "none (bf16 — not needed)",
             },
+            # ZeRO++-style collective compression (tpu_engine/comm_compress.py):
+            # which mechanisms are on and the analytic wire-volume factors.
+            "comm_compression": comm.compression_plan(config),
             "activation_checkpointing": {
                 "enabled": config.activation_checkpointing,
                 "policy": config.remat_policy,

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_engine import comm_compress
 from tpu_engine.mesh_runtime import BATCH_AXES, MeshRuntime
 from tpu_engine.models import transformer as tfm
 from tpu_engine.sharding import (
@@ -433,6 +434,17 @@ def build_train_program(
                 f"{runtime.axis_sizes['model']} = {local_heads}) divisible by "
                 f"the sequence axis size {seq_size}"
             )
+    # ZeRO++-style comm compression (tpu_engine/comm_compress.py): the
+    # grad path moves into a full-manual shard_map whose collectives are
+    # explicit int8 gathers/reductions. Config validators reject most bad
+    # combos; the runtime-shaped ones (resolved attention kernel, actual
+    # mesh axis extents) must be re-checked here — reaching the SPMD
+    # partitioner with a nested/partial-auto manual region aborts the
+    # process rather than raising.
+    compress = comm_compress.enabled(cfg)
+    if compress:
+        comm_compress.validate_runtime(cfg, runtime, model_cfg, attn_mesh=attn_mesh)
+
     stage = cfg.sharding_stage
     compute_dtype = cfg.compute_dtype()
     master_dtype = cfg.master_dtype()
@@ -577,8 +589,11 @@ def build_train_program(
     # then fully rematerialises (all-gathers) per-layer weights that should
     # stay sharded. One explicit constraint per slice removes the ambiguity
     # at zero cost when the layout already matches.
+    # Under comm compression the loss runs inside a full-manual shard_map
+    # region, where with_sharding_constraint is illegal (there is no GSPMD
+    # propagation to anchor) — the explicit gathers pin every layout.
     layer_constraint = None
-    if mesh.size > 1:
+    if mesh.size > 1 and not compress:
         _full_layer_pspecs = (
             param_pspecs(logical, stage)["layers"] if use_lora
             else p_pspecs["layers"]
@@ -788,6 +803,48 @@ def build_train_program(
         train_loss_fn = loss_fn
 
     grad_fn = jax.value_and_grad(train_loss_fn)
+
+    # Compressed gradient path: one full-manual shard_map per microbatch.
+    # Inside it ``train_loss_fn`` sees locally-sharded tokens and the
+    # gathered (dequantized) params, and its raw-sums/global-denom form
+    # makes the per-device losses sum to exactly the GSPMD objective.
+    compression = None
+    if compress:
+        compression = comm_compress.build(
+            mesh=mesh,
+            loss_fn=train_loss_fn,
+            pspecs=p_pspecs,
+            abs_params=state_shape["params"],
+            grad_sh=grad_sh,
+            data_size=runtime.axis_sizes["data"],
+            fsdp_size=runtime.axis_sizes["fsdp"],
+            dcn_data=cfg.mesh.dcn_data,
+            quant_weights=cfg.comm_quant_weights,
+            secondary_weights=cfg.comm_secondary_weights,
+            quant_grads=cfg.comm_quant_grads,
+            block_size=cfg.comm_quant_block_size,
+            dtype=compute_dtype,
+        )
+        if compression.refresh is not None:
+            # hpZ: the secondary int8 store rides the train state so the
+            # steady-state step never re-quantizes (and restores resume
+            # with a consistent replica via init/refresh).
+            hpz_sh = jax.tree.map(
+                lambda spec: NamedSharding(mesh, spec),
+                compression.hpz_pspecs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            state_shardings = {**state_shardings, "hpz": hpz_sh}
+            _base_init = init_fn
+
+            def init_fn(rng: jax.Array) -> dict[str, Any]:
+                state = _base_init(rng)
+                state["hpz"] = compression.refresh(state["params"])
+                return state
+
+            # compress excludes every host-memory-kind combo, so the
+            # simple jit path is always the one being replaced here.
+            jit_init = jax.jit(init_fn, out_shardings=state_shardings)
 
     # ---- pipelined loss (pipe axis > 1): one forward over all microbatches,
     # streamed through the stages; autodiff gives the reverse pipeline. ----
@@ -1005,6 +1062,16 @@ def build_train_program(
         if pipe_size > 1:
             loss, grads = pipe_grad_fn(params_g, batch)
             grads = _reduce_grads(grads)
+        elif compression is not None:
+            # Step-deterministic key for qgZ's stochastic rounding (and
+            # restart-reproducible: derived from seed + step, not a
+            # threaded RNG state).
+            qkey = jax.random.fold_in(
+                jax.random.PRNGKey(cfg.seed), state["step"]
+            )
+            loss, grads = compression.accumulate(
+                params_g, state.get("hpz"), batch, qkey
+            )
         else:
             loss, grads = accumulate_grads(
                 grad_fn, _reduce_grads, params_g, params, batch, grad_sh
@@ -1033,6 +1100,10 @@ def build_train_program(
             "step": state["step"] + 1,
             "lr_scale": state["lr_scale"],
         }
+        if compression is not None and compression.refresh is not None:
+            # hpZ refresh: re-quantize the secondary store from the
+            # just-updated primary partition, once per optimizer step.
+            new_state["hpz"] = compression.refresh(new_params)
         metrics = {
             "loss": loss,
             "grad_norm": grad_norm,
@@ -1377,6 +1448,7 @@ def _assemble_disk_tier(
             params = jax.jit(
                 _to_compute, donate_argnums=(0,), out_shardings=param_sh
             )(masters)
+        _verified_step[0] = None  # init/attach: first step re-checks
         return {
             "params": params,
             "step": jax.device_put(jnp.zeros((), jnp.int32), replicated),
@@ -1413,6 +1485,19 @@ def _assemble_disk_tier(
     # in-flight host walk. Only the engine thread touches this.
     pending: list[Any] = [None]
 
+    # Discontinuity-consensus cache: the ``_all_hosts`` call below is a
+    # blocking cross-host collective, and running it EVERY step would
+    # serialise each disk step behind the slowest host (it used to).
+    # Continuity only changes at attach/init, checkpoint restore, or
+    # rollback — all of which surface as an incoming step that does NOT
+    # continue the last step this process applied or verified, so the
+    # steady state skips the collective entirely after the first agreeing
+    # step. The cache is deterministic (every host sees the same
+    # ``state.step`` sequence and the same walk outcomes), so all hosts
+    # take the same skip/check branch and the collective stays aligned.
+    _verified_step = [None]
+    store.consensus_checks = 0  # observability: actual collective runs
+
     def _check_discontinuity(state, t):
         # ONE discontinuity check covering every path — lazy attach,
         # warm init-attach, in-process rollback, restored checkpoint at
@@ -1421,6 +1506,9 @@ def _assemble_disk_tier(
         # and the trajectory restarts from them (masters reseeded,
         # moments zeroed, bias-correction counter reset — the LR
         # schedule keeps the state's step).
+        if _verified_step[0] == t - 1:
+            return  # steady state: this process applied step t-1 itself
+        store.consensus_checks += 1
         needs = store.step_on_disk is not None and store.step_on_disk != t - 1
         if not _all_hosts(not needs):
             # Any ONE host's discontinuity reseeds every host — moments
@@ -1434,12 +1522,14 @@ def _assemble_disk_tier(
                 _leaf_fetcher(state["params"]), step=t - 1,
                 cast_dtype=compute_dtype,
             )
+        _verified_step[0] = t - 1
 
     def disk_step(state, batch):
         grads, metrics = jit_grad(state, batch)
         t = int(state["step"]) + 1
         if not store.slabs:
             _ensure_store(state["params"])  # restored-without-init path
+            _verified_step[0] = None  # fresh attach: re-establish consensus
         _check_discontinuity(state, t)
         uploader = _make_uploader()
         try:
@@ -1450,6 +1540,7 @@ def _assemble_disk_tier(
         finally:
             uploader.close()  # never leak the worker on an update failure
         new_params = dsk.unflatten_like(state["params"], uploader.result())
+        _verified_step[0] = t  # this process applied t: continuity holds
         new_state = {
             "params": new_params,
             "step": metrics["step"],
@@ -1474,6 +1565,7 @@ def _assemble_disk_tier(
         t = int(state["step"]) + 1
         if not store.slabs:
             _ensure_store(state["params"])
+            _verified_step[0] = None  # fresh attach: re-establish consensus
         prev = pending[0]
         pending[0] = None
         prev_leaves = None
@@ -1492,6 +1584,9 @@ def _assemble_disk_tier(
             store, _grad_fetchers(grads),
             float(metrics["learning_rate"]), t, _make_uploader(),
         )
+        # The in-flight walk will apply t (a failure raises at the next
+        # join and aborts the run — there is no silent-miss path).
+        _verified_step[0] = t
         params = state["params"] if prev_leaves is None else \
             dsk.unflatten_like(state["params"], prev_leaves)
         new_state = {
