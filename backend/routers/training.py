@@ -79,6 +79,22 @@ class TrainingLaunchRequest(BaseModel):
         default=256, ge=8,
         description="quantization block length along each tensor's last axis; "
         "per-block fp32 scale overhead is 4/block_size bytes per element")
+    # AQT-style MXU int8 quantized training (tpu_engine/quant_train.py);
+    # composes with the comm_quant_* wire compression — see
+    # TPUTrainConfig._validate_quant_training for the rejected combos.
+    quant_training: Literal["none", "int8"] = Field(
+        default="none",
+        description="int8: route the targeted training matmuls through a "
+        "per-channel symmetric int8 dot with int32 MXU accumulation and "
+        "stochastically-rounded backward operands (up to 2x the bf16 MXU "
+        "rate; master weights/optimizer state stay full precision). "
+        "Rejected with LoRA, pipeline_schedule='1f1b', and ragged MoE.")
+    quant_train_targets: list[str] = Field(
+        default=["attn", "mlp", "moe"],
+        description="matmul groups riding the quantized dot: 'attn' "
+        "(Q/K/V/O projections), 'mlp' (dense MLP), 'moe' (per-expert "
+        "einsums); router/dispatch/embed/unembed always stay full "
+        "precision")
     attention_impl: Literal["auto", "xla", "flash", "ring", "ulysses"] = "auto"
     # "auto" resolves at build time: 1f1b when the microbatch count
     # exceeds the pipe-stage count (where its O(P) activation residency
@@ -182,6 +198,8 @@ def _to_config(req: TrainingLaunchRequest) -> TPUTrainConfig:
             comm_secondary_weights=req.comm_secondary_weights,
             comm_quant_grads=req.comm_quant_grads,
             comm_quant_block_size=req.comm_quant_block_size,
+            quant_training=req.quant_training,
+            quant_train_targets=tuple(req.quant_train_targets),
             attention_impl=req.attention_impl,
             pipeline_schedule=req.pipeline_schedule,
             sliding_window=req.sliding_window,

@@ -168,6 +168,9 @@ def main() -> None:
     comm_line = _comm_compress_metric(n_dev)
     if comm_line is not None:
         print(json.dumps(comm_line))
+    quant_line = _quant_train_metric()
+    if quant_line is not None:
+        print(json.dumps(quant_line))
 
 
 def _comm_compress_metric(n_dev: int) -> dict | None:
@@ -228,6 +231,58 @@ def _comm_compress_metric(n_dev: int) -> dict | None:
                 base["total_wire_bytes"] / max(full["total_wire_bytes"], 1), 2
             ),
             "n_devices": n_dev,
+        }
+    except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
+        return None
+
+
+def _quant_train_metric() -> dict | None:
+    """Third JSON line: AQT-style int8 quantized-training A/B
+    (tpu_engine/quant_train.py) — step-time ratio and loss parity of
+    quant_training='int8' vs off on the gpt-tiny model, single device,
+    same seed/batch, 8 timed steps (the benchmarks/quant_train.py
+    protocol at bench scale). Never fails the bench: any error degrades
+    to None (MFU already printed)."""
+    try:
+        results = {}
+        for quant in ("none", "int8"):
+            cfg = TPUTrainConfig(
+                model_name="gpt-tiny", mesh=MeshConfig(data=1),
+                micro_batch_size=2, seq_len=128,
+                sharding_stage=ShardingStage.DISABLED,
+                learning_rate=1e-3, warmup_steps=2, total_steps=100,
+                activation_checkpointing=False, attention_impl="auto",
+                quant_training=quant,
+            )
+            program = build_train_program(cfg)
+            state = program.init(jax.random.PRNGKey(0))
+            batch = program.synthetic_batch(seed=0)
+            losses = []
+            t0 = None
+            for i in range(9):
+                state, metrics = program.step(state, batch)
+                losses.append(float(metrics["loss"]))
+                if i == 0:  # exclude compile
+                    jax.block_until_ready(state["params"])
+                    t0 = time.perf_counter()
+            jax.block_until_ready(state["params"])
+            results[quant] = {
+                "dt_ms": (time.perf_counter() - t0) / 8 * 1e3,
+                "losses": losses,
+            }
+            del program, state
+            jax.clear_caches()
+        base, q = results["none"], results["int8"]
+        return {
+            "metric": "quant_train_ab",
+            "value": round(base["dt_ms"] / max(q["dt_ms"], 1e-9), 3),
+            "unit": "x step-time vs bf16 (>1 = int8 faster)",
+            "loss_delta_final": round(
+                abs(base["losses"][-1] - q["losses"][-1]), 5
+            ),
+            "bf16_step_time_ms": round(base["dt_ms"], 2),
+            "int8_step_time_ms": round(q["dt_ms"], 2),
+            "backend": jax.default_backend(),
         }
     except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
         return None

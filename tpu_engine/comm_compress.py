@@ -71,6 +71,15 @@ def _n_blocks(last: int, block: int) -> int:
     return -(-last // block)
 
 
+def stochastic_round(y: jax.Array, key: jax.Array) -> jax.Array:
+    """Unbiased stochastic rounding: ``floor(y + u)``, ``u ~ U[0,1)`` —
+    ``E[result] == y``. The shared rounding helper for the quantized
+    collectives here (qgZ) and the quantized training matmuls
+    (tpu_engine/quant_train.py): zero-mean error needs no error-feedback
+    state."""
+    return jnp.floor(y + jax.random.uniform(key, y.shape))
+
+
 def blockwise_quantize(
     x: jax.Array, block: int, key: Optional[jax.Array] = None
 ) -> tuple[jax.Array, jax.Array]:
@@ -98,7 +107,7 @@ def blockwise_quantize(
     scales = jnp.maximum(absmax, 1e-30) / 127.0
     y = xb / scales[..., None]
     if key is not None:
-        y = jnp.floor(y + jax.random.uniform(key, y.shape))
+        y = stochastic_round(y, key)
     else:
         y = jnp.round(y)
     codes = jnp.clip(y, -127.0, 127.0).astype(jnp.int8)

@@ -28,7 +28,7 @@ from typing import Any, Callable, Optional
 import jax
 from pydantic import BaseModel, Field
 
-from tpu_engine import comm
+from tpu_engine import comm, quant_train
 from tpu_engine.mesh_runtime import MESH_AXES, MeshConfig
 from tpu_engine.models import transformer as tfm
 from tpu_engine.sharding import (
@@ -156,6 +156,9 @@ class TPULauncher:
             # ZeRO++-style collective compression (tpu_engine/comm_compress.py):
             # which mechanisms are on and the analytic wire-volume factors.
             "comm_compression": comm.compression_plan(config),
+            # AQT-style MXU int8 quantized training (tpu_engine/quant_train.py):
+            # mode, targeted matmul groups, and the MFU accounting basis.
+            "quant_training": quant_train.training_plan(config),
             "activation_checkpointing": {
                 "enabled": config.activation_checkpointing,
                 "policy": config.remat_policy,

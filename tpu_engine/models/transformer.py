@@ -33,6 +33,7 @@ from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 
 from tpu_engine.quant import QuantWeight, dequantize_weight
+from tpu_engine.quant_train import int8_einsum
 
 
 @dataclass(frozen=True)
@@ -82,6 +83,14 @@ class ModelConfig:
     #   Single-shard experts only (ragged_dot is not GSPMD-partitionable
     #   over the expert dim; validated at build).
     moe_impl: str = "dense"
+    # MXU int8 quantized training (tpu_engine/quant_train.py): "none" or
+    # "int8". Routes the listed matmul groups through the channel-scaled
+    # int8 einsum primitive — "attn" (Q/K/V/O projections), "mlp" (dense
+    # MLP), "moe" (per-expert einsums). Router/dispatch/embed/unembed
+    # always stay full precision. Resolved onto this config by
+    # build_train_program from TPUTrainConfig (like attention_impl).
+    quant_training: str = "none"
+    quant_train_targets: tuple = ("attn", "mlp", "moe")
 
     # Per-head dim decoupled from d_model // n_heads (Gemma: 256). 0 = derived.
     head_dim_override: int = 0
@@ -612,12 +621,15 @@ def _moe_mlp(h, layer_params, cfg: ModelConfig):
             return dequantize_weight(w, h.dtype)
         return w
 
+    # Only the per-expert matmuls ride the quantized-training hook; the
+    # router (fp32 softmax input) and the [B,S,E,C] dispatch/combine
+    # einsums (0/1 masks and gates — not matmul-heavy per element, and
+    # quantization-sensitive) stay full precision.
+    dot = _train_dot(cfg, "moe") or jnp.einsum
     expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, h)         # [E, B, C, D]
-    gate = jnp.einsum("ebcd,edf->ebcf", expert_in, kern("gate"))
-    up = jnp.einsum("ebcd,edf->ebcf", expert_in, kern("up"))
-    expert_out = jnp.einsum(
-        "ebcf,efd->ebcd", jax.nn.silu(gate) * up, kern("down")
-    )
+    gate = dot("ebcd,edf->ebcf", expert_in, kern("gate"))
+    up = dot("ebcd,edf->ebcf", expert_in, kern("up"))
+    expert_out = dot("ebcf,efd->ebcd", jax.nn.silu(gate) * up, kern("down"))
     out = jnp.einsum("bsec,ebcd->bsd", combine, expert_out)
 
     # Load-balancing auxiliary loss (Switch Transformer eq. 4): fraction of
@@ -629,7 +641,17 @@ def _moe_mlp(h, layer_params, cfg: ModelConfig):
     return out, aux
 
 
-def _proj(h, kernel, lora_ab=None, lora_scale=1.0, bias=None):
+def _train_dot(cfg: ModelConfig, group: str):
+    """The injectable quantized-dot hook for one matmul group ("attn",
+    "mlp", "moe"): :func:`tpu_engine.quant_train.int8_einsum` when
+    ``cfg.quant_training == "int8"`` and ``group`` is targeted, else None
+    (call sites fall back to plain einsum via ``dot or jnp.einsum``)."""
+    if cfg.quant_training == "int8" and group in cfg.quant_train_targets:
+        return int8_einsum
+    return None
+
+
+def _proj(h, kernel, lora_ab=None, lora_scale=1.0, bias=None, dot=None):
     """Last-dim projection ``h @ W (+ b)``, with an optional rank-sized LoRA
     term ``scale·(h@A)@B`` — the activation-side formulation: only [.., r]
     intermediates and rank-sized cotangents, never a full ΔW.
@@ -639,7 +661,11 @@ def _proj(h, kernel, lora_ab=None, lora_scale=1.0, bias=None):
     (weight-only quantized serving): the per-output-channel scale is
     constant along the contraction, so it applies to the matmul OUTPUT —
     the int8→compute-dtype convert fuses into the dot's operand read and
-    the weight's HBM traffic stays int8-sized."""
+    the weight's HBM traffic stays int8-sized.
+
+    ``dot``: optional quantized-einsum hook (:func:`_train_dot`) for the
+    main matmul only — serving QuantWeights are already int8 and the
+    rank-sized LoRA terms are too small to be worth quantizing."""
     if isinstance(kernel, QuantWeight):
         out = jnp.einsum("bsi,io->bso", h, kernel.q.astype(h.dtype))
         # Scale in fp32 (one rounding, at the end) — rounding the scale
@@ -647,7 +673,7 @@ def _proj(h, kernel, lora_ab=None, lora_scale=1.0, bias=None):
         # mul+cast fuses into the matmul's output loop.
         out = (out.astype(jnp.float32) * kernel.scale).astype(h.dtype)
     else:
-        out = jnp.einsum("bsi,io->bso", h, kernel)
+        out = (dot or jnp.einsum)("bsi,io->bso", h, kernel)
     if bias is not None:
         out = out + bias.astype(out.dtype)
     if lora_ab is not None:
@@ -662,21 +688,24 @@ def _dense_mlp(h, layer_params, lora=None, lora_scale=1.0, *, cfg: ModelConfig):
     h: [B, S, D] (already normed) → [B, S, D]. ``cfg`` is REQUIRED — see
     :func:`embed_tokens`."""
     lora = lora or {}
+    dot = _train_dot(cfg, "mlp")
     if cfg.arch == "gpt2":
         h = jax.nn.gelu(
             _proj(h, layer_params["fc"]["kernel"], lora.get("fc"), lora_scale,
-                  bias=layer_params["fc"]["bias"]),
+                  bias=layer_params["fc"]["bias"], dot=dot),
             approximate=True)
         return _proj(h, layer_params["proj"]["kernel"], lora.get("proj"),
-                     lora_scale, bias=layer_params["proj"]["bias"])
-    gate = _proj(h, layer_params["gate"]["kernel"], lora.get("gate"), lora_scale)
-    up = _proj(h, layer_params["up"]["kernel"], lora.get("up"), lora_scale)
+                     lora_scale, bias=layer_params["proj"]["bias"], dot=dot)
+    gate = _proj(h, layer_params["gate"]["kernel"], lora.get("gate"), lora_scale,
+                 dot=dot)
+    up = _proj(h, layer_params["up"]["kernel"], lora.get("up"), lora_scale,
+               dot=dot)
     if cfg.arch == "gemma":
         act = jax.nn.gelu(gate, approximate=True)  # GeGLU
     else:
         act = jax.nn.silu(gate)  # SwiGLU
     return _proj(act * up, layer_params["down"]["kernel"],
-                 lora.get("down"), lora_scale)
+                 lora.get("down"), lora_scale, dot=dot)
 
 
 def _block(
@@ -700,13 +729,14 @@ def _block(
 
     gpt2 = cfg.arch == "gpt2"
     bias = (lambda name: layer_params[name]["bias"]) if gpt2 else (lambda name: None)
+    dot = _train_dot(cfg, "attn")
     h = _norm(x, layer_params["attn_norm"], cfg)
     q = _proj(h, layer_params["q"]["kernel"], lora.get("q"), lora_scale,
-              bias("q")).reshape(B, S, H, HD)
+              bias("q"), dot=dot).reshape(B, S, H, HD)
     k = _proj(h, layer_params["k"]["kernel"], lora.get("k"), lora_scale,
-              bias("k")).reshape(B, S, KV, HD)
+              bias("k"), dot=dot).reshape(B, S, KV, HD)
     v = _proj(h, layer_params["v"]["kernel"], lora.get("v"), lora_scale,
-              bias("v")).reshape(B, S, KV, HD)
+              bias("v"), dot=dot).reshape(B, S, KV, HD)
     if cfg.arch == "qwen":  # per-head qk-norm, before RoPE
         q = _rms_norm(q, layer_params["q_norm"]["scale"], cfg.norm_eps)
         k = _rms_norm(k, layer_params["k_norm"]["scale"], cfg.norm_eps)
@@ -718,13 +748,20 @@ def _block(
                       window=cfg.sliding_window)
     attn = tag(attn.reshape(B, S, H * HD), "attn_out")
     x = x + _proj(attn, layer_params["o"]["kernel"], lora.get("o"), lora_scale,
-                  bias("o"))
+                  bias("o"), dot=dot)
 
     h = _norm(x, layer_params["mlp_norm"], cfg)
     if cfg.is_moe:
         if cfg.moe_impl not in ("dense", "ragged"):  # trace-time, free
             raise ValueError(
                 f"moe_impl={cfg.moe_impl!r} unknown; use 'dense' or 'ragged'"
+            )
+        if (cfg.moe_impl == "ragged" and cfg.quant_training == "int8"
+                and "moe" in cfg.quant_train_targets):
+            raise ValueError(
+                "quant_training='int8' cannot quantize ragged MoE "
+                "(lax.ragged_dot takes no per-channel scales); use "
+                "moe_impl='dense' or drop 'moe' from quant_train_targets"
             )
         moe = _moe_mlp_ragged if cfg.moe_impl == "ragged" else _moe_mlp
         mlp_out, aux = moe(h, layer_params, cfg)

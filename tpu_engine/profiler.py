@@ -61,6 +61,16 @@ def mfu(
 
     Uses *model* FLOPs (6N + attention), not hardware FLOPs: remat recompute
     is deliberately not credited, matching the standard MFU definition.
+
+    Accounting basis under int8 quantized training (``quant_training=
+    'int8'``, tpu_engine/quant_train.py): the numerator stays MODEL FLOPs
+    and the denominator stays the chip's BF16 peak — quantization changes
+    neither the model nor this definition. What it changes is the
+    ACHIEVABLE roofline: int8×int8→int32 MXU throughput is up to 2× the
+    bf16 rate, so a quantized run can legitimately report >100%
+    "bf16-MFU" on matmul-bound configs. Compare quantized runs by
+    step time / tokens-per-sec, and read their MFU as a fraction of the
+    bf16 roofline, not of the hardware's int8 ceiling.
     """
     peak = peak_flops_per_chip(device)
     if peak is None or tokens_per_sec_per_chip <= 0:

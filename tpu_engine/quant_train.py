@@ -1,0 +1,205 @@
+"""AQT-style int8 quantized training for the matmul hot path.
+
+PR 1 (tpu_engine/comm_compress.py) quantized the *wire*; this module
+quantizes the *compute*. TPU MXUs execute int8×int8→int32 dots at up to
+2× the bf16 rate, so routing the heavy training einsums (QKV/O
+projections, MLP, MoE expert dots) through an int8 primitive raises the
+achievable roofline without touching the master weights — the approach
+of AQT / ZeRO-line quantized training (arXiv:2306.10209, 1910.02054):
+
+- **per-channel symmetric scaling over the contraction axes** of BOTH
+  operands: for each operand, absmax is taken over exactly the axes that
+  are summed away by the einsum (with ``keepdims``), so every output
+  element is the int32 dot of two int8 vectors rescaled by the product
+  of its row scale and its column scale — no cross-channel scale mixing;
+- **int32 accumulation**: the int8×int8 dot runs with
+  ``preferred_element_type=jnp.int32`` so XLA lowers it onto the MXU's
+  int8 path instead of upcasting to float;
+- **dequantize by the outer product of scales**: both scale tensors keep
+  size-1 contraction dims, so the *same einsum spec* applied to the
+  scales computes the outer product that undoes the scaling;
+- **straight-through ``custom_vjp``**: the backward pass recomputes the
+  two transpose matmuls (dlhs = g·rhsᵀ, drhs = lhsᵀ·g) through the same
+  int8 primitive, quantizing the backward operands with STOCHASTIC
+  rounding (the same ``floor(v + u)`` scheme as
+  ``comm_compress.blockwise_quantize``) so the quantization error is
+  zero-mean and does not bias the fp32/bf16 master-weight updates.
+
+Randomness is derived *from the data*: each stochastic quantize folds a
+fixed base key with a salt bitcast from the operand's float32 sum, so
+different layers (scanned — same trace!) and different steps (params and
+grads change) draw decorrelated noise while the whole step stays a pure
+function — restart-reproducible, nothing threaded through loss_fn.
+
+The forward quantization uses round-to-nearest (deterministic — eval
+logits don't jitter); only backward operands round stochastically.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Matmul groups a config can route through the quantized primitive.
+# "attn" = Q/K/V/O projections; "mlp" = dense-MLP matmuls (incl. the MoE
+# blocks' shared dense layers); "moe" = the per-expert batched einsums.
+QUANT_TARGET_GROUPS = ("attn", "mlp", "moe")
+
+# Fixed base key for data-dependent stochastic rounding (see module
+# docstring); an arbitrary constant, NOT a config seed — determinism
+# across restarts must not depend on config plumbing.
+_SR_BASE_KEY = 0x51AE7
+
+
+def _data_key(x: jax.Array) -> jax.Array:
+    """A PRNG key derived from ``x``'s contents: fold the fixed base key
+    with the bit pattern of the float32 sum. Distinct layers/steps see
+    distinct sums → decorrelated rounding noise; same data → same key."""
+    salt = jax.lax.bitcast_convert_type(
+        jnp.sum(x, dtype=jnp.float32), jnp.uint32
+    )
+    return jax.random.fold_in(jax.random.PRNGKey(_SR_BASE_KEY), salt)
+
+
+def channel_quantize(
+    x: jax.Array,
+    axes: tuple[int, ...],
+    stochastic: bool = False,
+    key: Optional[jax.Array] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Symmetric int8 quantization with one scale per channel.
+
+    ``axes`` are the contraction axes: absmax is reduced over them with
+    ``keepdims=True``, so ``scales`` broadcasts against ``x`` and keeps
+    full extent on every non-contraction dim (per-channel, not
+    per-tensor). Returns ``(codes int8, scales fp32 keepdims)`` with
+    ``x ≈ codes * scales``.
+
+    ``stochastic`` switches round-to-nearest to the unbiased rounding of
+    :func:`comm_compress.stochastic_round` (the shared helper), keyed
+    from the data itself (:func:`_data_key`); pass ``key`` explicitly to
+    draw independent roundings of the same data (tests).
+    """
+    from tpu_engine.comm_compress import stochastic_round
+
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=axes, keepdims=True)
+    scales = jnp.maximum(absmax, 1e-30) / 127.0
+    y = xf / scales
+    if stochastic or key is not None:
+        y = stochastic_round(y, _data_key(xf) if key is None else key)
+    else:
+        y = jnp.round(y)
+    codes = jnp.clip(y, -127.0, 127.0).astype(jnp.int8)
+    return codes, scales
+
+
+def _contraction_axes(spec: str) -> tuple[tuple[int, ...], tuple[int, ...]]:
+    """Per-operand contraction axes of a two-operand einsum ``spec``:
+    the positions of labels absent from the output subscript. Batch
+    labels (present in the output, e.g. ``e`` in the MoE expert dots)
+    correctly stay per-channel."""
+    operands, osub = spec.split("->")
+    lsub, rsub = operands.split(",")
+    lax_ = tuple(i for i, c in enumerate(lsub) if c not in osub)
+    rax = tuple(i for i, c in enumerate(rsub) if c not in osub)
+    return lax_, rax
+
+
+def _quantized_dot(
+    spec: str, lhs: jax.Array, rhs: jax.Array, stochastic: bool
+) -> jax.Array:
+    """One quantized einsum: int8 codes dot in int32, dequantize by the
+    scales' outer product (the same spec over the keepdims scale tensors
+    — contraction dims are size 1 there, so it IS the outer product)."""
+    laxes, raxes = _contraction_axes(spec)
+    ql, sl = channel_quantize(lhs, laxes, stochastic=stochastic)
+    qr, sr = channel_quantize(rhs, raxes, stochastic=stochastic)
+    acc = jnp.einsum(spec, ql, qr, preferred_element_type=jnp.int32)
+    scale = jnp.einsum(spec, sl, sr)
+    return acc.astype(jnp.float32) * scale
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0,))
+def int8_einsum(spec: str, lhs: jax.Array, rhs: jax.Array) -> jax.Array:
+    """Drop-in quantized replacement for ``jnp.einsum(spec, lhs, rhs)``.
+
+    Forward: round-to-nearest int8 channel quantization of both
+    operands, int32 MXU accumulation, fp32 dequantize, cast back to the
+    operands' promoted dtype. Backward (straight-through): the two
+    transpose matmuls run through the same primitive with stochastic
+    rounding; gradients flow to the full-precision inputs as if the
+    quantizer were identity.
+    """
+    out_dtype = jnp.promote_types(lhs.dtype, rhs.dtype)
+    return _quantized_dot(spec, lhs, rhs, stochastic=False).astype(out_dtype)
+
+
+def _fwd(spec, lhs, rhs):
+    return int8_einsum(spec, lhs, rhs), (lhs, rhs)
+
+
+def _transpose_specs(spec: str) -> tuple[str, str]:
+    """(dlhs_spec, drhs_spec) for forward ``spec``: with forward
+    ``l,r->o``, dlhs is ``o,r->l`` and drhs is ``l,o->r`` (einsum
+    transposes — contraction/batch structure follows from the labels)."""
+    operands, osub = spec.split("->")
+    lsub, rsub = operands.split(",")
+    return f"{osub},{rsub}->{lsub}", f"{lsub},{osub}->{rsub}"
+
+
+def _bwd(spec, res, g):
+    lhs, rhs = res
+    dlhs_spec, drhs_spec = _transpose_specs(spec)
+    dlhs = _quantized_dot(dlhs_spec, g, rhs, stochastic=True)
+    drhs = _quantized_dot(drhs_spec, lhs, g, stochastic=True)
+    return dlhs.astype(lhs.dtype), drhs.astype(rhs.dtype)
+
+
+int8_einsum.defvjp(_fwd, _bwd)
+
+
+def make_dot(enabled: bool = True):
+    """The injectable dot hook: ``dot(spec, lhs, rhs)``. With
+    ``enabled=False`` returns None — callers fall back to plain einsum
+    (keeps call sites branch-free: ``dot or jnp.einsum``)."""
+    if not enabled:
+        return None
+    return int8_einsum
+
+
+# ---------------------------------------------------------------------------
+# Config surface: enabled() + plan (launcher/HTTP report), mirroring
+# comm.compression_plan for the PR-1 wire compression.
+# ---------------------------------------------------------------------------
+
+
+def enabled(cfg) -> bool:
+    """True when MXU int8 quantized training is on for ``cfg``."""
+    return getattr(cfg, "quant_training", "none") != "none"
+
+
+def training_plan(cfg) -> dict[str, Any]:
+    """The quantized-training surface of ``cfg`` as a plan/launch-report
+    dict: mode, which matmul groups ride the int8 path, and the
+    accounting basis (model FLOPs are unchanged — int8 raises the
+    achievable roofline, it does not shrink the numerator)."""
+    plan: dict[str, Any] = {
+        "enabled": enabled(cfg),
+        "mode": getattr(cfg, "quant_training", "none"),
+        "targets": list(getattr(cfg, "quant_train_targets", ())),
+    }
+    if plan["enabled"]:
+        plan["forward_rounding"] = "nearest"
+        plan["backward_rounding"] = "stochastic (unbiased)"
+        plan["accumulation"] = "int32 (preferred_element_type)"
+        plan["mfu_note"] = (
+            "MFU accounting basis unchanged (model FLOPs at the bf16 "
+            "peak); int8 MXU throughput is up to 2x bf16, so reported "
+            "MFU may exceed the bf16-roofline fraction"
+        )
+    return plan

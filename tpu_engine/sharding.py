@@ -331,6 +331,20 @@ class TPUTrainConfig(BaseModel):
     # fp32 scale overhead is 4/block_size bytes per element.
     comm_quant_block_size: int = Field(default=256, ge=8)
 
+    # AQT-style MXU int8 quantized training (tpu_engine/quant_train.py):
+    # "int8" routes the targeted training matmuls (Q/K/V/O projections,
+    # dense MLP, MoE expert einsums) through a channel-scaled int8 dot
+    # with int32 accumulation and stochastically-rounded backward
+    # operands — master weights/optimizer state stay full precision.
+    # Orthogonal to, and composable with, the comm_quant_* wire
+    # compression above (that quantizes collectives; this quantizes
+    # compute). See _validate_quant_training for the rejected combos.
+    quant_training: Literal["none", "int8"] = "none"
+    # Which matmul groups ride the quantized dot: "attn" (Q/K/V/O),
+    # "mlp" (dense MLP), "moe" (per-expert einsums). Router, dispatch/
+    # combine, embed and unembed always stay full precision.
+    quant_train_targets: tuple[str, ...] = ("attn", "mlp", "moe")
+
     # Attention implementation: "auto" = flash kernel on TPU, XLA elsewhere;
     # a >1 sequence mesh axis switches to ring attention unless "ulysses"
     # (all-to-all sequence parallelism) is requested explicitly.
@@ -567,6 +581,53 @@ class TPUTrainConfig(BaseModel):
                 f"{self.attention_impl!r} is unsupported (kernel attention "
                 "is a shard_map region and cannot nest inside the "
                 "compression region) — use 'auto' or 'xla'"
+            )
+        return self
+
+    @model_validator(mode="after")
+    def _validate_quant_training(self) -> "TPUTrainConfig":
+        """MXU int8 quantized training interaction matrix.
+
+        COMPOSES with the ZeRO++ comm_quant_* flags (they quantize the
+        *wire*, this quantizes the *compute*; the int8 einsum is plain
+        jnp inside the compression region's loss_fn) and with optimizer/
+        param offload and the disk tier (orthogonal to where state
+        lives). REJECTED combos fail here with the reason:
+        """
+        from tpu_engine.quant_train import QUANT_TARGET_GROUPS
+
+        bad = set(self.quant_train_targets) - set(QUANT_TARGET_GROUPS)
+        if bad:
+            raise ValueError(
+                f"unknown quant_train_targets {sorted(bad)}; valid groups: "
+                f"{list(QUANT_TARGET_GROUPS)}"
+            )
+        if self.quant_training == "none":
+            return self
+        if not self.quant_train_targets:
+            raise ValueError(
+                "quant_training='int8' with empty quant_train_targets is a "
+                "no-op; set targets or quant_training='none'"
+            )
+        if self.lora_rank is not None:
+            raise ValueError(
+                "quant_training='int8' with LoRA is unsupported: the "
+                "rank-sized adapter matmuls bypass the quantized hook and "
+                "stochastic-rounding noise on the frozen base would leak "
+                "into merge-time semantics — fine-tune in bf16"
+            )
+        if self.pipeline_schedule == "1f1b":
+            raise ValueError(
+                "quant_training='int8' with pipeline_schedule='1f1b' is "
+                "unsupported (the manual per-stage vjp bypasses the "
+                "quantized primitive's custom backward); use 'gpipe' or "
+                "'auto' (auto falls back to gpipe under quantization)"
+            )
+        if self.moe_impl == "ragged" and "moe" in self.quant_train_targets:
+            raise ValueError(
+                "quant_training='int8' with moe_impl='ragged' is "
+                "unsupported (lax.ragged_dot takes no per-channel scales); "
+                "use moe_impl='dense' or drop 'moe' from quant_train_targets"
             )
         return self
 

@@ -393,6 +393,32 @@ def build_train_program(
             )
         if model_cfg.moe_impl != cfg.moe_impl:
             model_cfg = model_cfg.with_(moe_impl=cfg.moe_impl)
+    # MXU int8 quantized training (tpu_engine/quant_train.py): resolve the
+    # config knobs onto the model config exactly like attention_impl —
+    # every parallelism layout's loss path reads model_cfg, so the
+    # quantized-dot hook reaches plain GSPMD, comm-compressed shard_map,
+    # gpipe pipeline, disk tier and offload builds alike.
+    if (
+        model_cfg.quant_training != cfg.quant_training
+        or model_cfg.quant_train_targets != tuple(cfg.quant_train_targets)
+    ):
+        model_cfg = model_cfg.with_(
+            quant_training=cfg.quant_training,
+            quant_train_targets=tuple(cfg.quant_train_targets),
+        )
+    if (
+        model_cfg.quant_training == "int8"
+        and model_cfg.is_moe
+        and model_cfg.moe_impl == "ragged"
+        and "moe" in model_cfg.quant_train_targets
+    ):
+        # Config validation sees cfg.moe_impl=None when the MODEL preset
+        # carries ragged — re-check on the resolved model config.
+        raise ValueError(
+            "quant_training='int8' cannot quantize ragged MoE "
+            "(lax.ragged_dot takes no per-channel scales); use "
+            "moe_impl='dense' or drop 'moe' from quant_train_targets"
+        )
     # Reject window × sequence-parallel here, at build time, rather than
     # letting the job fail at first-step trace deep inside _attention.
     if model_cfg.sliding_window and impl in ("ring", "ulysses"):
@@ -468,9 +494,16 @@ def build_train_program(
     # manual-vjp schedule does not support fall back to gpipe.
     pipe_schedule = cfg.pipeline_schedule
     if pipe_schedule == "auto":
-        unsupported_1f1b = bool(cfg.loss_chunk_size) or (
-            cfg.grad_allreduce_dtype is not None
-            and cfg.grad_allreduce_dtype != Precision.FP32
+        # quant_training: the manual 1f1b per-stage vjp would bypass
+        # int8_einsum's custom backward — auto degrades to gpipe, whose
+        # plain autodiff differentiates through the custom_vjp.
+        unsupported_1f1b = (
+            bool(cfg.loss_chunk_size)
+            or cfg.quant_training != "none"
+            or (
+                cfg.grad_allreduce_dtype is not None
+                and cfg.grad_allreduce_dtype != Precision.FP32
+            )
         )
         pipe_schedule = (
             "1f1b"
