@@ -19,7 +19,16 @@ from aiohttp import web
 
 from backend import openapi
 from backend.http import cors_middleware, error_middleware, json_response
-from backend.routers import metrics, monitoring, profiling, serving, topology, tpu, training
+from backend.routers import (
+    metrics,
+    monitoring,
+    profiling,
+    scheduler,
+    serving,
+    topology,
+    tpu,
+    training,
+)
 
 VERSION = "0.1.0"
 _started_at = time.time()
@@ -53,6 +62,9 @@ async def root(request: web.Request) -> web.Response:
                 "Orbax checkpointing with stable-pointer rollback, auto-resume, "
                 "and elastic cross-mesh restore",
                 "preemption watcher with emergency checkpoint",
+                "fleet scheduler: priority+FIFO queue, HBM-aware gang "
+                "admission against healthy chips, checkpoint-preempt-"
+                "requeue, backfill, per-submitter quotas, drain",
                 "real ICI topology introspection",
                 "jax.profiler trace capture, per-step wall-clock breakdown, "
                 "and structured JSONL metrics logs",
@@ -66,6 +78,7 @@ async def root(request: web.Request) -> web.Response:
             "endpoints": {
                 "tpu": "/api/v1/tpu",
                 "training": "/api/v1/training",
+                "scheduler": "/api/v1/scheduler",
                 "monitoring": "/api/v1/monitoring",
                 "topology": "/api/v1/topology",
                 "profile": "/api/v1/profile",
@@ -100,6 +113,7 @@ def create_app() -> web.Application:
     app = web.Application(middlewares=[cors_middleware, error_middleware])
     tpu.setup(app)
     training.setup(app)
+    scheduler.setup(app)
     monitoring.setup(app)
     topology.setup(app)
     profiling.setup(app)

@@ -1,0 +1,128 @@
+"""Fleet scheduler routes: submit, queue state, submission lifecycle,
+cancel, drain.
+
+The two-phase surface over :class:`tpu_engine.scheduler.FleetScheduler` —
+``/training/launch`` stays the thin direct-launch wrapper (priority=normal,
+409 + queue position when it cannot be admitted now); this router is the
+full queue view: priority submissions, per-submitter quotas visible through
+429s, preempt/requeue history per submission, and drain for maintenance.
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+from aiohttp import web
+from pydantic import Field
+
+from backend import state
+from backend.http import ApiError, json_response, parse_body
+from backend.openapi import body
+from backend.routers.training import TrainingLaunchRequest, _to_config
+from tpu_engine.hbm_estimate import estimate_job_hbm
+from tpu_engine.scheduler import JobPriority, QuotaExceeded
+
+
+class SchedulerSubmitRequest(TrainingLaunchRequest):
+    """A training launch plus queue semantics. ``dry_run`` here means
+    "estimate only": validate, project the HBM footprint, and return the
+    admission picture without enqueueing."""
+
+    priority: Literal["low", "normal", "high", "critical"] = "normal"
+    submitter: str = Field(default="anonymous", min_length=1, max_length=128)
+    dry_run: bool = False  # submissions default to real (launch defaults dry)
+
+
+@body(SchedulerSubmitRequest)
+async def submit(request: web.Request) -> web.Response:
+    req = await parse_body(request, SchedulerSubmitRequest)
+    config = _to_config(req)
+    priority = JobPriority[req.priority.upper()]
+    if req.dry_run:
+        est = estimate_job_hbm(config)
+        return json_response(
+            {
+                "dry_run": True,
+                "priority": req.priority,
+                "hbm_estimate": est.model_dump() if est else None,
+                "stats": state.scheduler.stats(),
+            }
+        )
+    job_kwargs = {}
+    if req.max_steps is not None:
+        job_kwargs["max_steps"] = req.max_steps
+    if req.watch_preemption:
+        job_kwargs["watch_preemption"] = True
+    try:
+        sub = state.scheduler.submit(
+            config,
+            priority=priority,
+            submitter=req.submitter,
+            job_kwargs=job_kwargs,
+        )
+    except QuotaExceeded as e:
+        raise ApiError(429, str(e))
+    state.scheduler.poll()
+    return json_response(
+        {
+            **sub.describe(),
+            "queue_position": state.scheduler.queue_position(sub.submission_id),
+        },
+        status=202,
+    )
+
+
+async def queue(request: web.Request) -> web.Response:
+    """Full queue state: queued (admission order), running, finished,
+    counters, and the fleet HBM view the admission gate sees."""
+    qs = state.scheduler.queue_state()
+    qs["fleet_hbm"] = state.scheduler.fleet_hbm_utilization()
+    return json_response(qs)
+
+
+async def get_submission(request: web.Request) -> web.Response:
+    sub_id = request.match_info["submission_id"]
+    sub = state.scheduler.get(sub_id)
+    if sub is None:
+        raise ApiError(404, f"submission '{sub_id}' not found")
+    return json_response(
+        {
+            **sub.describe(),
+            "queue_position": state.scheduler.queue_position(sub_id),
+        }
+    )
+
+
+async def cancel_submission(request: web.Request) -> web.Response:
+    sub_id = request.match_info["submission_id"]
+    sub = state.scheduler.get(sub_id)
+    if sub is None:
+        raise ApiError(404, f"submission '{sub_id}' not found")
+    if not state.scheduler.cancel(sub_id):
+        raise ApiError(
+            409, f"submission '{sub_id}' is already {sub.state.value}"
+        )
+    return json_response({"submission_id": sub_id, "state": sub.state.value})
+
+
+async def drain(request: web.Request) -> web.Response:
+    """Stop admitting (running jobs continue; submissions keep queuing) —
+    the maintenance mode a rolling fleet update needs."""
+    state.scheduler.drain()
+    return json_response({"draining": True, "stats": state.scheduler.stats()})
+
+
+async def resume(request: web.Request) -> web.Response:
+    state.scheduler.resume_admission()
+    return json_response({"draining": False, "stats": state.scheduler.stats()})
+
+
+def setup(app: web.Application, prefix: str = "/api/v1/scheduler") -> None:
+    app.router.add_post(f"{prefix}/submit", submit)
+    app.router.add_get(f"{prefix}/queue", queue)
+    app.router.add_get(f"{prefix}/submissions/{{submission_id}}", get_submission)
+    app.router.add_post(
+        f"{prefix}/submissions/{{submission_id}}/cancel", cancel_submission
+    )
+    app.router.add_post(f"{prefix}/drain", drain)
+    app.router.add_post(f"{prefix}/resume", resume)

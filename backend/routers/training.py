@@ -227,16 +227,24 @@ def _to_config(req: TrainingLaunchRequest) -> TPUTrainConfig:
 @body(TrainingLaunchRequest)
 async def launch_training(request: web.Request) -> web.Response:
     """Launch (or dry-run) a supervised in-process training job
-    (reference ``launch_training``, ``training.py:56-80``)."""
+    (reference ``launch_training``, ``training.py:56-80``).
+
+    Direct launch is a thin wrapper over scheduler submit at normal
+    priority: a launch the fleet cannot admit right now comes back as a
+    structured 409 carrying ``submission_id`` + ``queue_position`` — the
+    scheduler keeps working on it (poll ``/api/v1/scheduler``), it is NOT
+    refused."""
     req = await parse_body(request, TrainingLaunchRequest)
     config = _to_config(req)
     result = state.launcher.launch(
         config,
         dry_run=req.dry_run,
         max_steps=req.max_steps,
-        watch_preemption=req.watch_preemption,
+        # True opts into the real GCE metadata poll; the default keeps the
+        # scheduler's preempt seam (still a watcher — still preemptible).
+        watch_preemption=True if req.watch_preemption else None,
     )
-    return json_response(result)
+    return json_response(result, status=409 if result.status == "queued" else 200)
 
 
 @body(PresetLaunchRequest)

@@ -20,6 +20,11 @@ from tpu_engine.tpu_manager import TPUManager
 
 manager = TPUManager()
 launcher = TPULauncher()
+# One admission authority: the launcher's FleetScheduler, with the live
+# fleet as its placement view (on CPU chips report no HBM, so admission
+# degrades to capacity-only there — never a refusal).
+scheduler = launcher.scheduler
+scheduler.fleet_fn = manager.get_fleet_status
 
 _monitors: dict[str, LossSpikeMonitor] = {}
 _monitors_lock = threading.Lock()

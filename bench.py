@@ -171,6 +171,9 @@ def main() -> None:
     quant_line = _quant_train_metric()
     if quant_line is not None:
         print(json.dumps(quant_line))
+    sched_line = _scheduler_metric()
+    if sched_line is not None:
+        print(json.dumps(sched_line))
 
 
 def _comm_compress_metric(n_dev: int) -> dict | None:
@@ -283,6 +286,29 @@ def _quant_train_metric() -> dict | None:
             "bf16_step_time_ms": round(base["dt_ms"], 2),
             "int8_step_time_ms": round(q["dt_ms"], 2),
             "backend": jax.default_backend(),
+        }
+    except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
+        return None
+
+
+def _scheduler_metric() -> dict | None:
+    """Fourth JSON line: fleet-scheduler goodput on the 21-job mixed-priority
+    mock-fleet trace (benchmarks/scheduler_sim.py phase A — FakeJobs, no
+    device compute) vs the reference's serial FIFO launcher (= 1.0).
+    Never fails the bench: any error degrades to None."""
+    try:
+        from benchmarks.scheduler_sim import run_trace
+
+        trace = run_trace()
+        return {
+            "metric": "scheduler_goodput_vs_serial_fifo",
+            "value": trace["goodput_work_s_per_wall_s"],
+            "unit": "work-seconds per wall-second (serial FIFO = 1.0)",
+            "speedup_vs_serial": trace["speedup_vs_serial"],
+            "mean_wait_s": trace["mean_wait_s"],
+            "serial_mean_wait_s": trace["serial_mean_wait_s"],
+            "preemptions": trace["preemptions"],
+            "zero_lost_work": trace["zero_lost_work"],
         }
     except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
         return None

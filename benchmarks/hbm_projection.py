@@ -116,28 +116,13 @@ def run_aot() -> None:
 
 def run_table() -> None:
     """Pure eval_shape accounting: per-device state bytes by stage/offload."""
-    from tpu_engine.mesh_runtime import MeshConfig, MeshRuntime
-    from tpu_engine.sharding import ShardingStage, TPUTrainConfig
-    from tpu_engine.train import build_train_program
+    from tpu_engine.sharding import ShardingStage
+
+    # The estimator lives in tpu_engine/hbm_estimate.py now (the fleet
+    # scheduler's admission gate uses the analytic plane of the same module).
+    from tpu_engine.hbm_estimate import per_device_bytes
 
     gib = 2**30
-
-    def per_device_bytes(shape_tree, sharding_tree, host: bool):
-        """Per-device bytes of one state subtree, exact via shard_shape;
-        ``host`` selects the pinned-host-resident or device-resident part."""
-        total = 0
-        leaves = jax.tree.leaves(shape_tree)
-        shs = jax.tree.leaves(
-            sharding_tree, is_leaf=lambda x: hasattr(x, "memory_kind"))
-        for leaf, sh in zip(leaves, shs):
-            if (getattr(sh, "memory_kind", None) == "pinned_host") != host:
-                continue
-            shard_shape = sh.shard_shape(leaf.shape)
-            n = leaf.dtype.itemsize
-            for d in shard_shape:
-                n *= d
-            total += n
-        return total
 
     from jax.experimental import topologies
 

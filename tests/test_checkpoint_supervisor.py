@@ -15,6 +15,7 @@ import pytest
 
 from tpu_engine.checkpoint import TrainCheckpointManager, abstract_state_like
 from tpu_engine.mesh_runtime import MeshConfig
+from tpu_engine.scheduler import FleetScheduler, JobPriority, SubmissionState
 from tpu_engine.sharding import Precision, ShardingStage, TPUTrainConfig
 from tpu_engine.supervisor import JobStatus, TrainingJob
 from tpu_engine.train import build_train_program
@@ -387,6 +388,131 @@ def test_elastic_min_enforced_even_when_mesh_would_fit(tmp_path):
     job.join(timeout=120)
     assert job.status == JobStatus.FAILED
     assert "no admissible mesh" in (job.error or "")
+
+
+def _wait_for(pred, timeout=300.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def test_scheduler_preempt_requeue_auto_resume_zero_lost_steps(tmp_path):
+    """The full fleet-scheduler round trip on REAL jobs: a HIGH submission
+    evicts a running LOW job through the emergency-save seam; the LOW
+    submission requeues and auto-resumes from exactly the step the
+    emergency checkpoint captured — zero lost steps."""
+    cfg_low = tiny_config(
+        tmp_path / "low", total_steps=30,
+        checkpoint_interval_steps=1000,  # ONLY the emergency save persists
+    )
+    cfg_high = tiny_config(
+        tmp_path / "high", total_steps=4, checkpoint_interval_steps=1000
+    )
+    sched = FleetScheduler(max_concurrent_jobs=1, poll_interval_s=0.05)
+    holder = {}
+
+    def slow_data(step):
+        # ~20 ms/step: keeps the LOW run alive long enough for the
+        # eviction to land mid-run (gpt-tiny steps are ~2 ms once warm).
+        time.sleep(0.02)
+        return holder["low"].job.program.synthetic_batch(0)
+
+    try:
+        low = sched.submit(
+            cfg_low, priority=JobPriority.LOW,
+            job_kwargs={"data_fn": slow_data},
+        )
+        holder["low"] = low
+        assert _wait_for(
+            lambda: low.job is not None and low.job.current_step >= 3
+        ), "LOW job never got going"
+        attempt1 = low.job
+
+        high = sched.submit(cfg_high, priority=JobPriority.HIGH)
+        high = sched.wait(high.submission_id, timeout=300)
+        assert high.state == SubmissionState.COMPLETED, high.describe()
+
+        low = sched.wait(low.submission_id, timeout=300)
+        assert low.state == SubmissionState.COMPLETED, low.describe()
+        assert low.preemptions == 1 and low.attempts == 2
+        # Attempt 1 died PREEMPTED after its synchronous force-save...
+        assert attempt1.status == JobStatus.PREEMPTED
+        saved = attempt1.current_step
+        assert saved >= 3
+        # ...and attempt 2 resumed from exactly that step: zero lost work.
+        assert low.job.resumed_from_step == saved
+        assert low.job.current_step == 30
+        assert sched.preemptions_total == 1 and sched.requeues_total == 1
+    finally:
+        sched.shutdown()
+
+
+def test_corrupt_emergency_checkpoint_quarantined_on_readmission(tmp_path):
+    """A preempted submission whose emergency checkpoint was corrupted on
+    disk must not wedge the queue on re-admission: restore quarantines the
+    bad step and falls back to the last good interval save."""
+    ck = tmp_path / "low"
+    cfg_low = tiny_config(ck, total_steps=40, checkpoint_interval_steps=5)
+    cfg_high = tiny_config(
+        tmp_path / "high", total_steps=4, checkpoint_interval_steps=1000
+    )
+    sched = FleetScheduler(max_concurrent_jobs=1, poll_interval_s=0.05)
+    holder = {}
+
+    def slow_data(step):
+        time.sleep(0.02)
+        return holder["low"].job.program.synthetic_batch(0)
+
+    try:
+        low = sched.submit(
+            cfg_low, priority=JobPriority.LOW,
+            job_kwargs={"data_fn": slow_data},
+        )
+        holder["low"] = low
+        # Let interval saves (5, 10) land before forcing the eviction.
+        assert _wait_for(
+            lambda: low.job is not None and low.job.current_step >= 12
+        ), "LOW job never reached step 12"
+        attempt1 = low.job
+
+        sched.submit(cfg_high, priority=JobPriority.HIGH)
+        assert _wait_for(
+            lambda: low.state in (
+                SubmissionState.PREEMPTING, SubmissionState.QUEUED
+            )
+        )
+        # Freeze admission so the requeued LOW cannot restart before the
+        # corruption is in place.
+        sched.drain()
+        assert _wait_for(lambda: low.state == SubmissionState.QUEUED)
+        saved = attempt1.current_step  # the emergency-save step
+
+        # Corrupt the newest checkpoint on disk IN PLACE: garbage every file
+        # but keep the item-directory layout. (Deleting whole item dirs would
+        # leave the step with a different item set than its siblings, and the
+        # fresh CheckpointManager of attempt 2 would then demand Composite
+        # args for every later interval save.)
+        steps = sorted(int(p.name) for p in ck.iterdir() if p.name.isdigit())
+        assert steps and steps[-1] == saved
+        newest = ck / str(saved)
+        for f in newest.glob("**/*"):
+            if f.is_file():
+                f.write_bytes(b"\x00corrupt\x00")
+
+        sched.resume_admission()
+        low = sched.wait(low.submission_id, timeout=300)
+        assert low.state == SubmissionState.COMPLETED, low.describe()
+        # Restore quarantined the corrupt step and fell back to a good
+        # interval save — strictly before the emergency save.
+        assert low.job.resumed_from_step is not None
+        assert low.job.resumed_from_step < saved
+        assert low.job.resumed_from_step % 5 == 0
+        assert low.job.current_step == 40  # still ran to completion
+    finally:
+        sched.shutdown()
 
 
 def test_elastic_max_caps_to_device_subset(tmp_path):

@@ -75,7 +75,7 @@ def test_presets_exposed():
     assert {"125m", "7b", "13b", "70b"} <= set(p)
 
 
-def test_concurrent_job_cap():
+def test_concurrent_job_cap_queues_instead_of_refusing():
     import time
 
     from tpu_engine import TPULauncher, TPUTrainConfig
@@ -86,7 +86,7 @@ def test_concurrent_job_cap():
         micro_batch_size=1, seq_len=32, precision="fp32", total_steps=200,
         activation_checkpointing=False, warmup_steps=1,
     )
-    launcher = TPULauncher()  # default cap: 1
+    launcher = TPULauncher()  # default cap: 1 — enforced by the scheduler
     first = launcher.launch(cfg, dry_run=False, block=False)
     assert first.status == "launched"
     job = launcher.get_job(first.job_id)
@@ -97,9 +97,11 @@ def test_concurrent_job_cap():
     ):
         time.sleep(0.2)
     assert job.status.value == "running", job.describe()
+    # Over-cap launch queues with a position — not a bare refusal.
     second = launcher.launch(cfg, dry_run=False, block=False)
-    assert second.status == "failed"
-    assert "already running" in second.error
+    assert second.status == "queued"
+    assert second.queue_position == 1
+    assert second.submission_id is not None
     # Dry runs are never blocked by the cap.
     assert launcher.launch(cfg, dry_run=True).status == "dry_run"
     # A running job cannot be deleted from the registry.
@@ -107,9 +109,13 @@ def test_concurrent_job_cap():
 
     with pytest.raises(ValueError, match="stop it"):
         launcher.delete_job(first.job_id)
+    # Cancel the queued submission by its job_id (not admitted → no thread).
+    assert launcher.stop_job(second.job_id)
+    assert launcher.scheduler.get(second.submission_id).state.value == "cancelled"
     job.stop()
     job.join(timeout=120)
-    # Capacity freed → a new launch succeeds.
+    # Capacity freed → a new launch is admitted immediately.
     third = launcher.launch(cfg, dry_run=False, max_steps=1, block=True)
     assert third.status == "launched"
     assert launcher.get_job(third.job_id).status.value == "completed"
+    launcher.scheduler.shutdown()

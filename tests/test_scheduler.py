@@ -1,0 +1,452 @@
+"""Fleet scheduler: queue order, HBM-aware admission, preempt-requeue.
+
+Fast tier: jobs are thread-backed stubs (no JAX compute) driven through the
+real :class:`~tpu_engine.scheduler.FleetScheduler` state machine; the real
+end-to-end checkpoint round trip lives in ``test_checkpoint_supervisor.py``
+(slow tier) and ``benchmarks/scheduler_sim.py``.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tpu_engine.hbm_estimate import (
+    HBMEstimate,
+    estimate_job_hbm,
+    gang_size,
+)
+from tpu_engine.mesh_runtime import MeshConfig
+from tpu_engine.scheduler import (
+    FleetScheduler,
+    JobPriority,
+    QuotaExceeded,
+    SubmissionState,
+)
+from tpu_engine.sharding import OffloadDevice, ShardingStage, TPUTrainConfig
+from tpu_engine.supervisor import JobStatus
+from tpu_engine.tpu_manager import TPUManager
+
+
+def cfg(**kw):
+    base = dict(
+        model_name="gpt-tiny",
+        mesh=MeshConfig(data=1, fsdp=2),
+        micro_batch_size=1,
+        seq_len=32,
+        precision="fp32",
+        total_steps=5,
+        activation_checkpointing=False,
+        checkpoint_dir="/tmp/sched_test",  # preemptibility flag only
+    )
+    base.update(kw)
+    return TPUTrainConfig(**base)
+
+
+def wait_until(pred, timeout=5.0, interval=0.01):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class StubWatcher:
+    def __init__(self):
+        self.fired = threading.Event()
+
+    def simulate_interruption(self):
+        self.fired.set()
+
+
+class StubJob:
+    """Thread-backed TrainingJob stand-in: runs until the test calls
+    ``finish()`` (or the scheduler stops/preempts it)."""
+
+    def __init__(self, sub):
+        self.job_id = sub.job_id
+        self.config = sub.config
+        self.status = JobStatus.PENDING
+        self.error = None
+        self.current_step = 0
+        self.watcher = StubWatcher()
+        self._stop = threading.Event()
+        self._done = threading.Event()
+        self._final = JobStatus.COMPLETED
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    @property
+    def is_alive(self):
+        return self._thread.is_alive()
+
+    def start(self):
+        self._thread.start()
+
+    def join(self, timeout=None):
+        self._thread.join(timeout)
+
+    def describe(self):
+        return {"job_id": self.job_id, "status": self.status.value}
+
+    def finish(self, status=JobStatus.COMPLETED):
+        self._final = status
+        self._done.set()
+
+    def _run(self):
+        self.status = JobStatus.RUNNING
+        while not self._done.is_set():
+            if self._stop.is_set():
+                self.status = JobStatus.STOPPED
+                return
+            if self.watcher.fired.is_set():
+                self.status = JobStatus.PREEMPTED  # the "emergency save"
+                return
+            self._done.wait(0.005)
+        self.status = self._final
+
+
+@pytest.fixture
+def sched_factory():
+    created = []
+
+    def make(**kw):
+        jobs = []
+
+        def factory(sub):
+            job = StubJob(sub)
+            jobs.append(job)
+            return job
+
+        kw.setdefault("job_factory", factory)
+        kw.setdefault("poll_interval_s", 0.01)
+        s = FleetScheduler(**kw)
+        s._stub_jobs = jobs
+        created.append(s)
+        return s
+
+    yield make
+    for s in created:
+        for j in getattr(s, "_stub_jobs", []):
+            j.finish()
+        s.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# hbm_estimate
+# ---------------------------------------------------------------------------
+
+
+def test_gang_size_explicit_and_elastic():
+    assert gang_size(cfg(mesh=MeshConfig(data=2, fsdp=4))) == 8
+    elastic = cfg(mesh=MeshConfig(data=-1, fsdp=2))
+    assert gang_size(elastic) == 2  # no hint → smallest legal gang
+    assert gang_size(elastic, available=7) == 6  # largest multiple of fsdp
+    assert gang_size(elastic, available=1) == 2  # below one block → one block
+
+
+def test_estimate_known_model_breakdown():
+    est = estimate_job_hbm(cfg(mesh=MeshConfig(data=2, fsdp=4)))
+    assert est is not None and est.gang_devices == 8
+    parts = (
+        est.params_gib + est.grads_gib + est.opt_gib + est.working_gib
+        + est.activations_gib + est.logits_gib
+    )
+    assert est.device_total_gib == pytest.approx(parts, abs=1e-3)
+    assert est.device_total_gib > 0 and est.host_gib == 0
+
+
+def test_estimate_unknown_model_is_none():
+    assert estimate_job_hbm(cfg(model_name="nope-9b")) is None
+
+
+def test_estimate_sharding_shrinks_params():
+    full = estimate_job_hbm(
+        cfg(mesh=MeshConfig(data=1, fsdp=4),
+            sharding_stage=ShardingStage.FULL_PARTITIONING)
+    )
+    rep = estimate_job_hbm(
+        cfg(mesh=MeshConfig(data=4, fsdp=1),
+            sharding_stage=ShardingStage.DISABLED)
+    )
+    assert full.params_gib < rep.params_gib
+    assert full.grads_gib < rep.grads_gib
+
+
+def test_estimate_offload_moves_state_to_host():
+    on_dev = estimate_job_hbm(cfg())
+    off = estimate_job_hbm(cfg(optimizer_offload=OffloadDevice.HOST))
+    assert off.opt_gib == 0 and off.host_gib > 0
+    assert off.device_total_gib < on_dev.device_total_gib
+    assert any("offloaded" in n for n in off.notes)
+
+
+# ---------------------------------------------------------------------------
+# queue order / capacity
+# ---------------------------------------------------------------------------
+
+
+def test_priority_then_fifo_order(sched_factory):
+    s = sched_factory(max_concurrent_jobs=0)  # nothing admits: pure queue
+    low = s.submit(cfg(), priority=JobPriority.LOW)
+    norm1 = s.submit(cfg(), priority=JobPriority.NORMAL)
+    high = s.submit(cfg(), priority=JobPriority.HIGH)
+    norm2 = s.submit(cfg(), priority=JobPriority.NORMAL)
+    crit = s.submit(cfg(), priority=JobPriority.CRITICAL)
+    order = [q["submission_id"] for q in s.queue_state()["queued"]]
+    assert order == [
+        crit.submission_id, high.submission_id,
+        norm1.submission_id, norm2.submission_id, low.submission_id,
+    ]
+    assert s.queue_position(crit.submission_id) == 1
+    assert s.queue_position(low.submission_id) == 5
+
+
+def test_capacity_admission_and_stats(sched_factory):
+    s = sched_factory(max_concurrent_jobs=2)
+    subs = [s.submit(cfg()) for _ in range(3)]
+    assert wait_until(lambda: len(s._stub_jobs) == 2)
+    s.poll()
+    assert subs[2].state == SubmissionState.QUEUED
+    assert s.queue_position(subs[2].submission_id) == 1
+    assert subs[2].last_skip_reason == "at max_concurrent_jobs capacity"
+
+    s._stub_jobs[0].finish()
+    assert wait_until(lambda: subs[2].state == SubmissionState.RUNNING)
+    for j in s._stub_jobs:
+        j.finish()
+    assert wait_until(
+        lambda: all(sub.state == SubmissionState.COMPLETED for sub in subs)
+    )
+    st = s.stats()
+    assert st["submitted_total"] == 3 and st["admitted_total"] == 3
+    assert st["completed_total"] == 3 and st["queue_depth"] == 0
+    assert all(sub.wait_s is not None for sub in subs)
+
+
+# ---------------------------------------------------------------------------
+# HBM-aware gang admission against the (mock) fleet
+# ---------------------------------------------------------------------------
+
+
+def test_gang_larger_than_healthy_fleet_never_admits(sched_factory):
+    # Mock fleet: 8 chips, chip 5 hot (88% HBM, 97% duty) → 7 healthy.
+    s = sched_factory(max_concurrent_jobs=4, fleet_fn=TPUManager.get_mock_fleet)
+    big = s.submit(cfg(mesh=MeshConfig(data=2, fsdp=4)), priority=JobPriority.HIGH)
+    small = s.submit(cfg(mesh=MeshConfig(data=1, fsdp=2)))
+    assert wait_until(lambda: small.state == SubmissionState.RUNNING)
+    # Backfill admitted the small job past the unplaceable head...
+    assert big.state == SubmissionState.QUEUED
+    assert "gang of 8 device(s) > 7 healthy chip(s)" in big.last_skip_reason
+    # ...and an unplaceable head never evicts anyone.
+    assert s.preemptions_total == 0
+
+
+def test_hbm_reservation_serialises_big_jobs(sched_factory):
+    # Healthy mock chips have 9.6 GiB free; two 6 GiB/device gangs of 4
+    # cannot coexist (7 chips, each fits ONE such job's reservation).
+    def est(config, n_avail):
+        return HBMEstimate(
+            model_name=config.model_name,
+            gang_devices=gang_size(config, n_avail),
+            params_gib=6.0, grads_gib=0, opt_gib=0, working_gib=0,
+            activations_gib=0, logits_gib=0, device_total_gib=6.0, host_gib=0,
+        )
+
+    s = sched_factory(
+        max_concurrent_jobs=4, fleet_fn=TPUManager.get_mock_fleet,
+        estimate_fn=est,
+    )
+    first = s.submit(cfg(mesh=MeshConfig(data=1, fsdp=4)))
+    assert wait_until(lambda: first.state == SubmissionState.RUNNING)
+    assert len(first.placement) == 4
+    second = s.submit(cfg(mesh=MeshConfig(data=1, fsdp=4)))
+    s.poll()
+    assert second.state == SubmissionState.QUEUED
+    assert "only 3 have that headroom" in second.last_skip_reason
+    assert s.stats()["reserved_hbm_gib"] == pytest.approx(24.0)
+
+    s._stub_jobs[0].finish()
+    assert wait_until(lambda: second.state == SubmissionState.RUNNING)
+    # The finished job's reservation was released before re-placement.
+    assert s.stats()["reserved_hbm_gib"] == pytest.approx(24.0)
+
+
+def test_estimate_none_degrades_to_capacity_only(sched_factory):
+    s = sched_factory(
+        max_concurrent_jobs=1, fleet_fn=TPUManager.get_mock_fleet,
+        estimate_fn=lambda config, n_avail: None,
+    )
+    sub = s.submit(cfg(model_name="gpt-tiny"))
+    assert wait_until(lambda: sub.state == SubmissionState.RUNNING)
+    assert sub.estimate is None and len(sub.placement) == 2
+
+
+# ---------------------------------------------------------------------------
+# preempt-requeue
+# ---------------------------------------------------------------------------
+
+
+def test_preempt_requeue_and_priority_resume(sched_factory):
+    s = sched_factory(max_concurrent_jobs=1)
+    low = s.submit(cfg(), priority=JobPriority.LOW)
+    assert wait_until(lambda: low.state == SubmissionState.RUNNING)
+    low_job_1 = low.job
+
+    high = s.submit(cfg(), priority=JobPriority.HIGH)
+    # The head cannot be admitted at capacity → the LOW victim is told to
+    # emergency-save (watcher seam), then requeued at its original seq.
+    assert wait_until(lambda: low_job_1.watcher.fired.is_set())
+    assert wait_until(lambda: high.state == SubmissionState.RUNNING)
+    assert low.state == SubmissionState.QUEUED
+    assert low.preemptions == 1 and low.attempts == 1
+    assert s.requeues_total == 1 and s.preemptions_total == 1
+
+    s._stub_jobs[-1].finish()  # high completes
+    assert wait_until(lambda: low.state == SubmissionState.RUNNING)
+    assert low.attempts == 2
+    assert low.job is not low_job_1  # fresh attempt
+    assert low.job_id == low.job.job_id  # same durable job identity
+    s._stub_jobs[-1].finish()
+    assert wait_until(lambda: low.state == SubmissionState.COMPLETED)
+
+
+def test_requeued_victim_goes_to_front_of_its_class(sched_factory):
+    s = sched_factory(max_concurrent_jobs=1)
+    victim = s.submit(cfg(), priority=JobPriority.LOW)
+    assert wait_until(lambda: victim.state == SubmissionState.RUNNING)
+    later_low = s.submit(cfg(), priority=JobPriority.LOW)
+    high = s.submit(cfg(), priority=JobPriority.HIGH)
+    assert wait_until(lambda: high.state == SubmissionState.RUNNING)
+    # Requeued victim keeps its ORIGINAL seq → ahead of the later LOW.
+    order = [q["submission_id"] for q in s.queue_state()["queued"]]
+    assert order == [victim.submission_id, later_low.submission_id]
+
+
+def test_equal_priority_never_preempts(sched_factory):
+    s = sched_factory(max_concurrent_jobs=1)
+    first = s.submit(cfg(), priority=JobPriority.NORMAL)
+    assert wait_until(lambda: first.state == SubmissionState.RUNNING)
+    second = s.submit(cfg(), priority=JobPriority.NORMAL)
+    time.sleep(0.1)
+    s.poll()
+    assert second.state == SubmissionState.QUEUED
+    assert s.preemptions_total == 0
+    assert first.state == SubmissionState.RUNNING
+
+
+def test_non_preemptible_job_is_never_evicted(sched_factory):
+    s = sched_factory(max_concurrent_jobs=1)
+    # No checkpoint_dir → no emergency-save path → not preemptible.
+    low = s.submit(cfg(checkpoint_dir=None), priority=JobPriority.LOW)
+    assert wait_until(lambda: low.state == SubmissionState.RUNNING)
+    s.submit(cfg(), priority=JobPriority.CRITICAL)
+    time.sleep(0.1)
+    s.poll()
+    assert low.state == SubmissionState.RUNNING
+    assert s.preemptions_total == 0
+
+
+def test_one_eviction_frees_exactly_one_slot(sched_factory):
+    s = sched_factory(max_concurrent_jobs=2)
+    lows = [s.submit(cfg(), priority=JobPriority.LOW) for _ in range(2)]
+    assert wait_until(
+        lambda: all(x.state == SubmissionState.RUNNING for x in lows)
+    )
+    crit = s.submit(cfg(), priority=JobPriority.CRITICAL)
+    assert wait_until(lambda: crit.state == SubmissionState.RUNNING)
+    # One LOW was evicted for the one missing slot; the other kept running.
+    assert s.preemptions_total == 1
+    assert sum(1 for x in lows if x.state == SubmissionState.RUNNING) == 1
+
+
+# ---------------------------------------------------------------------------
+# quotas / cancel / drain
+# ---------------------------------------------------------------------------
+
+
+def test_per_submitter_quota(sched_factory):
+    s = sched_factory(max_concurrent_jobs=0, default_quota=2,
+                      quotas={"vip": 3})
+    s.submit(cfg(), submitter="alice")
+    s.submit(cfg(), submitter="alice")
+    with pytest.raises(QuotaExceeded, match="alice"):
+        s.submit(cfg(), submitter="alice")
+    s.submit(cfg(), submitter="bob")  # separate budget
+    for _ in range(3):
+        s.submit(cfg(), submitter="vip")  # per-submitter override
+    with pytest.raises(QuotaExceeded):
+        s.submit(cfg(), submitter="vip")
+
+
+def test_quota_frees_on_terminal_state(sched_factory):
+    s = sched_factory(max_concurrent_jobs=0, default_quota=1)
+    first = s.submit(cfg(), submitter="alice")
+    with pytest.raises(QuotaExceeded):
+        s.submit(cfg(), submitter="alice")
+    assert s.cancel(first.submission_id)
+    s.submit(cfg(), submitter="alice")  # slot freed
+
+
+def test_cancel_queued_and_running(sched_factory):
+    s = sched_factory(max_concurrent_jobs=1)
+    running = s.submit(cfg())
+    queued = s.submit(cfg())
+    assert wait_until(lambda: running.state == SubmissionState.RUNNING)
+    assert s.cancel(queued.submission_id)
+    assert queued.state == SubmissionState.CANCELLED
+
+    assert s.cancel(running.submission_id)
+    assert wait_until(lambda: running.state == SubmissionState.CANCELLED)
+    assert not s.cancel(running.submission_id)  # already terminal
+    assert not s.cancel("sub_nope")
+    assert s.stats()["cancelled_total"] == 2
+
+
+def test_drain_pauses_admission(sched_factory):
+    s = sched_factory(max_concurrent_jobs=2)
+    s.drain()
+    sub = s.submit(cfg())
+    time.sleep(0.1)
+    s.poll()
+    assert sub.state == SubmissionState.QUEUED and s.draining
+    s.resume_admission()
+    assert wait_until(lambda: sub.state == SubmissionState.RUNNING)
+
+
+def test_fleet_exception_degrades_to_capacity_only(sched_factory):
+    def broken_fleet():
+        raise RuntimeError("telemetry source down")
+
+    s = sched_factory(max_concurrent_jobs=1, fleet_fn=broken_fleet)
+    sub = s.submit(cfg())
+    assert wait_until(lambda: sub.state == SubmissionState.RUNNING)
+
+
+def test_failed_job_is_terminal_not_requeued(sched_factory):
+    s = sched_factory(max_concurrent_jobs=1)
+    sub = s.submit(cfg())
+    assert wait_until(lambda: sub.state == SubmissionState.RUNNING)
+    s._stub_jobs[0].finish(JobStatus.FAILED)
+    assert wait_until(lambda: sub.state == SubmissionState.FAILED)
+    assert sub.attempts == 1 and s.stats()["failed_total"] == 1
+
+
+def test_job_factory_exception_fails_submission(sched_factory):
+    def exploding(sub):
+        raise RuntimeError("bad mesh")
+
+    s = sched_factory(max_concurrent_jobs=1, job_factory=exploding)
+    sub = s.submit(cfg())
+    assert wait_until(lambda: sub.state == SubmissionState.FAILED)
+    assert "bad mesh" in sub.last_skip_reason
+
+
+def test_fleet_hbm_utilization_view(sched_factory):
+    s = sched_factory(fleet_fn=TPUManager.get_mock_fleet)
+    view = s.fleet_hbm_utilization()
+    assert view is not None
+    assert view["total_gib"] == pytest.approx(128.0)
+    assert 0 < view["utilization_pct"] <= 100
+    # No fleet source → no honest utilization number.
+    assert sched_factory().fleet_hbm_utilization() is None

@@ -1,0 +1,229 @@
+"""Per-device HBM footprint estimation for jobs — the admission-control math.
+
+Two measurement planes, promoted out of ``benchmarks/hbm_projection.py`` so
+the fleet scheduler (``tpu_engine/scheduler.py``) can project a *queued*
+job's footprint against live headroom before committing chips to it
+(placement-semantics stance: admission should reason about a job's concrete
+device/memory footprint, arXiv:2601.02311; the AOT compile plane in the
+benchmark remains the strongest evidence and stays there):
+
+1. :func:`per_device_bytes` — **exact** state accounting from a built
+   program's shapes + shardings (``shard_shape`` per leaf, device- vs
+   host-resident split). Needs ``build_train_program`` → too expensive for
+   an admission decision on every queue pass, but the benchmark and any
+   offline validation use it.
+
+2. :func:`estimate_job_hbm` — **analytic** projection straight from a
+   :class:`~tpu_engine.sharding.TPUTrainConfig`: params / grads / optimizer
+   state / activations / logits per device from ``param_count`` and the
+   sharding semantics alone. No compile, microseconds, safe to call on a
+   scheduler tick. Deliberately a slight over-estimate (workspace terms are
+   rounded up) — an admission gate must err toward "does not fit".
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from pydantic import BaseModel, Field
+
+from tpu_engine.sharding import (
+    OffloadDevice,
+    Precision,
+    ShardingStage,
+    TPUTrainConfig,
+    dtype_of,
+)
+
+_GIB = 2**30
+
+
+def _itemsize(p: Precision) -> int:
+    return jax.numpy.dtype(dtype_of(p)).itemsize
+
+
+# ---------------------------------------------------------------------------
+# Exact plane: state accounting from a built program (ex benchmarks/
+# hbm_projection.run_table — the benchmark now imports this).
+# ---------------------------------------------------------------------------
+
+
+def per_device_bytes(shape_tree: Any, sharding_tree: Any, host: bool) -> int:
+    """Per-device bytes of one state subtree, exact via ``shard_shape``.
+
+    ``shape_tree`` is a pytree of ``jax.ShapeDtypeStruct`` (from
+    ``jax.eval_shape`` of the program's init); ``sharding_tree`` the
+    matching shardings (``program.state_shardings``). ``host`` selects the
+    pinned-host-resident or device-resident part of the subtree.
+    """
+    total = 0
+    leaves = jax.tree.leaves(shape_tree)
+    shs = jax.tree.leaves(sharding_tree, is_leaf=lambda x: hasattr(x, "memory_kind"))
+    for leaf, sh in zip(leaves, shs):
+        if (getattr(sh, "memory_kind", None) == "pinned_host") != host:
+            continue
+        shard_shape = sh.shard_shape(leaf.shape)
+        n = leaf.dtype.itemsize
+        for d in shard_shape:
+            n *= d
+        total += n
+    return total
+
+
+# ---------------------------------------------------------------------------
+# Analytic plane: projection from the config alone.
+# ---------------------------------------------------------------------------
+
+
+class HBMEstimate(BaseModel):
+    """Per-device footprint projection for one training job."""
+
+    model_name: str
+    gang_devices: int  # devices the job's mesh occupies
+    params_gib: float  # master params resident on device
+    grads_gib: float
+    opt_gib: float  # optimizer state resident on device
+    working_gib: float  # compute-dtype copies / gather buffers
+    activations_gib: float  # saved activations + one layer's workspace
+    logits_gib: float  # fp32 loss logits chunk
+    device_total_gib: float  # sum of the device-resident terms
+    host_gib: float  # offloaded (pinned_host / disk-staging) state
+    notes: list[str] = Field(default_factory=list)
+
+
+def gang_size(config: TPUTrainConfig, available: Optional[int] = None) -> int:
+    """Devices a config's mesh occupies.
+
+    Explicit axes multiply out directly; ``data=-1`` absorbs devices, so it
+    resolves against ``available`` (largest multiple of the fixed axes that
+    fits, minimum one block). With no ``available`` hint a ``-1`` data axis
+    counts as 1 block — the smallest gang the job can legally run on.
+    """
+    m = config.mesh
+    fixed = m.fsdp * m.pipe * m.sequence * m.model
+    if m.data != -1:
+        return m.data * fixed
+    if available is None or available < fixed:
+        return fixed
+    return (available // fixed) * fixed
+
+
+def estimate_job_hbm(
+    config: TPUTrainConfig, available_devices: Optional[int] = None
+) -> Optional[HBMEstimate]:
+    """Analytic per-device HBM projection for a queued job.
+
+    Returns None for unknown model names (nothing honest to project).
+    The terms mirror the sharding semantics in ``tpu_engine/sharding.py``:
+    params shard over fsdp at stage>=3, grads at stage>=2, optimizer state
+    at stage>=1; tensor/pipe axes divide all weight-shaped state; the
+    sequence axis divides activations. LoRA jobs train adapter-sized
+    grads/optimizer state over a frozen compute-dtype base.
+    """
+    from tpu_engine.models import transformer as tfm
+
+    model_cfg = tfm.MODEL_CONFIGS.get(config.model_name)
+    if model_cfg is None:
+        return None
+
+    gang = gang_size(config, available_devices)
+    m = config.mesh
+    tp_pp = m.model * m.pipe  # axes that divide every weight-shaped tensor
+    stage = config.sharding_stage
+    notes: list[str] = []
+
+    n_params = tfm.param_count(model_cfg)
+    master_b = _itemsize(config.param_dtype)
+    compute_b = _itemsize(config.precision)
+
+    lora = config.lora_rank is not None
+    if lora:
+        # Adapters on the targeted projections: rank x (in + out) each.
+        d, hd = model_cfg.d_model, model_cfg.head_dim
+        out_dims = {
+            "q": model_cfg.n_heads * hd, "k": model_cfg.n_kv_heads * hd,
+            "v": model_cfg.n_kv_heads * hd, "o": d,
+        }
+        n_train = sum(
+            config.lora_rank * (d + out_dims.get(t, d))
+            for t in config.lora_targets
+        ) * model_cfg.n_layers
+        notes.append("lora: frozen base in compute dtype, adapter-sized grads/opt")
+    else:
+        n_train = n_params
+
+    params_shard = tp_pp * (m.fsdp if stage >= ShardingStage.FULL_PARTITIONING else 1)
+    grads_shard = tp_pp * (
+        m.fsdp if stage >= ShardingStage.GRADIENT_PARTITIONING else 1
+    )
+    opt_shard = tp_pp * (m.fsdp if stage >= ShardingStage.OPTIMIZER_STATE else 1)
+
+    host_bytes = 0.0
+    params_dev = n_params * (compute_b if lora else master_b) / params_shard
+    if not lora and config.param_offload != OffloadDevice.NONE:
+        host_bytes += params_dev
+        params_dev = 0.0
+        notes.append(f"params offloaded to {config.param_offload.value}")
+
+    grads_dev = n_train * master_b / grads_shard
+
+    # Optimizer state multiplier in master-dtype units.
+    mu_b = _itemsize(config.moment_dtype) if config.moment_dtype else master_b
+    if config.optimizer == "adamw":
+        opt_bytes_per_param = mu_b + master_b  # mu + nu
+    elif config.optimizer == "lion":
+        opt_bytes_per_param = mu_b
+    else:  # adafactor: factored second moments, O(in+out) per kernel
+        opt_bytes_per_param = 0.05 * master_b
+        notes.append("adafactor: factored moments approximated at 5%")
+    opt_dev = n_train * opt_bytes_per_param / opt_shard
+    if config.optimizer_offload != OffloadDevice.NONE:
+        host_bytes += opt_dev
+        opt_dev = 0.0
+        notes.append(f"optimizer state offloaded to {config.optimizer_offload.value}")
+
+    # Working set: compute-dtype weights. Stage-3 gathers materialise ~2
+    # layers at a time (current + prefetched); otherwise a full cast copy
+    # exists whenever compute != master dtype.
+    per_layer = n_params / max(model_cfg.n_layers, 1)
+    if stage >= ShardingStage.FULL_PARTITIONING and not lora:
+        working_dev = 2 * per_layer * compute_b / m.model
+    elif config.precision != config.param_dtype and not lora:
+        working_dev = n_params * compute_b / tp_pp
+    else:
+        working_dev = 0.0
+
+    # Activations: one microbatch lives at a time (accumulation is
+    # sequential). The batch dim is per data-parallel shard already; the
+    # sequence axis divides S.
+    bsz = config.micro_batch_size
+    seq = config.seq_len / m.sequence
+    d_model, d_ff = model_cfg.d_model, model_cfg.d_ff
+    layers_per_stage = max(model_cfg.n_layers / m.pipe, 1)
+    layer_ws = bsz * seq * (4 * d_model + 2 * d_ff) / m.model * compute_b
+    if config.activation_checkpointing:
+        # Saved boundaries (B,S,D per layer) + one layer's live workspace.
+        act_dev = bsz * seq * d_model * layers_per_stage * compute_b + layer_ws
+    else:
+        act_dev = layer_ws * layers_per_stage
+
+    # fp32 logits for the loss: the [B, S_chunk, V] tensor (often dominant
+    # for small models / large vocabs); chunked loss bounds S_chunk.
+    s_chunk = min(seq, config.loss_chunk_size or seq)
+    logits_dev = bsz * s_chunk * model_cfg.vocab_size * 4 / m.model
+
+    total = params_dev + grads_dev + opt_dev + working_dev + act_dev + logits_dev
+    return HBMEstimate(
+        model_name=config.model_name,
+        gang_devices=gang,
+        params_gib=round(params_dev / _GIB, 4),
+        grads_gib=round(grads_dev / _GIB, 4),
+        opt_gib=round(opt_dev / _GIB, 4),
+        working_gib=round(working_dev / _GIB, 4),
+        activations_gib=round(act_dev / _GIB, 4),
+        logits_gib=round(logits_dev / _GIB, 4),
+        device_total_gib=round(total / _GIB, 4),
+        host_gib=round(host_bytes / _GIB, 4),
+        notes=notes,
+    )

@@ -29,7 +29,9 @@ import jax
 from pydantic import BaseModel, Field
 
 from tpu_engine import comm, quant_train
-from tpu_engine.mesh_runtime import MESH_AXES, MeshConfig
+from tpu_engine import scheduler as scheduler_mod
+from tpu_engine.mesh_runtime import MESH_AXES
+from tpu_engine.scheduler import FleetScheduler, JobPriority, QuotaExceeded
 from tpu_engine.models import transformer as tfm
 from tpu_engine.sharding import (
     ShardingStage,
@@ -44,28 +46,62 @@ from tpu_engine.supervisor import JobStatus, TrainingJob
 
 
 class LaunchResult(BaseModel):
-    """Mirrors reference ``LaunchResult`` (``deepspeed_launcher.py:90-100``)."""
+    """Mirrors reference ``LaunchResult`` (``deepspeed_launcher.py:90-100``),
+    plus the two-phase fields: a launch that cannot be admitted right now is
+    ``status="queued"`` with its queue position — not a refusal."""
 
     job_id: str
-    status: str  # "dry_run" | "launched" | "failed"
+    status: str  # "dry_run" | "launched" | "queued" | "failed"
     model_name: str
     effective_batch_size: int
     num_devices: int
     plan: dict[str, Any] = Field(default_factory=dict)
     error: Optional[str] = None
+    submission_id: Optional[str] = None
+    queue_position: Optional[int] = None
 
 
 class TPULauncher:
-    """In-process launch + job registry (replaces subprocess orchestration)."""
+    """In-process launch + job registry (replaces subprocess orchestration).
 
-    def __init__(self, max_concurrent_jobs: int = 1):
+    Admission is owned by the :class:`~tpu_engine.scheduler.FleetScheduler`
+    (one admission authority): ``launch`` is a thin wrapper over ``submit``
+    with ``priority=normal``."""
+
+    def __init__(
+        self,
+        max_concurrent_jobs: int = 1,
+        scheduler: Optional[FleetScheduler] = None,
+    ):
         """``max_concurrent_jobs``: running-job cap for this process's
         devices (default 1 — concurrent sharded train loops would fight
         for the same HBM and silently thrash; raise it deliberately for
-        tiny-model multi-tenancy)."""
+        tiny-model multi-tenancy). Enforced by the scheduler."""
         self._jobs: dict[str, TrainingJob] = {}
         self._lock = threading.Lock()
-        self.max_concurrent_jobs = max_concurrent_jobs
+        self.scheduler = scheduler or FleetScheduler(
+            max_concurrent_jobs=max_concurrent_jobs,
+            job_factory=self._make_job,
+        )
+        if scheduler is not None:
+            self.scheduler.job_factory = self._make_job
+
+    @property
+    def max_concurrent_jobs(self) -> int:
+        return self.scheduler.max_concurrent_jobs
+
+    @max_concurrent_jobs.setter
+    def max_concurrent_jobs(self, n: int) -> None:
+        self.scheduler.max_concurrent_jobs = n
+
+    def _make_job(self, sub: "scheduler_mod.Submission") -> TrainingJob:
+        """Scheduler job factory: construct the attempt AND register it, so
+        the existing registry views (get_job/list_jobs/stop_job) keep
+        working; a requeued attempt reuses its job_id — newest wins."""
+        job = scheduler_mod._default_job_factory(sub)
+        with self._lock:
+            self._jobs[job.job_id] = job
+        return job
 
     # -- plan generation (generate_config parity) ----------------------------
 
@@ -201,10 +237,20 @@ class TPULauncher:
         dry_run: bool = False,
         max_steps: Optional[int] = None,
         data_fn: Optional[Callable[[int], jax.Array]] = None,
-        watch_preemption: bool = False,
+        watch_preemption: Optional[bool] = None,
         install_signal_handlers: bool = False,
         block: bool = False,
+        priority: JobPriority = JobPriority.NORMAL,
+        submitter: str = "anonymous",
     ) -> LaunchResult:
+        """Two-phase: submit to the scheduler, then one synchronous admit
+        pass. An admitted job is ``"launched"``; one the fleet cannot take
+        right now is ``"queued"`` with its position (the scheduler keeps
+        working on it — this is not a refusal).
+
+        ``watch_preemption=True`` opts into the REAL GCE metadata poll /
+        signal handlers; the default (None) still gets a watcher wired to
+        the scheduler's preempt seam."""
         plan = self.generate_plan(config)
         ts = datetime.now(timezone.utc).strftime("%Y%m%d_%H%M%S")
         # Reference id format (:330) + a uniquifier: second-resolution stamps
@@ -212,57 +258,63 @@ class TPULauncher:
         job_id = f"tpu_{config.model_name}_{ts}_{uuid.uuid4().hex[:6]}"
 
         base = dict(
-            job_id=job_id,
             model_name=config.model_name,
             effective_batch_size=config.effective_batch_size,
             num_devices=jax.device_count(),
             plan=plan,
         )
         if dry_run:
-            return LaunchResult(status="dry_run", **base)
+            return LaunchResult(job_id=job_id, status="dry_run", **base)
 
         if config.model_name not in tfm.MODEL_CONFIGS:
             return LaunchResult(
+                job_id=job_id,
                 status="failed",
                 error=f"unknown model '{config.model_name}'; known: {sorted(tfm.MODEL_CONFIGS)}",
                 **base,
             )
+        job_kwargs: dict[str, Any] = dict(
+            data_fn=data_fn,
+            max_steps=max_steps,
+            install_signal_handlers=install_signal_handlers,
+        )
+        if watch_preemption is not None:
+            job_kwargs["watch_preemption"] = watch_preemption
         try:
-            with self._lock:
-                # Admission is atomic with registration: a registered job
-                # counts (status PENDING) even before its thread starts, so
-                # two threaded launches cannot both pass the cap — and a
-                # rejected launch never pays TrainingJob's constructor side
-                # effects (checkpoint dir, Orbax manager).
-                non_terminal = (JobStatus.PENDING, JobStatus.COMPILING, JobStatus.RUNNING)
-                active = sum(
-                    1 for j in self._jobs.values() if j.status in non_terminal
-                )
-                if active >= self.max_concurrent_jobs:
-                    return LaunchResult(
-                        status="failed",
-                        error=(
-                            f"{active} job(s) already running (limit "
-                            f"{self.max_concurrent_jobs}); stop one or raise "
-                            "max_concurrent_jobs"
-                        ),
-                        **base,
-                    )
-                job = TrainingJob(
-                    job_id=job_id,
-                    config=config,
-                    data_fn=data_fn,
-                    max_steps=max_steps,
-                    watch_preemption=watch_preemption,
-                    install_signal_handlers=install_signal_handlers,
-                )
-                self._jobs[job_id] = job
-            job.start()
-            if block:
-                job.join()
-        except Exception as e:  # noqa: BLE001 — launch boundary
-            return LaunchResult(status="failed", error=f"{type(e).__name__}: {e}", **base)
-        return LaunchResult(status="launched", **base)
+            sub = self.scheduler.submit(
+                config, priority=priority, submitter=submitter,
+                job_kwargs=job_kwargs,
+            )
+        except QuotaExceeded as e:
+            return LaunchResult(job_id=job_id, status="failed", error=str(e), **base)
+        self.scheduler.poll()
+        if block:
+            sub = self.scheduler.wait(sub.submission_id)
+        state = sub.state
+        if state == scheduler_mod.SubmissionState.QUEUED:
+            return LaunchResult(
+                job_id=sub.job_id,
+                status="queued",
+                submission_id=sub.submission_id,
+                queue_position=self.scheduler.queue_position(sub.submission_id),
+                **base,
+            )
+        if state == scheduler_mod.SubmissionState.FAILED and (
+            sub.job is None or sub.attempts == 0
+        ):
+            return LaunchResult(
+                job_id=sub.job_id,
+                status="failed",
+                submission_id=sub.submission_id,
+                error=sub.last_skip_reason or "admission failed",
+                **base,
+            )
+        return LaunchResult(
+            job_id=sub.job_id,
+            status="launched",
+            submission_id=sub.submission_id,
+            **base,
+        )
 
     # -- presets (reference :369-407) ---------------------------------------
 
@@ -281,6 +333,10 @@ class TPULauncher:
     def stop_job(self, job_id: str) -> bool:
         job = self._jobs.get(job_id)
         if job is None:
+            # Not admitted yet — a queued submission is cancelled instead.
+            sub = self.scheduler.find_by_job_id(job_id)
+            if sub is not None:
+                return self.scheduler.cancel(sub.submission_id)
             return False
         job.stop()
         return True
@@ -385,7 +441,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         config,
         dry_run=args.dry_run,
         max_steps=args.max_steps,
-        watch_preemption=args.watch_preemption,
+        # True opts into the real GCE poll; None keeps the scheduler seam.
+        watch_preemption=True if args.watch_preemption else None,
         install_signal_handlers=not args.dry_run,
         block=not args.dry_run,
     )

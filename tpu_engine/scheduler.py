@@ -1,0 +1,642 @@
+"""Fleet job scheduler: priority queue, HBM-aware gang admission, preemption.
+
+The reference admits a job immediately or refuses (``DeepSpeedLauncher`` has
+no queue — SURVEY.md §5); launch here becomes a two-phase submit→admit
+pipeline owned by one admission authority:
+
+- **submit** enqueues a :class:`Submission` (priority + FIFO within a
+  priority class, per-submitter quotas) and returns immediately with a
+  queue position;
+- **admit** runs on every scheduler pass: a submission starts only when its
+  *gang* of devices (the product of its mesh axes) fits the fleet's healthy
+  chips — unhealthy/critical chips (``TPUDevice.is_available``,
+  ``tpu_engine/tpu_manager.py`` thresholds) are excluded from placement —
+  AND its projected per-device HBM footprint
+  (:func:`tpu_engine.hbm_estimate.estimate_job_hbm`) fits the headroom left
+  after every already-running job's reservation (Poplar's stance that
+  cluster-aware placement, not just per-job parallelism, drives fleet
+  utilization — arXiv:2408.12596);
+- a higher-priority submission that cannot be admitted triggers
+  **checkpoint-preempt-requeue** of the lowest-priority running job through
+  the supervisor's existing emergency-save path
+  (``PreemptionWatcher.simulate_interruption`` → synchronous Orbax save →
+  the submission re-enters the queue and auto-resumes from its checkpoint
+  when re-admitted — zero lost steps);
+- **backfill**: a small job behind a too-big head-of-queue job may start if
+  it fits, bounded by ``backfill_depth`` so the head cannot starve.
+
+``TPULauncher.launch`` is a thin wrapper over ``submit`` (priority=normal);
+``backend/routers/scheduler.py`` exposes the full queue surface.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from datetime import datetime, timezone
+from enum import Enum, IntEnum
+from typing import Any, Callable, Optional
+
+import jax
+
+from tpu_engine.hbm_estimate import HBMEstimate, estimate_job_hbm, gang_size
+from tpu_engine.sharding import TPUTrainConfig
+from tpu_engine.supervisor import JobStatus, TrainingJob
+from tpu_engine.tpu_manager import TPUFleetStatus
+
+log = logging.getLogger(__name__)
+
+
+class JobPriority(IntEnum):
+    LOW = 0
+    NORMAL = 1
+    HIGH = 2
+    CRITICAL = 3
+
+
+class SubmissionState(str, Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    PREEMPTING = "preempting"  # emergency save in flight; requeued when done
+    COMPLETED = "completed"
+    FAILED = "failed"
+    CANCELLING = "cancelling"
+    CANCELLED = "cancelled"
+
+
+# Submission states that will never change again.
+TERMINAL_STATES = frozenset(
+    {SubmissionState.COMPLETED, SubmissionState.FAILED, SubmissionState.CANCELLED}
+)
+
+
+class QuotaExceeded(Exception):
+    """Per-submitter quota would be exceeded (maps to HTTP 429)."""
+
+    def __init__(self, submitter: str, limit: int):
+        self.submitter = submitter
+        self.limit = limit
+        super().__init__(
+            f"submitter '{submitter}' already has {limit} active submission(s) "
+            f"(quota {limit}); wait for one to finish or cancel it"
+        )
+
+
+class Submission:
+    """One queued/running unit of work — survives preempt-requeue cycles
+    (the :class:`~tpu_engine.supervisor.TrainingJob` is per *attempt*; the
+    submission is the durable identity the queue orders and the API names).
+    """
+
+    def __init__(
+        self,
+        config: TPUTrainConfig,
+        priority: JobPriority,
+        submitter: str,
+        seq: int,
+        job_kwargs: Optional[dict[str, Any]] = None,
+    ):
+        ts = datetime.now(timezone.utc).strftime("%Y%m%d_%H%M%S")
+        self.submission_id = f"sub_{ts}_{uuid.uuid4().hex[:6]}"
+        # Attempts reuse this id so the registry's newest entry wins.
+        self.job_id = f"tpu_{config.model_name}_{ts}_{uuid.uuid4().hex[:6]}"
+        self.config = config
+        self.priority = priority
+        self.submitter = submitter
+        self.seq = seq  # FIFO tiebreak within a priority class; kept on requeue
+        self.job_kwargs = job_kwargs or {}
+
+        self.state = SubmissionState.QUEUED
+        self.job: Optional[TrainingJob] = None
+        self.attempts = 0
+        self.preemptions = 0
+        self.submitted_at = time.time()
+        self.first_admitted_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.last_skip_reason: Optional[str] = None
+        self.estimate: Optional[HBMEstimate] = None
+        self.placement: list[int] = []  # fleet device indices reserved for it
+
+    @property
+    def preemptible(self) -> bool:
+        """Preemption is only safe when the emergency-save path exists: a
+        watcher to fire and a checkpoint dir for the synchronous save the
+        requeued attempt resumes from."""
+        return (
+            self.job is not None
+            and self.job.watcher is not None
+            and bool(self.config.checkpoint_dir)
+        )
+
+    @property
+    def wait_s(self) -> Optional[float]:
+        if self.first_admitted_at is None:
+            return None
+        return self.first_admitted_at - self.submitted_at
+
+    def describe(self) -> dict[str, Any]:
+        return {
+            "submission_id": self.submission_id,
+            "job_id": self.job_id,
+            "state": self.state.value,
+            "priority": self.priority.name.lower(),
+            "submitter": self.submitter,
+            "model_name": self.config.model_name,
+            "attempts": self.attempts,
+            "preemptions": self.preemptions,
+            "submitted_at": self.submitted_at,
+            "first_admitted_at": self.first_admitted_at,
+            "finished_at": self.finished_at,
+            "wait_s": self.wait_s,
+            "last_skip_reason": self.last_skip_reason,
+            "hbm_estimate": self.estimate.model_dump() if self.estimate else None,
+            "placement": self.placement,
+            "job": self.job.describe() if self.job is not None else None,
+        }
+
+
+def _default_job_factory(sub: Submission) -> TrainingJob:
+    kwargs = dict(sub.job_kwargs)
+    # Every scheduler-run job is preemptible-by-the-scheduler: the watcher
+    # exists (simulate_interruption is the preempt verb) and the injected
+    # never-true check swaps the 5 s GCE metadata poll for the 0.05 s
+    # cadence, so a preempt lands within a step, not seconds later. A
+    # caller who passed watch_preemption=True explicitly wants the REAL
+    # GCE metadata poll — leave their check alone.
+    if "watch_preemption" not in kwargs:
+        kwargs["watch_preemption"] = True
+        kwargs.setdefault("simulate_preemption_check", lambda: False)
+    return TrainingJob(job_id=sub.job_id, config=sub.config, **kwargs)
+
+
+class FleetScheduler:
+    """Single admission authority for this process's devices.
+
+    ``fleet_fn`` supplies the placement view (a
+    :class:`~tpu_engine.tpu_manager.TPUFleetStatus`); None, an empty fleet,
+    or chips with no HBM telemetry (``hbm_total_gb == 0`` — the CPU backend)
+    degrade admission to capacity-only, never to a refusal: missing
+    telemetry must not brick the queue.
+    """
+
+    def __init__(
+        self,
+        max_concurrent_jobs: int = 1,
+        fleet_fn: Optional[Callable[[], TPUFleetStatus]] = None,
+        job_factory: Callable[[Submission], TrainingJob] = _default_job_factory,
+        estimate_fn: Callable[..., Optional[HBMEstimate]] = estimate_job_hbm,
+        backfill_depth: int = 4,
+        default_quota: Optional[int] = None,
+        quotas: Optional[dict[str, int]] = None,
+        checkpoint_root: Optional[str] = None,
+        poll_interval_s: float = 0.1,
+    ):
+        self.max_concurrent_jobs = max_concurrent_jobs
+        self.fleet_fn = fleet_fn
+        self.job_factory = job_factory
+        self.estimate_fn = estimate_fn
+        self.backfill_depth = backfill_depth
+        self.default_quota = default_quota
+        self.quotas = dict(quotas or {})
+        self.checkpoint_root = checkpoint_root
+        self.poll_interval_s = poll_interval_s
+
+        self._lock = threading.RLock()
+        self._subs: dict[str, Submission] = {}
+        self._seq = 0
+        self._draining = False
+        self._reserved: dict[int, float] = {}  # device index → reserved GiB
+
+        # Telemetry counters (the metrics router renders these).
+        self.submitted_total = 0
+        self.admitted_total = 0
+        self.preemptions_total = 0
+        self.requeues_total = 0
+        self.completed_total = 0
+        self.failed_total = 0
+        self.cancelled_total = 0
+        self._wait_samples: list[float] = []  # bounded; admitted-wait seconds
+
+        self._shutdown = threading.Event()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(
+        self,
+        config: TPUTrainConfig,
+        priority: JobPriority = JobPriority.NORMAL,
+        submitter: str = "anonymous",
+        job_kwargs: Optional[dict[str, Any]] = None,
+    ) -> Submission:
+        """Enqueue; raises :class:`QuotaExceeded` when the submitter already
+        holds their quota of active (queued/running) submissions."""
+        with self._lock:
+            quota = self.quotas.get(submitter, self.default_quota)
+            if quota is not None:
+                active = sum(
+                    1
+                    for s in self._subs.values()
+                    if s.submitter == submitter and s.state not in TERMINAL_STATES
+                )
+                if active >= quota:
+                    raise QuotaExceeded(submitter, quota)
+            if not config.checkpoint_dir and self.checkpoint_root:
+                # Preemptibility needs somewhere to emergency-save; give the
+                # submission a stable dir its requeued attempts resume from.
+                config = config.model_copy(
+                    update={
+                        "checkpoint_dir": (
+                            f"{self.checkpoint_root}/sub_{uuid.uuid4().hex[:8]}"
+                        )
+                    }
+                )
+            self._seq += 1
+            sub = Submission(config, priority, submitter, self._seq, job_kwargs)
+            self._subs[sub.submission_id] = sub
+            self.submitted_total += 1
+        self._ensure_thread()
+        self._wake.set()
+        return sub
+
+    def get(self, submission_id: str) -> Optional[Submission]:
+        return self._subs.get(submission_id)
+
+    def find_by_job_id(self, job_id: str) -> Optional[Submission]:
+        for s in self._subs.values():
+            if s.job_id == job_id:
+                return s
+        return None
+
+    def queue_position(self, submission_id: str) -> Optional[int]:
+        """1-based position in admission order; None when not queued."""
+        with self._lock:
+            for i, s in enumerate(self._queued()):
+                if s.submission_id == submission_id:
+                    return i + 1
+        return None
+
+    def cancel(self, submission_id: str) -> bool:
+        """Cancel a queued submission immediately; a running one is stopped
+        (its final checkpoint still lands) and reaped to CANCELLED."""
+        with self._lock:
+            sub = self._subs.get(submission_id)
+            if sub is None or sub.state in TERMINAL_STATES:
+                return False
+            if sub.state == SubmissionState.QUEUED:
+                sub.state = SubmissionState.CANCELLED
+                sub.finished_at = time.time()
+                self.cancelled_total += 1
+                return True
+            sub.state = SubmissionState.CANCELLING
+            if sub.job is not None:
+                sub.job._stop.set()
+        self._wake.set()
+        return True
+
+    def drain(self) -> None:
+        """Stop admitting; running jobs continue, submissions keep queuing."""
+        with self._lock:
+            self._draining = True
+
+    def resume_admission(self) -> None:
+        with self._lock:
+            self._draining = False
+        self._wake.set()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    # -- scheduling pass ------------------------------------------------------
+
+    def poll(self) -> None:
+        """One pass: reap finished attempts (requeue preempted ones), then
+        admit. Idempotent and safe to call from any thread."""
+        with self._lock:
+            self._reap()
+            if not self._draining:
+                self._admit()
+
+    def wait(self, submission_id: str, timeout: Optional[float] = None) -> Submission:
+        """Block until the submission reaches a terminal state."""
+        deadline = None if timeout is None else time.time() + timeout
+        sub = self._subs[submission_id]
+        while sub.state not in TERMINAL_STATES:
+            if deadline is not None and time.time() > deadline:
+                break
+            self.poll()
+            if sub.job is not None and sub.state == SubmissionState.RUNNING:
+                sub.job.join(timeout=self.poll_interval_s)
+            else:
+                time.sleep(self.poll_interval_s)
+        return sub
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    # -- internals (all hold self._lock) --------------------------------------
+
+    def _queued(self) -> list[Submission]:
+        q = [s for s in self._subs.values() if s.state == SubmissionState.QUEUED]
+        q.sort(key=lambda s: (-int(s.priority), s.seq))
+        return q
+
+    def _active(self) -> list[Submission]:
+        return [
+            s
+            for s in self._subs.values()
+            if s.state
+            in (
+                SubmissionState.RUNNING,
+                SubmissionState.PREEMPTING,
+                SubmissionState.CANCELLING,
+            )
+        ]
+
+    def _release(self, sub: Submission) -> None:
+        for idx in sub.placement:
+            est = sub.estimate.device_total_gib if sub.estimate else 0.0
+            left = self._reserved.get(idx, 0.0) - est
+            if left <= 1e-9:
+                self._reserved.pop(idx, None)
+            else:
+                self._reserved[idx] = left
+        sub.placement = []
+
+    def _reap(self) -> None:
+        for sub in self._active():
+            job = sub.job
+            if job is None or job.is_alive:
+                continue
+            if job.status == JobStatus.PREEMPTED and sub.state != SubmissionState.CANCELLING:
+                # Emergency save completed (the train loop's final
+                # force+wait save runs before the thread exits) — requeue
+                # at the submission's ORIGINAL seq: a preempted job goes
+                # back to the front of its priority class, it does not
+                # re-pay the whole wait.
+                self._release(sub)
+                sub.state = SubmissionState.QUEUED
+                sub.preemptions += 1
+                sub.job = None
+                self.requeues_total += 1
+                log.info(
+                    "scheduler: %s preempted at step %s — requeued",
+                    sub.submission_id, job.current_step,
+                )
+            elif job.status in (
+                JobStatus.COMPLETED,
+                JobStatus.FAILED,
+                JobStatus.STOPPED,
+                JobStatus.PREEMPTED,  # cancelled mid-preempt
+            ) or sub.state == SubmissionState.CANCELLING:
+                self._release(sub)
+                sub.finished_at = time.time()
+                if sub.state == SubmissionState.CANCELLING:
+                    sub.state = SubmissionState.CANCELLED
+                    self.cancelled_total += 1
+                elif job.status == JobStatus.COMPLETED:
+                    sub.state = SubmissionState.COMPLETED
+                    self.completed_total += 1
+                elif job.status == JobStatus.STOPPED:
+                    sub.state = SubmissionState.CANCELLED
+                    self.cancelled_total += 1
+                else:
+                    sub.state = SubmissionState.FAILED
+                    self.failed_total += 1
+
+    def _fleet(self) -> Optional[TPUFleetStatus]:
+        if self.fleet_fn is None:
+            return None
+        try:
+            return self.fleet_fn()
+        except Exception:  # degraded telemetry must not brick admission
+            log.exception("scheduler: fleet snapshot failed — capacity-only pass")
+            return None
+
+    def _admit(self) -> None:
+        queued = self._queued()
+        if not queued:
+            return
+        fleet = self._fleet()
+        slots = self.max_concurrent_jobs - len(self._active())
+
+        preempt_wanted = False
+        for rank, sub in enumerate(queued[: max(self.backfill_depth, 1)]):
+            if slots <= 0:
+                if rank == 0:
+                    sub.last_skip_reason = "at max_concurrent_jobs capacity"
+                    # Eviction frees a slot and HBM — but never heals a
+                    # chip. A head whose gang exceeds the healthy fleet
+                    # must not thrash victims it can never replace.
+                    preempt_wanted = self._placeable(sub, fleet)
+                break
+            if self._try_admit(sub, fleet):
+                slots -= 1
+            elif rank == 0 and "healthy chip" not in (sub.last_skip_reason or ""):
+                # Only the HEAD preempts (backfill candidates must never
+                # evict work), and only when eviction can actually help:
+                # capacity or HBM headroom — not a gang larger than the
+                # healthy fleet, which no preemption fixes.
+                preempt_wanted = True
+        if preempt_wanted:
+            self._maybe_preempt(queued[0])
+
+    def _placeable(self, sub: Submission, fleet: Optional[TPUFleetStatus]) -> bool:
+        """Could ``sub``'s gang fit the healthy fleet if capacity/HBM were
+        freed? (No fleet view → capacity-only admission → always yes.)"""
+        if fleet is None or not fleet.devices:
+            return True
+        eligible = [d for d in fleet.devices if d.is_available]
+        return gang_size(sub.config, len(eligible)) <= len(eligible)
+
+    def _try_admit(self, sub: Submission, fleet: Optional[TPUFleetStatus]) -> bool:
+        eligible = None
+        if fleet is not None and fleet.devices:
+            eligible = [d for d in fleet.devices if d.is_available]
+        n_avail = len(eligible) if eligible is not None else jax.device_count()
+
+        gang = gang_size(sub.config, n_avail)
+        try:
+            est = self.estimate_fn(sub.config, n_avail)
+        except Exception:  # estimator must never block admission
+            est = None
+        sub.estimate = est
+
+        placement: list[int] = []
+        if eligible is not None:
+            if gang > len(eligible):
+                sub.last_skip_reason = (
+                    f"gang of {gang} device(s) > {len(eligible)} healthy chip(s)"
+                )
+                return False
+            # HBM gate only when the fleet actually reports HBM (CPU chips
+            # report 0 total — capacity-only there).
+            hbm_known = all(d.hbm_total_gb > 0 for d in eligible)
+            if hbm_known and est is not None:
+                need = est.device_total_gib
+                fits = [
+                    d
+                    for d in eligible
+                    if d.hbm_free_gb - self._reserved.get(d.index, 0.0) >= need
+                ]
+                if gang > len(fits):
+                    sub.last_skip_reason = (
+                        f"needs {need:.2f} GiB/device on {gang} chip(s); only "
+                        f"{len(fits)} have that headroom"
+                    )
+                    return False
+                # Most-headroom-first keeps the fleet balanced.
+                fits.sort(
+                    key=lambda d: -(d.hbm_free_gb - self._reserved.get(d.index, 0.0))
+                )
+                placement = [d.index for d in fits[:gang]]
+            else:
+                placement = [d.index for d in eligible[:gang]]
+
+        try:
+            job = self.job_factory(sub)
+        except Exception as e:  # noqa: BLE001 — constructor boundary
+            sub.state = SubmissionState.FAILED
+            sub.finished_at = time.time()
+            sub.last_skip_reason = f"job construction failed: {type(e).__name__}: {e}"
+            self.failed_total += 1
+            return False
+
+        sub.job = job
+        sub.attempts += 1
+        sub.state = SubmissionState.RUNNING
+        sub.last_skip_reason = None
+        sub.placement = placement
+        if est is not None:
+            for idx in placement:
+                self._reserved[idx] = (
+                    self._reserved.get(idx, 0.0) + est.device_total_gib
+                )
+        if sub.first_admitted_at is None:
+            sub.first_admitted_at = time.time()
+            self._wait_samples.append(sub.wait_s or 0.0)
+            del self._wait_samples[:-1000]
+        self.admitted_total += 1
+        job.start()
+        log.info(
+            "scheduler: admitted %s (%s, priority %s, attempt %d, gang %d)",
+            sub.submission_id, sub.config.model_name,
+            sub.priority.name, sub.attempts, gang,
+        )
+        return True
+
+    def _maybe_preempt(self, head: Submission) -> None:
+        """Evict the lowest-priority running job strictly below ``head``'s
+        priority (one per pass) via the emergency-save seam."""
+        if any(
+            s.state == SubmissionState.PREEMPTING for s in self._subs.values()
+        ):
+            return  # one eviction in flight at a time — its save must land
+        running = [
+            s
+            for s in self._subs.values()
+            if s.state == SubmissionState.RUNNING and s.preemptible
+        ]
+        victims = [s for s in running if s.priority < head.priority]
+        if not victims:
+            return
+        victims.sort(key=lambda s: (int(s.priority), -s.seq))  # lowest, youngest
+        victim = victims[0]
+        victim.state = SubmissionState.PREEMPTING
+        self.preemptions_total += 1
+        log.warning(
+            "scheduler: preempting %s (priority %s) for %s (priority %s)",
+            victim.submission_id, victim.priority.name,
+            head.submission_id, head.priority.name,
+        )
+        victim.job.watcher.simulate_interruption()
+
+    # -- background pump -------------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="fleet-scheduler"
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._shutdown.is_set():
+            self._wake.wait(timeout=self.poll_interval_s)
+            self._wake.clear()
+            try:
+                self.poll()
+            except Exception:  # the pump must survive anything
+                log.exception("scheduler: poll pass failed")
+
+    # -- views -----------------------------------------------------------------
+
+    def queue_state(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "draining": self._draining,
+                "max_concurrent_jobs": self.max_concurrent_jobs,
+                "queued": [s.describe() for s in self._queued()],
+                "running": [s.describe() for s in self._active()],
+                "finished": [
+                    s.describe()
+                    for s in self._subs.values()
+                    if s.state in TERMINAL_STATES
+                ],
+                "stats": self.stats(),
+            }
+
+    def stats(self) -> dict[str, Any]:
+        """Telemetry snapshot (the metrics router renders these as gauges)."""
+        queued = self._queued()
+        now = time.time()
+        by_priority = {p.name.lower(): 0 for p in JobPriority}
+        for s in queued:
+            by_priority[s.priority.name.lower()] += 1
+        waits = self._wait_samples
+        return {
+            "queue_depth": len(queued),
+            "queue_depth_by_priority": by_priority,
+            "running": len(self._active()),
+            "oldest_queued_wait_s": (
+                round(now - min(s.submitted_at for s in queued), 3) if queued else 0.0
+            ),
+            "mean_admission_wait_s": (
+                round(sum(waits) / len(waits), 4) if waits else 0.0
+            ),
+            "submitted_total": self.submitted_total,
+            "admitted_total": self.admitted_total,
+            "preemptions_total": self.preemptions_total,
+            "requeues_total": self.requeues_total,
+            "completed_total": self.completed_total,
+            "failed_total": self.failed_total,
+            "cancelled_total": self.cancelled_total,
+            "reserved_hbm_gib": round(sum(self._reserved.values()), 3),
+            "draining": self._draining,
+        }
+
+    def fleet_hbm_utilization(self) -> Optional[dict[str, float]]:
+        """Fleet-wide HBM view for telemetry: measured + scheduler-reserved
+        over total; None when no fleet source (or no HBM telemetry)."""
+        fleet = self._fleet()
+        if fleet is None or not fleet.devices:
+            return None
+        total = sum(d.hbm_total_gb for d in fleet.devices)
+        if total <= 0:
+            return None
+        used = sum(d.hbm_used_gb for d in fleet.devices)
+        reserved = sum(self._reserved.values())
+        return {
+            "total_gib": round(total, 3),
+            "used_gib": round(used, 3),
+            "reserved_gib": round(reserved, 3),
+            "utilization_pct": round(min((used + reserved) / total, 1.0) * 100, 2),
+        }
