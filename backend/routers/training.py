@@ -88,7 +88,8 @@ class TrainingLaunchRequest(BaseModel):
         "per-channel symmetric int8 dot with int32 MXU accumulation and "
         "stochastically-rounded backward operands (up to 2x the bf16 MXU "
         "rate; master weights/optimizer state stay full precision). "
-        "Rejected with LoRA, pipeline_schedule='1f1b', and ragged MoE.")
+        "Rejected with LoRA, the manual-vjp pipeline schedules "
+        "('1f1b'/'zb'), and ragged MoE.")
     quant_train_targets: list[str] = Field(
         default=["attn", "mlp", "moe"],
         description="matmul groups riding the quantized dot: 'attn' "
@@ -96,10 +97,12 @@ class TrainingLaunchRequest(BaseModel):
         "einsums); router/dispatch/embed/unembed always stay full "
         "precision")
     attention_impl: Literal["auto", "xla", "flash", "ring", "ulysses"] = "auto"
-    # "auto" resolves at build time: 1f1b when the microbatch count
-    # exceeds the pipe-stage count (where its O(P) activation residency
-    # pays), gpipe otherwise.
-    pipeline_schedule: Literal["auto", "gpipe", "1f1b"] = "auto"
+    # "auto" resolves at build time (sharding.resolve_pipeline_schedule):
+    # zb — the zero-bubble B/W-split schedule — when the microbatch count
+    # exceeds the pipe-stage count (where the O(P) activation residency
+    # pays) and no gpipe-only feature is requested, gpipe otherwise.
+    # "1f1b" (combined-backward manual vjp) stays selectable explicitly.
+    pipeline_schedule: Literal["auto", "gpipe", "1f1b", "zb"] = "auto"
     sliding_window: Optional[int] = Field(
         default=None, ge=0,
         description="sliding-window attention: None = model preset's window, "

@@ -174,6 +174,9 @@ def main() -> None:
     sched_line = _scheduler_metric()
     if sched_line is not None:
         print(json.dumps(sched_line))
+    pipe_line = _pipeline_schedule_metric(n_dev)
+    if pipe_line is not None:
+        print(json.dumps(pipe_line))
 
 
 def _comm_compress_metric(n_dev: int) -> dict | None:
@@ -310,6 +313,67 @@ def _scheduler_metric() -> dict | None:
             "preemptions": trace["preemptions"],
             "zero_lost_work": trace["zero_lost_work"],
         }
+    except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
+        return None
+
+
+def _pipeline_schedule_metric(n_dev: int) -> dict | None:
+    """Fifth JSON line: the zero-bubble pipeline schedule's tick/busy-lane
+    account vs 1F1B at the same M and P, plus a measured per-sample step
+    time A/B on a tiny pipelined program when the visible devices allow a
+    pipe=2 mesh. Never fails the bench: any error degrades to None."""
+    try:
+        from tpu_engine.parallel.pipeline_zb import schedule_account
+
+        pipe, accum = 4, 16
+        zb = schedule_account("zb", pipe, accum)
+        f1b = schedule_account("1f1b", pipe, accum)
+        line = {
+            "metric": "pipeline_schedule_zb_vs_1f1b",
+            "schedule": "zb",
+            "pipe_stages": pipe,
+            "microbatches": accum,
+            "ticks": zb["ticks"],
+            "busy_fraction": round(zb["busy_fraction"], 4),
+            "1f1b_busy_fraction": round(f1b["busy_fraction"], 4),
+            "burned_cost_vs_1f1b": round(
+                zb["burned_cost"] / f1b["burned_cost"], 3
+            ),
+            "per_sample_ms": None,
+            "1f1b_per_sample_ms": None,
+        }
+        if n_dev >= 2 and n_dev % 2 == 0:
+            from tpu_engine.mesh_runtime import MeshConfig
+            from tpu_engine.sharding import TPUTrainConfig
+            from tpu_engine.train import build_train_program
+
+            times = {}
+            for sched in ("1f1b", "zb"):
+                cfg = TPUTrainConfig(
+                    model_name="gpt-tiny",
+                    mesh=MeshConfig(data=-1, pipe=2),
+                    micro_batch_size=1,
+                    gradient_accumulation_steps=8,
+                    seq_len=64,
+                    precision="fp32",
+                    total_steps=4,
+                    pipeline_schedule=sched,
+                )
+                prog = build_train_program(cfg)
+                state = prog.init(jax.random.PRNGKey(0))
+                state, _ = prog.step(state, prog.synthetic_batch(seed=0))
+                jax.block_until_ready(jax.tree.leaves(state)[0])
+                t0 = time.perf_counter()
+                for i in range(1, 3):
+                    state, m = prog.step(state, prog.synthetic_batch(seed=i))
+                jax.block_until_ready(jax.tree.leaves(state)[0])
+                samples = 2 * cfg.effective_batch_size
+                times[sched] = (time.perf_counter() - t0) * 1e3 / samples
+            line["per_sample_ms"] = round(times["zb"], 2)
+            line["1f1b_per_sample_ms"] = round(times["1f1b"], 2)
+            line["measured_pipe_stages"] = 2
+            line["measured_microbatches"] = 8
+        return line
     except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
         return None
 

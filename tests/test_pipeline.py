@@ -193,15 +193,18 @@ def test_flash_attention_under_pipeline():
 
 def test_auto_schedule_selection():
     """pipeline_schedule="auto" (the default) resolves at build time:
-    1f1b exactly when the microbatch count exceeds the stage count (the
-    regime where its O(P) activation residency frees real memory —
-    measured in benchmarks/RESULTS.md §Pipeline), gpipe otherwise, and
-    gpipe whenever the manual-vjp schedule lacks a requested feature."""
+    zb — the zero-bubble schedule, which strictly dominates 1f1b — exactly
+    when the microbatch count exceeds the stage count (the regime where
+    the O(P) activation residency frees real memory — measured in
+    benchmarks/RESULTS.md §Pipeline), gpipe otherwise, and gpipe whenever
+    the manual-vjp schedules lack a requested feature. (Config-only
+    resolution is covered fast in test_pipeline_zb.py; this asserts the
+    built program agrees.)"""
     mesh = MeshConfig(data=2, fsdp=2, pipe=2)
-    # M=4 > P=2 → 1f1b.
+    # M=4 > P=2 → zb.
     assert build_train_program(
         _cfg(mesh, pipeline_schedule="auto")
-    ).pipeline_schedule == "1f1b"
+    ).pipeline_schedule == "zb"
     # M=2 <= P=2 → gpipe (warmup/drain overhead, no memory win).
     assert build_train_program(
         _cfg(mesh, pipeline_schedule="auto", gradient_accumulation_steps=2)

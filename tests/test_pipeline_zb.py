@@ -1,0 +1,275 @@
+"""Zero-bubble pipeline schedule: op table, analytic account, gradient
+parity, auto-resolution and the interaction matrix.
+
+The schedule-level parity tests run the raw schedule functions on tiny
+unsharded shapes (no mesh, seconds to compile) and so stay in the fast
+tier; the program-level three-way parity rides the compile-heavy slow tier
+next to ``test_pipeline.py``'s other full-program schedule tests.
+"""
+
+import collections
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_engine.mesh_runtime import MeshConfig
+from tpu_engine.models import transformer as tfm
+from tpu_engine.parallel.pipeline import stage_layer_stack
+from tpu_engine.parallel.pipeline_1f1b import pipeline_1f1b_grads
+from tpu_engine.parallel.pipeline_zb import (
+    pipeline_zb_grads,
+    schedule_account,
+    zb_op_table,
+)
+from tpu_engine.sharding import (
+    Precision,
+    ShardingStage,
+    TPUTrainConfig,
+    resolve_pipeline_schedule,
+)
+
+PM_COMBOS = [(2, 2), (2, 4), (4, 4), (4, 8)]
+
+
+# -- host-side op table -------------------------------------------------------
+
+
+@pytest.mark.parametrize("P,M", PM_COMBOS + [(4, 2), (3, 5), (8, 16)])
+def test_op_table_invariants(P, M):
+    """Every (microbatch, stage) pair gets exactly one F, one B and one W;
+    stage p defers exactly min(P-1-p, M) weight gradients — the stash
+    bound the schedule's memory claim rests on."""
+    table = zb_op_table(P, M)
+    assert len(table) == M + 3 * (P - 1)
+    counts = [collections.Counter() for _ in range(P)]
+    for row in table:
+        assert len(row) == P
+        for p, ops in enumerate(row):
+            counts[p].update(ops)
+    for p in range(P):
+        c = counts[p]
+        assert c["F"] == M
+        assert c["BW"] + c["B"] == M  # every backward's B half happens once
+        assert c["BW"] + c["W"] == M  # ... and its W half
+        assert c["B"] == c["W"] == min(P - 1 - p, M)  # deferred set
+        assert c["B"] <= P - 1  # stash bound
+
+
+def test_op_table_phase_structure():
+    """Forwards never run after the steady window and deferred W never
+    before the tail — the four-scan segmentation is exactly the table."""
+    P, M = 4, 8
+    table = zb_op_table(P, M)
+    for t, row in enumerate(table):
+        flat = [op for ops in row for op in ops]
+        if t <= P - 2:  # warmup
+            assert set(flat) <= {"F"}
+        elif t <= M + P - 2:  # steady
+            assert "B" not in flat and "W" not in flat
+        elif t <= M + 2 * (P - 1) - 1:  # drain
+            assert set(flat) <= {"B"}
+        else:  # W-tail
+            assert set(flat) <= {"W"}
+
+
+@pytest.mark.parametrize("P,M", PM_COMBOS + [(8, 32)])
+def test_schedule_account_zb_strictly_beats_1f1b(P, M):
+    zb = schedule_account("zb", P, M)
+    f1b = schedule_account("1f1b", P, M)
+    gp = schedule_account("gpipe", P, M)
+    # Closed forms the docstrings claim, in per-stage lane F-units.
+    assert zb["lane_cost"] == 4 * M + 6 * (P - 1)
+    assert f1b["lane_cost"] == 4 * M + 8 * (P - 1)
+    assert gp["lane_cost"] == 4 * (M + P - 1)
+    assert zb["ticks"] == M + 3 * (P - 1) == len(zb_op_table(P, M))
+    # The acceptance bar: strictly less busy-burning bubble compute than
+    # 1f1b at equal M and P, hence a strictly higher busy fraction.
+    assert zb["burned_cost"] < f1b["burned_cost"]
+    assert zb["busy_fraction"] > f1b["busy_fraction"]
+    assert zb["useful_cost"] == f1b["useful_cost"] == gp["useful_cost"]
+
+
+def test_schedule_account_degenerate():
+    assert schedule_account("zb", 1, 8)["busy_fraction"] == 1.0
+    with pytest.raises(ValueError, match="unknown pipeline schedule"):
+        schedule_account("interleaved", 4, 8)
+
+
+# -- gradient parity ----------------------------------------------------------
+
+
+def _parity_fixtures(P, M, seed=0):
+    cfg = tfm.MODEL_CONFIGS["gpt-tiny"].with_(n_layers=4, vocab_size=64)
+    params = tfm.init_params(jax.random.PRNGKey(seed), cfg, jnp.float32)
+    B, S, D = 1, 8, cfg.d_model
+    staged = stage_layer_stack(params["layers"], P, cfg.n_layers)
+    x_mb = jax.random.normal(jax.random.PRNGKey(1), (M, B, S, D)) * 0.1
+    toks = jax.random.randint(jax.random.PRNGKey(2), (M, B, S), 0, 64)
+    positions = jnp.arange(S)[None, :]
+    denom = M * B * S
+
+    body = tfm.remat_scan_body(cfg, positions, None, False, "nothing_saveable")
+
+    def stage_fn(x, w):
+        y, _aux = jax.lax.scan(body, x, w)
+        return y
+
+    def exit_scalar(y):
+        return jnp.sum(y * y) / denom
+
+    def exit_fn(y, _toks):
+        loss, vjp = jax.vjp(exit_scalar, y)
+        (dy,) = vjp(jnp.ones((), jnp.float32))
+        return loss, dy, {}
+
+    def ref_loss(staged_w, x):
+        # The autodiff reference: the same math every schedule must
+        # reproduce — sequential stages, summed exit losses (this is
+        # exactly what the GPipe path differentiates).
+        total = jnp.zeros((), jnp.float32)
+        for m in range(M):
+            h = x[m]
+            for p in range(P):
+                h = stage_fn(h, jax.tree.map(lambda a: a[p], staged_w))
+            total = total + exit_scalar(h)
+        return total
+
+    sched_kwargs = dict(
+        positions=positions, exit_fn=exit_fn, outer_grad_zero={},
+        aux_cotangent=0.0,
+    )
+    return cfg, staged, x_mb, toks, ref_loss, sched_kwargs
+
+
+@pytest.mark.parametrize("P,M", PM_COMBOS)
+def test_gradient_parity_gpipe_1f1b_zb(P, M):
+    """The schedules are pure reorderings of the same per-stage vjps:
+    loss, layer grads and input cotangents must agree across autodiff
+    (gpipe math), 1f1b and zb for every (P, M) combination."""
+    cfg, staged, x_mb, toks, ref_loss, kw = _parity_fixtures(P, M)
+    ref_val, (ref_dstaged, ref_dx) = jax.value_and_grad(ref_loss, argnums=(0, 1))(
+        staged, x_mb
+    )
+    for fn in (pipeline_1f1b_grads, pipeline_zb_grads):
+        loss, _aux, dstaged, _d_outer, dx_mb = fn(staged, x_mb, toks, cfg, **kw)
+        np.testing.assert_allclose(loss, ref_val, rtol=1e-5)
+        for got, want in zip(jax.tree.leaves(dstaged), jax.tree.leaves(ref_dstaged)):
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(dx_mb, ref_dx, rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_zb_program_matches_gpipe_and_1f1b():
+    """Full-program three-way parity on the 8-virtual-device CPU mesh:
+    losses and grad norms agree across all three schedules over steps."""
+    from tpu_engine.train import build_train_program
+
+    def run(sched):
+        cfg = _train_cfg(MeshConfig(data=2, fsdp=2, pipe=2),
+                         pipeline_schedule=sched)
+        prog = build_train_program(cfg)
+        state = prog.init(jax.random.PRNGKey(0))
+        out = []
+        for i in range(3):
+            state, m = prog.step(state, prog.synthetic_batch(seed=i))
+            out.append((float(m["loss"]), float(m["grad_norm"])))
+        return out
+
+    zb = run("zb")
+    fb = run("1f1b")
+    gp = run("gpipe")
+    np.testing.assert_allclose([l for l, _ in zb], [l for l, _ in fb], rtol=1e-6)
+    np.testing.assert_allclose([g for _, g in zb], [g for _, g in fb], rtol=2e-5)
+    np.testing.assert_allclose([l for l, _ in zb], [l for l, _ in gp], rtol=2e-5)
+    np.testing.assert_allclose([g for _, g in zb], [g for _, g in gp], rtol=2e-4)
+
+
+# -- resolution & interaction matrix ------------------------------------------
+
+
+def _train_cfg(mesh, **kw):
+    base = dict(
+        model_name="gpt-tiny",
+        sharding_stage=ShardingStage.FULL_PARTITIONING,
+        mesh=mesh,
+        micro_batch_size=2,
+        gradient_accumulation_steps=4,
+        seq_len=64,
+        precision=Precision.FP32,
+        param_dtype=Precision.FP32,
+        activation_checkpointing=True,
+        total_steps=10,
+    )
+    base.update(kw)
+    return TPUTrainConfig(**base)
+
+
+def test_auto_resolves_to_zb():
+    mesh = MeshConfig(data=2, fsdp=2, pipe=2)
+    # M=4 > P=2 and nothing gpipe-only requested → zb.
+    assert resolve_pipeline_schedule(_train_cfg(mesh)) == "zb"
+    # M <= P: warmup/drain overhead with no residency win → gpipe.
+    assert resolve_pipeline_schedule(
+        _train_cfg(mesh, gradient_accumulation_steps=2)
+    ) == "gpipe"
+    # No pipe axis → gpipe (schedule irrelevant).
+    assert resolve_pipeline_schedule(
+        _train_cfg(MeshConfig(data=2, fsdp=2, model=2))
+    ) == "gpipe"
+    # Explicit choices are honoured verbatim.
+    assert resolve_pipeline_schedule(
+        _train_cfg(mesh, pipeline_schedule="1f1b")
+    ) == "1f1b"
+    assert resolve_pipeline_schedule(
+        _train_cfg(mesh, pipeline_schedule="gpipe")
+    ) == "gpipe"
+
+
+def test_auto_degrades_to_gpipe_on_unsupported_features():
+    mesh = MeshConfig(data=2, fsdp=2, pipe=2)
+    assert resolve_pipeline_schedule(
+        _train_cfg(mesh, loss_chunk_size=32)
+    ) == "gpipe"
+    assert resolve_pipeline_schedule(
+        _train_cfg(mesh, quant_training="int8")
+    ) == "gpipe"
+    assert resolve_pipeline_schedule(
+        _train_cfg(mesh, precision=Precision.BF16,
+                   grad_allreduce_dtype="bf16")
+    ) == "gpipe"
+
+
+def test_zb_rejects_comm_compression():
+    with pytest.raises(ValueError, match="comm compression"):
+        _train_cfg(MeshConfig(data=2, fsdp=2, pipe=2),
+                   pipeline_schedule="zb", comm_quant_weights=True)
+
+
+def test_zb_rejects_quant_training():
+    with pytest.raises(ValueError, match="quant_training"):
+        _train_cfg(MeshConfig(data=2, fsdp=2, pipe=2),
+                   pipeline_schedule="zb", quant_training="int8")
+
+
+def test_zb_rejects_loss_chunking():
+    from tpu_engine.train import build_train_program
+
+    with pytest.raises(ValueError, match="loss_chunk_size"):
+        build_train_program(
+            _train_cfg(MeshConfig(data=2, fsdp=2, pipe=2),
+                       pipeline_schedule="zb", loss_chunk_size=32)
+        )
+
+
+def test_zb_rejects_reduced_comm_dtype():
+    from tpu_engine.train import build_train_program
+
+    with pytest.raises(ValueError, match="grad_allreduce_dtype"):
+        build_train_program(
+            _train_cfg(MeshConfig(data=2, fsdp=2, pipe=2),
+                       pipeline_schedule="zb", precision=Precision.BF16,
+                       param_dtype=Precision.FP32,
+                       grad_allreduce_dtype="bf16")
+        )

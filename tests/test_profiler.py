@@ -5,7 +5,13 @@ import time
 
 import pytest
 
-from tpu_engine.profiler import PEAK_FLOPS_BF16, StepProfiler, TraceSession, mfu
+from tpu_engine.profiler import (
+    PEAK_FLOPS_BF16,
+    StepProfiler,
+    TraceSession,
+    mfu,
+    pipeline_tick_account,
+)
 
 
 def test_step_profiler_phases_and_stats():
@@ -55,6 +61,47 @@ def test_mfu_accounting():
 
     v = mfu(1e9, 88_650.0, device=FakeDev())  # 88650 tok/s × 1 GF/tok / 197 TF
     assert v == pytest.approx(88_650e9 / PEAK_FLOPS_BF16["v5e"], rel=1e-6)
+
+
+def test_pipeline_tick_account():
+    # Off the pipelined path there is nothing to account.
+    assert pipeline_tick_account("gpipe", 1, 8) is None
+    zb = pipeline_tick_account("zb", 4, 16)
+    f1b = pipeline_tick_account("1f1b", 4, 16)
+    assert 0 < zb["busy_fraction"] <= 1
+    assert zb["busy_fraction"] > f1b["busy_fraction"]
+    # Growing M amortises the fixed bubble: busy fraction rises.
+    assert (
+        pipeline_tick_account("zb", 4, 32)["busy_fraction"]
+        > zb["busy_fraction"]
+    )
+
+
+def test_bubble_adjusted_mfu_in_summary():
+    """With a pipeline account attached the summary exposes the schedule's
+    tick/busy accounting, and — when an MFU is computable — divides it by
+    the busy fraction so pipelined runs stop being under-reported."""
+    acct = pipeline_tick_account("zb", 4, 16)
+    prof = StepProfiler(window=4, tokens_per_step=1000,
+                        flops_per_token=1e6, pipeline_account=acct)
+    for _ in range(2):
+        prof.begin_step()
+        time.sleep(0.005)
+        prof.mark("device")
+        prof.end_step()
+    s = prof.summary()
+    pipe = s["pipeline"]
+    assert pipe["schedule"] == "zb"
+    assert pipe["ticks"] == acct["ticks"]
+    assert pipe["busy_fraction"] == pytest.approx(acct["busy_fraction"], abs=1e-4)
+    assert pipe["bubble_fraction"] == pytest.approx(1 - pipe["busy_fraction"], abs=1e-3)
+    # On the CPU test mesh mfu is None → no adjusted figure either.
+    if s.get("mfu") is not None:
+        assert s["mfu_bubble_adjusted"] == pytest.approx(
+            s["mfu"] / pipe["busy_fraction"], rel=1e-3
+        )
+    else:
+        assert "mfu_bubble_adjusted" not in s
 
 
 def test_trace_session_lifecycle(tmp_path):

@@ -181,6 +181,36 @@ def test_estimate_offload_moves_state_to_host():
     assert any("offloaded" in n for n in off.notes)
 
 
+def test_estimate_is_pipeline_schedule_aware():
+    """Regression: activation residency must follow the SCHEDULE — O(M+P)
+    stage boundary buffers for gpipe vs O(P) for 1f1b/zb — or the
+    admission gate over-rejects 1F1B/ZB gangs that actually fit (and
+    under-charges GPipe at large M)."""
+
+    def est(sched, accum):
+        return estimate_job_hbm(cfg(
+            mesh=MeshConfig(data=1, fsdp=2, pipe=2),
+            gradient_accumulation_steps=accum,
+            pipeline_schedule=sched,
+        ))
+
+    # GPipe's boundary-buffer term grows with the microbatch count; the
+    # manual-vjp schedules' does not (O(P) ring, M-independent).
+    assert est("gpipe", 32).activations_gib > est("gpipe", 4).activations_gib
+    assert est("1f1b", 32).activations_gib == est("1f1b", 4).activations_gib
+    assert est("zb", 32).activations_gib == est("zb", 4).activations_gib
+    # At large M the O(P) schedules project strictly below GPipe; zb pays
+    # only its bounded deferred-W stash on top of the 1f1b ring.
+    assert est("zb", 32).activations_gib < est("gpipe", 32).activations_gib
+    assert est("1f1b", 32).activations_gib <= est("zb", 32).activations_gib
+    # "auto" resolves (M > P → zb) before projecting, same answer.
+    assert est("auto", 32).activations_gib == est("zb", 32).activations_gib
+    assert any("pipeline schedule" in n for n in est("auto", 32).notes)
+    # Non-pipelined configs carry no schedule term or note.
+    flat = estimate_job_hbm(cfg(mesh=MeshConfig(data=1, fsdp=2)))
+    assert not any("pipeline schedule" in n for n in flat.notes)
+
+
 # ---------------------------------------------------------------------------
 # queue order / capacity
 # ---------------------------------------------------------------------------

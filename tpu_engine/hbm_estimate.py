@@ -34,6 +34,7 @@ from tpu_engine.sharding import (
     ShardingStage,
     TPUTrainConfig,
     dtype_of,
+    resolve_pipeline_schedule,
 )
 
 _GIB = 2**30
@@ -207,6 +208,31 @@ def estimate_job_hbm(
         act_dev = bsz * seq * d_model * layers_per_stage * compute_b + layer_ws
     else:
         act_dev = layer_ws * layers_per_stage
+
+    # Pipelined jobs additionally hold stage boundary buffers whose count
+    # is set by the SCHEDULE, not the model: GPipe-by-autodiff saves one
+    # [B,S,D] carry per forward tick — O(M + P) buffers — while the
+    # manual-vjp schedules (1f1b/zb) bound residency at the 2(P-1)+1-slot
+    # ring plus the two lane buffers, O(P) independent of the microbatch
+    # count (zb adds its P-1-entry deferred-W cotangent stash). Ignoring
+    # this term (the pre-schedule-aware behaviour) under-charges GPipe at
+    # large M and — worse for utilisation — makes 1F1B/ZB gangs look as
+    # expensive as GPipe, so the admission gate over-rejects jobs that fit.
+    if m.pipe > 1:
+        sched = resolve_pipeline_schedule(config)
+        M = config.gradient_accumulation_steps
+        boundary = bsz * seq * d_model * compute_b
+        if sched == "gpipe":
+            n_bufs = M + m.pipe - 1
+        else:
+            n_bufs = (2 * (m.pipe - 1) + 1) + 2  # ring + fwd/bwd lane bufs
+            if sched == "zb":
+                n_bufs += m.pipe - 1  # deferred-W stash
+        act_dev += n_bufs * boundary
+        notes.append(
+            f"pipeline schedule {sched}: {n_bufs} stage boundary "
+            f"buffers/device ({'O(M+P)' if sched == 'gpipe' else 'O(P)'})"
+        )
 
     # fp32 logits for the loss: the [B, S_chunk, V] tensor (often dominant
     # for small models / large vocabs); chunked loss bounds S_chunk.

@@ -31,6 +31,7 @@ from pydantic import BaseModel, Field
 from tpu_engine import comm, quant_train
 from tpu_engine import scheduler as scheduler_mod
 from tpu_engine.mesh_runtime import MESH_AXES
+from tpu_engine.parallel import pipeline_zb
 from tpu_engine.scheduler import FleetScheduler, JobPriority, QuotaExceeded
 from tpu_engine.models import transformer as tfm
 from tpu_engine.sharding import (
@@ -41,6 +42,7 @@ from tpu_engine.sharding import (
     opt_state_pspecs,
     param_pspecs,
     presets as config_presets,
+    resolve_pipeline_schedule,
 )
 from tpu_engine.supervisor import JobStatus, TrainingJob
 
@@ -156,7 +158,20 @@ class TPULauncher:
             },
             "mesh": {"shape": mesh_shape, "note": mesh_note, "axes_order_note":
                      "outer→inner = DCN-most→ICI-most: " + str(MESH_AXES)},
-            "pipeline_schedule": config.pipeline_schedule,
+            "pipeline_schedule": {
+                "configured": config.pipeline_schedule,
+                "resolved": resolve_pipeline_schedule(config),
+                # Analytic per-stage tick/busy-lane account for the
+                # resolved schedule (None off the pipelined path).
+                "tick_account": (
+                    pipeline_zb.schedule_account(
+                        resolve_pipeline_schedule(config),
+                        config.mesh.pipe,
+                        config.gradient_accumulation_steps,
+                    )
+                    if config.mesh.pipe > 1 else None
+                ),
+            },
             "sharding": {
                 "stage": int(stage),
                 "stage_name": ShardingStage(stage).name,

@@ -46,6 +46,21 @@ MESH_AXES = ("data", "fsdp", "pipe", "sequence", "model")
 BATCH_AXES = ("data", "fsdp")
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs):
+    """``jax.shard_map`` across jax versions: top-level export with
+    ``check_vma`` (new) vs ``jax.experimental.shard_map`` with
+    ``check_rep`` (old). Replication checking is off either way — the
+    kernel call sites here all return fully sharded outputs, which the
+    checker cannot verify through a Pallas call."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(f, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=False)
+
+
 class MeshConfig(BaseModel):
     """Shape of the logical device mesh.
 

@@ -464,8 +464,9 @@ def _attention(q, k, v, impl: str, mesh=None, window: int = 0):
         # flash path — build_train_program routes it to ring/ulysses).
         from functools import partial
 
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
+
+        from tpu_engine.mesh_runtime import shard_map_compat
 
         model_size = mesh.shape.get("model", 1)
         H, KV = q.shape[2], k.shape[2]
@@ -487,13 +488,12 @@ def _attention(q, k, v, impl: str, mesh=None, window: int = 0):
             # mode) rather than silently testing the XLA fallback — that
             # would be a *different* backward graph than the one that ships.
             interpret = mesh.devices.flat[0].platform != "tpu"
-            fn = shard_map(
+            fn = shard_map_compat(
                 partial(flash_attention.mha, causal=True, window=window,
                         interpret=interpret),
                 mesh=mesh,
                 in_specs=(spec, spec, spec),
                 out_specs=spec,
-                check_vma=False,
             )
             return jax.lax.with_sharding_constraint(fn(q, k, v), sh)
         # GQA ratio would change per-shard (wrong kv mapping) — XLA path.

@@ -30,6 +30,9 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# Renamed TPUCompilerParams -> CompilerParams across jax releases.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 _NEG_INF = -1e30
 
 
@@ -251,7 +254,7 @@ def _flash_fwd(q, k, v, block: int, interpret: bool, window: int,
             pltpu.VMEM((block, D), jnp.float32),    # output accumulator
             pltpu.VMEM((block, D), q.dtype),        # scale·log2e-folded Q
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -430,7 +433,7 @@ def _flash_bwd(block: int, interpret: bool, window: int, res, do,
         out_specs=qkv_spec,
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bb, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -472,7 +475,7 @@ def _flash_bwd(block: int, interpret: bool, window: int, res, do,
             pltpu.VMEM((bb, D), jnp.float32),
             pltpu.VMEM((bb, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -33,7 +33,7 @@ import jax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from tpu_engine.mesh_runtime import BATCH_AXES
+from tpu_engine.mesh_runtime import BATCH_AXES, shard_map_compat
 from tpu_engine.ops import flash_attention
 
 
@@ -93,7 +93,7 @@ def ulysses_mha(
     # — not the XLA fallback's different backward graph.
     on_tpu = mesh.devices.flat[0].platform == "tpu"
     spec = P(BATCH_AXES, axis_name, "model", None)
-    f = jax.shard_map(
+    f = shard_map_compat(
         partial(
             _ulysses_local,
             axis_name=axis_name,
@@ -103,6 +103,5 @@ def ulysses_mha(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_vma=False,
     )
     return f(q, k, v)

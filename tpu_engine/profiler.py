@@ -78,6 +78,24 @@ def mfu(
     return flops_per_token * tokens_per_sec_per_chip / peak
 
 
+def pipeline_tick_account(
+    schedule: str, n_stages: int, microbatches: int
+) -> Optional[dict[str, Any]]:
+    """Analytic tick / busy-lane account for a pipelined run, or None off
+    the pipelined path (``n_stages <= 1``).
+
+    Thin re-export of ``tpu_engine.parallel.pipeline_zb.schedule_account``
+    so profiler consumers (supervisor telemetry, bench.py) don't import the
+    schedule module directly. ``busy_fraction`` is useful lane F-units over
+    total lane F-units — see the schedule module for the cost model.
+    """
+    if n_stages <= 1:
+        return None
+    from tpu_engine.parallel.pipeline_zb import schedule_account
+
+    return schedule_account(schedule, n_stages, microbatches)
+
+
 class StepProfiler:
     """Rolling wall-clock breakdown of the train loop's phases.
 
@@ -91,11 +109,19 @@ class StepProfiler:
     PHASES = ("data", "dispatch", "device", "other")
 
     def __init__(self, window: int = 100, tokens_per_step: Optional[int] = None,
-                 flops_per_token: Optional[float] = None, n_devices: int = 1):
+                 flops_per_token: Optional[float] = None, n_devices: int = 1,
+                 pipeline_account: Optional[dict[str, Any]] = None):
         self.window = window
         self.tokens_per_step = tokens_per_step
         self.flops_per_token = flops_per_token
         self.n_devices = max(n_devices, 1)
+        # Analytic schedule account for pipelined runs (from
+        # pipeline_tick_account): enables bubble-adjusted MFU — raw MFU
+        # divided by the schedule's busy-lane fraction, i.e. utilisation of
+        # the lanes the schedule actually keeps busy. Without it RESULTS.md
+        # under-reports pipelined MFU: the bubble is a schedule property,
+        # not a kernel-efficiency loss.
+        self.pipeline_account = pipeline_account
         self._phases: dict[str, deque[float]] = {p: deque(maxlen=window) for p in self.PHASES}
         self._totals: deque[float] = deque(maxlen=window)
         self._steps_seen = 0
@@ -176,6 +202,19 @@ class StepProfiler:
             if self.flops_per_token:
                 u = mfu(self.flops_per_token, tps / self.n_devices)
                 out["mfu"] = round(u, 4) if u is not None else None
+        if self.pipeline_account is not None:
+            acct = self.pipeline_account
+            busy = acct.get("busy_fraction", 1.0) or 1.0
+            out["pipeline"] = {
+                "schedule": acct.get("schedule"),
+                "n_stages": acct.get("n_stages"),
+                "microbatches": acct.get("microbatches"),
+                "ticks": acct.get("ticks"),
+                "busy_fraction": round(busy, 4),
+                "bubble_fraction": round(acct.get("bubble_fraction", 0.0), 4),
+            }
+            if out.get("mfu") is not None:
+                out["mfu_bubble_adjusted"] = round(out["mfu"] / busy, 4)
         return out
 
 

@@ -407,12 +407,21 @@ class TPUTrainConfig(BaseModel):
     # buffers); "1f1b" = interleaved one-forward-one-backward with manual
     # per-stage vjp — activation residency O(P) ring slots per stage, the
     # schedule that lets microbatch counts grow without activation blowup
-    # (tpu_engine/parallel/pipeline_1f1b.py). "auto" (default) picks 1f1b
-    # exactly where it wins — microbatch count above the stage count, so
-    # the O(P) residency frees real memory and the warmup/drain overhead
-    # is amortised — and gpipe otherwise (measured: benchmarks/RESULTS.md
-    # §Pipeline; resolution in train.build_train_program).
-    pipeline_schedule: Literal["auto", "gpipe", "1f1b"] = "auto"
+    # (tpu_engine/parallel/pipeline_1f1b.py); "zb" = zero-bubble variant of
+    # 1f1b that splits the backward into B (input-cotangent) and W (weight
+    # gradient) phases and retires deferred W in the warmup/drain lanes
+    # 1f1b burns as masked compute — same O(P) residency plus a bounded
+    # P-1-entry stash, strictly less bubble compute per step
+    # (tpu_engine/parallel/pipeline_zb.py). "auto" (default) picks zb
+    # exactly where the O(P)-residency schedules win — microbatch count
+    # above the stage count, so the residency bound frees real memory and
+    # the warmup/drain overhead is amortised — and gpipe otherwise
+    # (measured: benchmarks/RESULTS.md §Pipeline; resolution in
+    # resolve_pipeline_schedule below, shared by train/launcher/HBM
+    # admission). zb and 1f1b share one interaction matrix: both reject
+    # comm compression, quant_training, reduced grad_allreduce_dtype and
+    # loss_chunk_size when explicit, and "auto" degrades to gpipe.
+    pipeline_schedule: Literal["auto", "gpipe", "1f1b", "zb"] = "auto"
 
     # Elasticity (reference :78,226-238): TPU slices are fixed-shape, so
     # elasticity means re-launch at a new mesh shape + resume from checkpoint.
@@ -541,10 +550,11 @@ class TPUTrainConfig(BaseModel):
                 "all-gather replaces the ZeRO-3 fsdp weight gather; stages "
                 "0-2 keep params replicated and gather nothing)"
             )
-        if self.pipeline_schedule == "1f1b":
+        if self.pipeline_schedule in ("1f1b", "zb"):
             raise ValueError(
-                "comm compression with pipeline_schedule='1f1b' is not "
-                "supported (the manual 1f1b vjp owns the grad collectives)"
+                f"comm compression with pipeline_schedule="
+                f"{self.pipeline_schedule!r} is not supported (the manual "
+                "per-stage vjp owns the grad collectives)"
             )
         if self.grad_allreduce_dtype not in (None, Precision.FP32):
             raise ValueError(
@@ -616,12 +626,13 @@ class TPUTrainConfig(BaseModel):
                 "stochastic-rounding noise on the frozen base would leak "
                 "into merge-time semantics — fine-tune in bf16"
             )
-        if self.pipeline_schedule == "1f1b":
+        if self.pipeline_schedule in ("1f1b", "zb"):
             raise ValueError(
-                "quant_training='int8' with pipeline_schedule='1f1b' is "
-                "unsupported (the manual per-stage vjp bypasses the "
-                "quantized primitive's custom backward); use 'gpipe' or "
-                "'auto' (auto falls back to gpipe under quantization)"
+                f"quant_training='int8' with pipeline_schedule="
+                f"{self.pipeline_schedule!r} is unsupported (the manual "
+                "per-stage vjp bypasses the quantized primitive's custom "
+                "backward); use 'gpipe' or 'auto' (auto falls back to "
+                "gpipe under quantization)"
             )
         if self.moe_impl == "ragged" and "moe" in self.quant_train_targets:
             raise ValueError(
@@ -707,6 +718,44 @@ class TPUTrainConfig(BaseModel):
 
     def master_dtype(self):
         return dtype_of(self.param_dtype)
+
+
+def resolve_pipeline_schedule(cfg: TPUTrainConfig) -> str:
+    """Resolve ``pipeline_schedule="auto"`` to a concrete schedule.
+
+    One resolver shared by the train-step builder, the launcher plan and
+    HBM admission (``hbm_estimate``), so "what will this config actually
+    run" has a single answer. Measured A/B in benchmarks/RESULTS.md
+    §Pipeline: at M <= P microbatches the O(P)-residency schedules bound
+    the same memory as GPipe while their masked warmup/drain lanes burn
+    compute, so gpipe wins; at M > P GPipe's O(M) saved stage buffers
+    grow past the ring — on memory-bound configs GPipe simply OOMs where
+    1f1b/zb keep scaling. Of the two manual-vjp schedules zb strictly
+    dominates 1f1b — same O(P) residency (plus a bounded P-1-entry
+    stash), 2(P-1) F-units less bubble compute per stage per step — so
+    auto picks zb; 1f1b stays selectable explicitly.
+
+    Features the manual-vjp schedules do not support (chunked exit loss,
+    quant_training's custom backward, reduced-dtype grad collectives)
+    degrade auto to gpipe, whose plain autodiff handles them all.
+    """
+    if cfg.pipeline_schedule != "auto":
+        return cfg.pipeline_schedule
+    unsupported_manual = (
+        bool(cfg.loss_chunk_size)
+        or cfg.quant_training != "none"
+        or (
+            cfg.grad_allreduce_dtype is not None
+            and cfg.grad_allreduce_dtype != Precision.FP32
+        )
+    )
+    if (
+        cfg.mesh.pipe > 1
+        and cfg.gradient_accumulation_steps > cfg.mesh.pipe
+        and not unsupported_manual
+    ):
+        return "zb"
+    return "gpipe"
 
 
 def presets() -> dict[str, TPUTrainConfig]:

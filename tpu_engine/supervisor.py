@@ -41,7 +41,7 @@ from tpu_engine.loss_monitor import (
 )
 from tpu_engine import telemetry
 from tpu_engine.preemption import PreemptionWatcher
-from tpu_engine.profiler import StepProfiler
+from tpu_engine.profiler import StepProfiler, pipeline_tick_account
 from tpu_engine.sharding import TPUTrainConfig
 from tpu_engine.train import TrainProgram, build_train_program
 
@@ -449,6 +449,11 @@ class TrainingJob:
                 tokens_per_step=tokens_per_batch,
                 flops_per_token=tfm.train_flops_per_token(prog.model_config, self.config.seq_len),
                 n_devices=prog.runtime.n_devices,
+                pipeline_account=pipeline_tick_account(
+                    prog.pipeline_schedule,
+                    prog.runtime.axis_sizes["pipe"],
+                    self.config.gradient_accumulation_steps,
+                ),
             )
             step = start_step
             while step < self.max_steps and not self._stop.is_set():

@@ -35,7 +35,6 @@ from tpu_engine.mesh_runtime import BATCH_AXES, MeshRuntime
 from tpu_engine.models import transformer as tfm
 from tpu_engine.sharding import (
     OffloadDevice,
-    Precision,
     ShardingStage,
     TPUTrainConfig,
     dtype_of,
@@ -44,6 +43,7 @@ from tpu_engine.sharding import (
     named_shardings,
     opt_state_pspecs,
     param_pspecs,
+    resolve_pipeline_schedule,
 )
 
 
@@ -484,34 +484,13 @@ def build_train_program(
             f"model n_layers={model_cfg.n_layers} must be divisible by the "
             f"pipe axis size {pipe_size}"
         )
-    # Schedule auto-selection (measured A/B in benchmarks/RESULTS.md
-    # §Pipeline): at M <= P microbatches 1F1B's residency bound equals
-    # GPipe's while its masked warmup/drain lanes burn compute (~8% slower
-    # at equal M), so gpipe wins; at M > P GPipe's O(M) saved stage buffers
-    # grow past 1F1B's O(P) ring — on memory-bound configs GPipe simply
-    # OOMs (llama-7b pipe=4 M=16 on v5e:4x4) where 1F1B keeps scaling and
-    # its per-sample time overtakes GPipe's best feasible M. Features the
-    # manual-vjp schedule does not support fall back to gpipe.
-    pipe_schedule = cfg.pipeline_schedule
-    if pipe_schedule == "auto":
-        # quant_training: the manual 1f1b per-stage vjp would bypass
-        # int8_einsum's custom backward — auto degrades to gpipe, whose
-        # plain autodiff differentiates through the custom_vjp.
-        unsupported_1f1b = (
-            bool(cfg.loss_chunk_size)
-            or cfg.quant_training != "none"
-            or (
-                cfg.grad_allreduce_dtype is not None
-                and cfg.grad_allreduce_dtype != Precision.FP32
-            )
-        )
-        pipe_schedule = (
-            "1f1b"
-            if pipe_size > 1
-            and cfg.gradient_accumulation_steps > pipe_size
-            and not unsupported_1f1b
-            else "gpipe"
-        )
+    # Schedule auto-selection lives in sharding.resolve_pipeline_schedule
+    # (one resolver shared with the launcher plan and HBM admission):
+    # auto → zb at M > P when the manual-vjp schedules support the config
+    # (no chunked exit loss, no quant_training custom backward, no
+    # reduced-dtype grad collectives), gpipe otherwise. Measured A/B in
+    # benchmarks/RESULTS.md §Pipeline.
+    pipe_schedule = resolve_pipeline_schedule(cfg)
     if cfg.loss_chunk_size and cfg.seq_len % cfg.loss_chunk_size != 0:
         raise ValueError(
             f"loss_chunk_size={cfg.loss_chunk_size} must divide seq_len={cfg.seq_len}"
@@ -960,20 +939,33 @@ def build_train_program(
 
         pipe_grad_fn = jax.value_and_grad(pipe_loss_fn)
 
-        if pipe_schedule == "1f1b":
-            # Interleaved 1F1B with manual per-stage vjp: O(P) in-flight
-            # stage inputs instead of GPipe-by-autodiff's O(M + P) saved
-            # boundary buffers (tpu_engine/parallel/pipeline_1f1b.py).
-            # Gradients are assembled manually — no jax.grad above this.
+        if pipe_schedule in ("1f1b", "zb"):
+            # Manual per-stage-vjp schedules: O(P) in-flight stage inputs
+            # instead of GPipe-by-autodiff's O(M + P) saved boundary
+            # buffers. "1f1b" interleaves one forward and one combined
+            # backward per tick (tpu_engine/parallel/pipeline_1f1b.py);
+            # "zb" additionally splits the drain backwards into B/W phases
+            # and retires deferred weight gradients in lanes 1f1b burns as
+            # masked bubble compute (tpu_engine/parallel/pipeline_zb.py).
+            # Both take the same arguments and return the same gradient
+            # pieces — the schedules are pure reorderings of the same
+            # per-stage vjps. Gradients are assembled manually — no
+            # jax.grad above this.
             if cfg.loss_chunk_size:
                 raise ValueError(
-                    "loss_chunk_size is not supported with "
-                    "pipeline_schedule='1f1b' (the exit loss runs inside "
-                    "the schedule's scan)"
+                    f"loss_chunk_size is not supported with "
+                    f"pipeline_schedule={pipe_schedule!r} (the exit loss "
+                    "runs inside the schedule's scan)"
                 )
             from tpu_engine.parallel.pipeline_1f1b import pipeline_1f1b_grads
+            from tpu_engine.parallel.pipeline_zb import pipeline_zb_grads
 
-            def pipe_grad_fn(params, raw_batch):  # noqa: F811 — 1f1b override
+            schedule_grads = (
+                pipeline_zb_grads if pipe_schedule == "zb"
+                else pipeline_1f1b_grads
+            )
+
+            def pipe_grad_fn(params, raw_batch):  # noqa: F811 — manual-vjp override
                 batch, loss_batch, positions, staged_of, denom = (
                     _pipe_prologue(raw_batch)
                 )
@@ -1007,7 +999,7 @@ def build_train_program(
                     model_cfg.router_aux_coef / (model_cfg.n_layers * accum)
                     if model_cfg.is_moe else 0.0
                 )
-                loss_sum, aux_sum, dstaged, d_outer, dx_mb = pipeline_1f1b_grads(
+                loss_sum, aux_sum, dstaged, d_outer, dx_mb = schedule_grads(
                     staged, x_mb, loss_batch, model_cfg,
                     positions=positions, exit_fn=exit_fn,
                     outer_grad_zero=outer_zero, mesh=attn_mesh,
@@ -1056,13 +1048,13 @@ def build_train_program(
         else None
     )
     reduced_comm = comm_dtype is not None and comm_dtype != jnp.float32
-    if reduced_comm and pipe_size > 1 and pipe_schedule == "1f1b":
+    if reduced_comm and pipe_size > 1 and pipe_schedule in ("1f1b", "zb"):
         raise ValueError(
-            "grad_allreduce_dtype with pipeline_schedule='1f1b' is not "
-            "supported: the manual-vjp schedule accumulates gradients in "
-            "fp32 inside its scan, so the reduced-dtype collective the "
-            "option exists for would never materialise (use 'gpipe', or "
-            "drop grad_allreduce_dtype)"
+            f"grad_allreduce_dtype with pipeline_schedule="
+            f"{pipe_schedule!r} is not supported: the manual-vjp schedule "
+            "accumulates gradients in fp32 inside its scan, so the "
+            "reduced-dtype collective the option exists for would never "
+            "materialise (use 'gpipe', or drop grad_allreduce_dtype)"
         )
     if reduced_comm and offload_params:
         raise ValueError(
