@@ -20,6 +20,7 @@ from aiohttp import web
 from backend import openapi
 from backend.http import cors_middleware, error_middleware, json_response
 from backend.routers import (
+    faults,
     metrics,
     monitoring,
     profiling,
@@ -62,6 +63,10 @@ async def root(request: web.Request) -> web.Response:
                 "Orbax checkpointing with stable-pointer rollback, auto-resume, "
                 "and elastic cross-mesh restore",
                 "preemption watcher with emergency checkpoint",
+                "deterministic fault injection (chip/host/checkpoint/"
+                "telemetry/preemption) and self-healing elastic recovery: "
+                "detect -> emergency save -> shrink mesh -> resume, with "
+                "grow-back when chips recover",
                 "fleet scheduler: priority+FIFO queue, HBM-aware gang "
                 "admission against healthy chips, checkpoint-preempt-"
                 "requeue, backfill, per-submitter quotas, drain",
@@ -79,6 +84,8 @@ async def root(request: web.Request) -> web.Response:
                 "tpu": "/api/v1/tpu",
                 "training": "/api/v1/training",
                 "scheduler": "/api/v1/scheduler",
+                "faults": "/api/v1/faults",
+                "recovery": "/api/v1/recovery",
                 "monitoring": "/api/v1/monitoring",
                 "topology": "/api/v1/topology",
                 "profile": "/api/v1/profile",
@@ -114,6 +121,7 @@ def create_app() -> web.Application:
     tpu.setup(app)
     training.setup(app)
     scheduler.setup(app)
+    faults.setup(app)
     monitoring.setup(app)
     topology.setup(app)
     profiling.setup(app)

@@ -1,0 +1,157 @@
+"""Fault-injection + recovery-status routes.
+
+The chaos-engineering surface over :mod:`tpu_engine.faults` and the
+self-healing supervisor/scheduler seams: arm a seeded fault plan in the
+running control plane, watch the structured :class:`FaultEvent` log, heal
+chips, and read the recovery state machine of every job (detected → saving
+→ saved → shrunk re-admission) plus the scheduler's elastic counters.
+"""
+
+from __future__ import annotations
+
+from typing import Literal, Optional
+
+from aiohttp import web
+from pydantic import BaseModel, Field
+
+from backend import state
+from backend.http import ApiError, json_response, parse_body
+from backend.openapi import body
+from tpu_engine import faults
+from tpu_engine.faults import FaultInjector, FaultPlan, FaultSpec
+
+
+class FaultSpecRequest(BaseModel):
+    kind: Literal[
+        "chip-unhealthy",
+        "host-slow",
+        "checkpoint-save-ioerror",
+        "checkpoint-restore-corruption",
+        "telemetry-nan",
+        "preemption-signal",
+    ]
+    at_step: Optional[int] = Field(default=None, ge=0)
+    after_s: Optional[float] = Field(default=None, ge=0.0)
+    device_index: Optional[int] = Field(default=None, ge=0)
+    count: int = Field(default=1, ge=1)
+    duration_steps: Optional[int] = Field(default=None, ge=1)
+    slow_s: float = Field(default=0.5, ge=0.0)
+
+
+class FaultInjectRequest(BaseModel):
+    """Arm faults in this process. ``faults`` lists explicit specs;
+    ``random_seed``/``random_n`` instead samples a reproducible random plan
+    (the chaos-trace entry point)."""
+
+    faults: list[FaultSpecRequest] = Field(default_factory=list)
+    seed: int = 0
+    random_n: Optional[int] = Field(default=None, ge=1, le=64)
+    random_max_step: int = Field(default=50, ge=1)
+
+
+class HealRequest(BaseModel):
+    device_index: int = Field(ge=0)
+
+
+@body(FaultInjectRequest)
+async def inject(request: web.Request) -> web.Response:
+    req = await parse_body(request, FaultInjectRequest)
+    if not req.faults and req.random_n is None:
+        raise ApiError(400, "provide explicit 'faults' or 'random_n' for a seeded plan")
+    try:
+        if req.random_n is not None:
+            fleet = state.manager.get_fleet_status()
+            plan = FaultPlan.random(
+                req.seed,
+                n_faults=req.random_n,
+                max_step=req.random_max_step,
+                n_devices=max(1, fleet.total_devices),
+            )
+            specs = plan.specs
+        else:
+            specs = [FaultSpec(**f.model_dump()) for f in req.faults]
+    except ValueError as e:
+        raise ApiError(400, str(e))
+    injector = faults.get_active()
+    if injector is None:
+        injector = FaultInjector(FaultPlan(seed=req.seed))
+        injector.arm()
+        faults.set_active(injector)
+    injector.extend(specs)
+    return json_response(injector.describe_full(), status=202)
+
+
+async def status(request: web.Request) -> web.Response:
+    injector = faults.get_active()
+    return json_response(
+        {"armed": injector is not None}
+        | (injector.describe_full() if injector is not None else {})
+    )
+
+
+@body(HealRequest)
+async def heal(request: web.Request) -> web.Response:
+    req = await parse_body(request, HealRequest)
+    injector = faults.get_active()
+    if injector is None:
+        raise ApiError(409, "no fault plan armed")
+    healed = injector.heal(req.device_index)
+    return json_response({"device_index": req.device_index, "healed_faults": healed})
+
+
+async def clear(request: web.Request) -> web.Response:
+    was_armed = faults.get_active() is not None
+    faults.clear_active()
+    return json_response({"armed": False, "was_armed": was_armed})
+
+
+async def recovery(request: web.Request) -> web.Response:
+    """Recovery pipeline view: scheduler elastic/self-heal counters plus
+    the per-job recovery state machine for every job that has one."""
+    sched = state.scheduler
+    st = sched.stats()
+    jobs = []
+    for job in state.launcher.list_jobs():
+        if (
+            job.get("recovery_state") is not None
+            or job.get("recovery_events")
+            or job.get("elastic_mesh") is not None
+        ):
+            jobs.append(
+                {
+                    "job_id": job["job_id"],
+                    "status": job["status"],
+                    "current_step": job["current_step"],
+                    "resumed_from_step": job["resumed_from_step"],
+                    "elastic_mesh": job["elastic_mesh"],
+                    "preemption_reason": job["preemption_reason"],
+                    "recovery_state": job["recovery_state"],
+                    "recovery_events": job["recovery_events"],
+                    "unhealthy_devices": job["unhealthy_devices"],
+                }
+            )
+    injector = faults.get_active()
+    return json_response(
+        {
+            "scheduler": {
+                "self_heal_requeues_total": st["self_heal_requeues_total"],
+                "elastic_shrinks_total": st["elastic_shrinks_total"],
+                "grow_backs_total": st["grow_backs_total"],
+                "running_shrunk": st["running_shrunk"],
+                "requeues_total": st["requeues_total"],
+                "preemptions_total": st["preemptions_total"],
+            },
+            "jobs": jobs,
+            "fault_injection": (
+                injector.describe_full() if injector is not None else {"armed": False}
+            ),
+        }
+    )
+
+
+def setup(app: web.Application, prefix: str = "/api/v1/faults") -> None:
+    app.router.add_post(f"{prefix}/inject", inject)
+    app.router.add_get(prefix, status)
+    app.router.add_post(f"{prefix}/heal", heal)
+    app.router.add_delete(prefix, clear)
+    app.router.add_get("/api/v1/recovery", recovery)

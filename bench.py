@@ -177,6 +177,9 @@ def main() -> None:
     pipe_line = _pipeline_schedule_metric(n_dev)
     if pipe_line is not None:
         print(json.dumps(pipe_line))
+    chaos_line = _chaos_metric()
+    if chaos_line is not None:
+        print(json.dumps(chaos_line))
 
 
 def _comm_compress_metric(n_dev: int) -> dict | None:
@@ -312,6 +315,29 @@ def _scheduler_metric() -> dict | None:
             "serial_mean_wait_s": trace["serial_mean_wait_s"],
             "preemptions": trace["preemptions"],
             "zero_lost_work": trace["zero_lost_work"],
+        }
+    except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
+        return None
+
+
+def _chaos_metric() -> dict | None:
+    """Sixth JSON line: goodput under a seeded chip-fault trace — the
+    self-healing detect->save->shrink->resume path vs the reference's
+    die-and-restart (benchmarks/chaos.py, deterministic virtual clock).
+    Never fails the bench: any error degrades to None."""
+    try:
+        from benchmarks.chaos import run_trace
+
+        trace = run_trace(seed=0)
+        return {
+            "metric": "chaos_goodput_self_heal_vs_die_restart",
+            "value": trace["goodput_improvement"],
+            "unit": "x goodput under faults (die-and-restart = 1.0)",
+            "mttr_reduction": trace["mttr_reduction"],
+            "mttr_mean_s": trace["self_heal"]["mttr_mean_s"],
+            "baseline_mttr_mean_s": trace["die_and_restart"]["mttr_mean_s"],
+            "steps_saved": trace["steps_saved"],
+            "zero_lost_steps": trace["self_heal"]["lost_steps"] == 0,
         }
     except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
         return None

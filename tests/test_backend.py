@@ -1037,3 +1037,71 @@ def test_quantized_snapshot_export_and_serve(client, tmp_path):
         assert body["tokens"] == np.asarray(ref)[0, len(prompt):].tolist()
     finally:
         client.post("/api/v1/serving/stop")
+
+
+# -- fault injection + recovery ---------------------------------------------
+
+
+def test_faults_inject_status_heal_clear(client):
+    from tpu_engine import faults as faults_mod
+
+    try:
+        # Nothing armed yet.
+        assert client.get("/api/v1/faults").json()["armed"] is False
+        # Neither explicit specs nor a random plan → 400.
+        assert client.post("/api/v1/faults/inject", json={}).status_code == 400
+        # A chip fault without a device_index → 400 from spec validation.
+        r = client.post("/api/v1/faults/inject", json={
+            "faults": [{"kind": "chip-unhealthy", "at_step": 3}],
+        })
+        assert r.status_code == 400
+        # Valid plan arms the process-wide injector.
+        r = client.post("/api/v1/faults/inject", json={
+            "faults": [
+                {"kind": "chip-unhealthy", "at_step": 3, "device_index": 5},
+                {"kind": "host-slow", "at_step": 2, "slow_s": 1.5},
+            ],
+            "seed": 11,
+        })
+        assert r.status_code == 202, r.text
+        body = r.json()
+        assert body["armed"] is True and len(body["specs"]) == 2
+        assert faults_mod.get_active() is not None
+        # Status reflects the armed plan; heal is recorded.
+        assert client.get("/api/v1/faults").json()["armed"] is True
+        r = client.post("/api/v1/faults/heal", json={"device_index": 5})
+        assert r.status_code == 200
+        assert r.json()["healed_faults"] == 1
+        # Clear disarms.
+        assert client.delete("/api/v1/faults").json()["was_armed"] is True
+        assert faults_mod.get_active() is None
+        assert client.post(
+            "/api/v1/faults/heal", json={"device_index": 5}
+        ).status_code == 409
+    finally:
+        faults_mod.clear_active()
+
+
+def test_recovery_endpoint_and_fault_metrics(client):
+    from tpu_engine import faults as faults_mod
+
+    try:
+        r = client.get("/api/v1/recovery")
+        assert r.status_code == 200
+        body = r.json()
+        for key in ("self_heal_requeues_total", "elastic_shrinks_total",
+                    "grow_backs_total", "running_shrunk"):
+            assert key in body["scheduler"]
+        assert body["fault_injection"]["armed"] is False
+        # Arm a plan: the Prometheus plane picks it up.
+        client.post("/api/v1/faults/inject", json={
+            "faults": [{"kind": "telemetry-nan", "at_step": 1,
+                        "device_index": 0}],
+        })
+        text = client.get("/metrics").text
+        assert "tpu_engine_fault_injection_armed 1.0" in text
+        assert "tpu_engine_fault_specs_active 1.0" in text
+        assert "tpu_engine_recovery_self_heal_requeues_total" in text
+        assert "tpu_engine_recovery_running_shrunk_jobs" in text
+    finally:
+        faults_mod.clear_active()

@@ -1,0 +1,213 @@
+"""Chaos round trip: inject chip fault → detect → emergency-save → shrink → resume.
+
+The acceptance test for the self-healing path. Fast tier: a tiny CPU-mesh
+job loses a chip at step 3 and must finish on a shrunk mesh with zero steps
+lost beyond the emergency save. Slow tier: per-step **loss parity** — after
+the shrink the resumed run must reproduce the uninterrupted run's losses,
+because the elastic re-admission preserves the declared effective batch
+(accum scales up as dp shrinks) and the data is keyed by global row index.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_engine import faults
+from tpu_engine import scheduler as scheduler_mod
+from tpu_engine.faults import FaultKind, FaultPlan, FaultSpec
+from tpu_engine.mesh_runtime import MeshConfig
+from tpu_engine.scheduler import FleetScheduler, SubmissionState
+from tpu_engine.sharding import TPUTrainConfig
+from tpu_engine.supervisor import JobStatus, TrainingJob
+from tpu_engine.tpu_manager import TPUManager
+
+
+@pytest.fixture(autouse=True)
+def _no_process_injector():
+    faults.clear_active()
+    yield
+    faults.clear_active()
+
+
+def chaos_cfg(tmp, **kw) -> TPUTrainConfig:
+    base = dict(
+        model_name="gpt-tiny",
+        mesh=MeshConfig(data=4, fsdp=2),
+        micro_batch_size=1,
+        gradient_accumulation_steps=1,
+        seq_len=32,
+        precision="fp32",
+        total_steps=6,
+        activation_checkpointing=False,
+        checkpoint_dir=str(tmp / "ckpt"),
+        checkpoint_interval_steps=2,
+        elastic_min_devices=2,
+        log_every_steps=1,
+    )
+    base.update(kw)
+    return TPUTrainConfig(**base)
+
+
+def test_chaos_round_trip_shrink_and_resume(tmp_path):
+    """Chip 5 dies at step 3 → emergency save @3 → requeue → re-admit on a
+    data=3 × fsdp=2 mesh over the 6 pinned healthy chips → resume from 3 →
+    complete step 6. Zero steps lost beyond the emergency save."""
+    mgr = TPUManager()
+    inj = faults.activate(FaultPlan(seed=1, specs=[
+        FaultSpec(kind=FaultKind.CHIP_UNHEALTHY, at_step=3, device_index=5),
+    ]))
+    jobs = []
+
+    def factory(sub):
+        job = scheduler_mod._default_job_factory(sub)
+        jobs.append(job)
+        return job
+
+    sched = FleetScheduler(
+        max_concurrent_jobs=1, fleet_fn=mgr.get_fleet_status,
+        job_factory=factory, poll_interval_s=0.05,
+    )
+    try:
+        sub = sched.submit(chaos_cfg(tmp_path), job_kwargs={"auto_rollback": False})
+        sub = sched.wait(sub.submission_id, timeout=600)
+        assert sub.state == SubmissionState.COMPLETED
+
+        # Attempt 1: detected the injected fault, emergency-saved, preempted.
+        first, second = jobs
+        assert first.status == JobStatus.PREEMPTED
+        assert first.preemption_reason.startswith("self-heal: unhealthy device(s) [5]")
+        assert first.recovery_state == "saved"
+        assert first.unhealthy_devices == [5]
+        assert first.current_step == 3
+        kinds = [e["kind"] for e in first.recovery_events]
+        assert kinds[0] == "detected"
+        assert "saved" in kinds
+
+        # Attempt 2: shrunk admission on the healthy remainder, zero lost steps.
+        assert sub.admitted_gang == 6
+        assert sub.shrunk_mesh["data"] == 3 and sub.shrunk_mesh["fsdp"] == 2
+        assert second.resumed_from_step == 3  # exactly the emergency save
+        assert second.current_step == 6
+        assert second.elastic_mesh["data"] == 3
+        assert second.status == JobStatus.COMPLETED
+
+        # Scheduler counters tell the same story.
+        st = sched.stats()
+        assert st["self_heal_requeues_total"] == 1
+        assert st["elastic_shrinks_total"] == 1
+        assert st["requeues_total"] == 1
+
+        # Structured event log: activation precedes detection.
+        ev = [(e.kind, e.step) for e in inj.events]
+        assert ("chip-unhealthy", 3) in ev
+        assert ev.index(("chip-unhealthy", 3)) < ev.index(("recovery:detected", 3))
+    finally:
+        sched.shutdown()
+
+
+def _row_data_fn(accum: int, rows: int, seq: int, vocab_cap: int = 97):
+    """Batches keyed by (step, global row): mesh-shape independent content.
+
+    Row ``g`` of step ``s`` holds the same tokens whether the global batch
+    is laid out (3 accum × 8 rows) or (4 accum × 6 rows) — the flattened
+    a-major order is identical, so losses must match across the resize.
+    """
+    def data_fn(step: int) -> jax.Array:
+        n = accum * rows
+        out = np.empty((n, seq), np.int64)
+        for g in range(n):
+            rng = np.random.default_rng(977 * step + g + 1)
+            out[g] = rng.integers(0, vocab_cap, size=seq)
+        return jnp.asarray(out.astype(np.int32).reshape(accum, rows, seq))
+    return data_fn
+
+
+def _train_losses(path: str) -> dict[int, float]:
+    import json
+
+    losses: dict[int, float] = {}
+    with open(path) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("kind") == "train":
+                losses[int(rec["step"])] = float(rec["loss"])
+    return losses
+
+
+@pytest.mark.slow
+def test_loss_parity_across_elastic_shrink(tmp_path):
+    """Per-step losses after the shrink match the uninterrupted run.
+
+    Declared batch: micro 1 × accum 3 × dp 8 (data 4 × fsdp 2) = 24.
+    Shrunk:         micro 1 × accum 4 × dp 6 (data 3 × fsdp 2) = 24 — exact.
+    """
+    total_steps = 8
+    common = dict(
+        total_steps=total_steps,
+        gradient_accumulation_steps=3,
+        checkpoint_interval_steps=50,  # only the emergency save can persist
+    )
+
+    # Baseline: uninterrupted run on the full mesh.
+    base_cfg = chaos_cfg(
+        tmp_path / "base", **common,
+        metrics_log_path=str(tmp_path / "base.jsonl"),
+    )
+    baseline = TrainingJob(
+        "baseline", base_cfg, data_fn=_row_data_fn(3, 8, base_cfg.seq_len),
+        auto_rollback=False,
+    )
+    baseline.start()
+    baseline.join(timeout=600)
+    assert baseline.status == JobStatus.COMPLETED
+    base_losses = _train_losses(str(tmp_path / "base.jsonl"))
+    assert set(base_losses) == set(range(1, total_steps + 1))
+
+    # Chaos run: same data, chip 5 dies at step 3.
+    mgr = TPUManager()
+    faults.activate(FaultPlan(seed=2, specs=[
+        FaultSpec(kind=FaultKind.CHIP_UNHEALTHY, at_step=3, device_index=5),
+    ]))
+    chaos_log = str(tmp_path / "chaos.jsonl")
+    cfg = chaos_cfg(tmp_path / "chaos", **common, metrics_log_path=chaos_log)
+    jobs = []
+
+    def factory(sub):
+        c = sub.config
+        dp_full = c.mesh.data * c.mesh.fsdp
+        declared = c.micro_batch_size * c.gradient_accumulation_steps * dp_full
+        # The scheduler pins devices on a shrunk admission (shrunk_mesh is
+        # recorded only after the factory returns); dp = the pinned count.
+        devices = sub.job_kwargs.get("devices")
+        dp = len(devices) if devices else dp_full
+        rows = c.micro_batch_size * dp
+        accum = -(-declared // rows)
+        assert accum * rows == declared, "parity needs an exact batch split"
+        sub.job_kwargs["data_fn"] = _row_data_fn(accum, rows, c.seq_len)
+        job = scheduler_mod._default_job_factory(sub)
+        jobs.append(job)
+        return job
+
+    sched = FleetScheduler(
+        max_concurrent_jobs=1, fleet_fn=mgr.get_fleet_status,
+        job_factory=factory, poll_interval_s=0.05,
+    )
+    try:
+        sub = sched.submit(cfg, job_kwargs={"auto_rollback": False})
+        sub = sched.wait(sub.submission_id, timeout=600)
+        assert sub.state == SubmissionState.COMPLETED
+        assert jobs[-1].resumed_from_step == 3
+        assert jobs[-1].elastic_mesh["data"] == 3
+    finally:
+        sched.shutdown()
+
+    chaos_losses = _train_losses(chaos_log)
+    assert set(chaos_losses) >= set(range(1, total_steps + 1))
+    for step in range(1, total_steps + 1):
+        assert chaos_losses[step] == pytest.approx(base_losses[step], abs=5e-3), (
+            f"step {step}: chaos {chaos_losses[step]} vs baseline {base_losses[step]}"
+        )
+    # Steps up to the fault ran on the identical mesh — bit-for-bit close;
+    # the post-shrink steps are the ones the tolerance is really for.
+    assert chaos_losses[1] == pytest.approx(base_losses[1], abs=1e-6)

@@ -1,0 +1,337 @@
+"""Fault-injection unit surface: plans, triggers, seams, hardened probes.
+
+The chaos *round trip* (inject → detect → emergency-save → shrink → resume)
+lives in ``test_chaos.py``; this file pins down the deterministic pieces it
+is built from — spec/trigger semantics, the checkpoint save/restore seams,
+the retry+quarantine path, the crash-atomic stable pointer, and the
+metadata-probe/watcher hardening.
+"""
+
+import json
+import os
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_engine import faults
+from tpu_engine.checkpoint import TrainCheckpointManager
+from tpu_engine.faults import (
+    FaultInjector,
+    FaultKind,
+    FaultPlan,
+    FaultSpec,
+)
+from tpu_engine.preemption import PreemptionWatcher, probe_gce_preempted
+
+
+@pytest.fixture(autouse=True)
+def _no_process_injector():
+    """Each test arms its own injector explicitly; never leak one."""
+    faults.clear_active()
+    yield
+    faults.clear_active()
+
+
+# ---------------------------------------------------------------------------
+# plans and specs
+# ---------------------------------------------------------------------------
+
+
+def test_random_plan_is_reproducible():
+    a = FaultPlan.random(seed=7, n_faults=8)
+    b = FaultPlan.random(seed=7, n_faults=8)
+    assert [s.model_dump() for s in a.specs] == [s.model_dump() for s in b.specs]
+    c = FaultPlan.random(seed=8, n_faults=8)
+    assert [s.model_dump() for s in a.specs] != [s.model_dump() for s in c.specs]
+
+
+def test_spec_requires_a_trigger_and_chip_faults_a_device():
+    with pytest.raises(ValueError):
+        FaultSpec(kind=FaultKind.HOST_SLOW)  # neither at_step nor after_s
+    with pytest.raises(ValueError):
+        FaultSpec(kind=FaultKind.CHIP_UNHEALTHY, at_step=3)  # no device_index
+    FaultSpec(kind=FaultKind.CHIP_UNHEALTHY, at_step=3, device_index=0)
+    FaultSpec(kind=FaultKind.PREEMPTION_SIGNAL, after_s=0.5)
+
+
+def test_step_trigger_and_count_consumption():
+    inj = FaultInjector(FaultPlan(specs=[
+        FaultSpec(kind=FaultKind.CHECKPOINT_SAVE_IOERROR, at_step=3, count=2),
+    ]))
+    inj.arm()
+    assert not inj.take_save_fault(2)       # not due yet
+    assert inj.take_save_fault(3)           # fires
+    assert inj.take_save_fault(3)           # second budget unit
+    assert not inj.take_save_fault(4)       # exhausted
+    assert inj.counters[FaultKind.CHECKPOINT_SAVE_IOERROR.value] == 2
+
+
+def test_preemption_and_host_slow_triggers():
+    inj = FaultInjector(FaultPlan(specs=[
+        FaultSpec(kind=FaultKind.PREEMPTION_SIGNAL, at_step=5),
+        FaultSpec(kind=FaultKind.HOST_SLOW, at_step=2, slow_s=1.25, count=2),
+    ]))
+    inj.arm()
+    assert inj.host_slow_penalty_s(1) == 0.0
+    assert inj.host_slow_penalty_s(2) == 1.25
+    assert inj.host_slow_penalty_s(2) == 1.25
+    assert inj.host_slow_penalty_s(3) == 0.0  # count exhausted
+    assert not inj.preempt_due(4)
+    assert inj.preempt_due(5)
+    assert not inj.preempt_due(6)  # consumed
+
+
+def test_chip_overlay_duration_window_and_heal():
+    inj = FaultInjector(FaultPlan(specs=[
+        FaultSpec(kind=FaultKind.CHIP_UNHEALTHY, at_step=2, device_index=1,
+                  duration_steps=2),
+        FaultSpec(kind=FaultKind.TELEMETRY_NAN, at_step=2, device_index=1),
+        FaultSpec(kind=FaultKind.TELEMETRY_NAN, at_step=3, device_index=4),
+    ]))
+    inj.arm()
+    inj.observe_step(1)
+    assert inj.chip_overlay() == {}
+    inj.observe_step(2)
+    # chip-unhealthy wins over telemetry-nan on the same chip.
+    assert inj.chip_overlay()[1] is FaultKind.CHIP_UNHEALTHY
+    inj.observe_step(3)
+    assert inj.chip_overlay()[4] is FaultKind.TELEMETRY_NAN
+    inj.observe_step(4)  # duration_steps=2 window [2, 4) has closed
+    overlay = inj.chip_overlay()
+    assert overlay.get(1) is FaultKind.TELEMETRY_NAN  # no-duration fault persists
+    healed = inj.heal(1)
+    assert healed >= 1
+    assert 1 not in inj.chip_overlay()
+    assert any(e.kind == "heal" for e in inj.events)
+
+
+def test_describe_full_and_specs_active():
+    inj = FaultInjector(FaultPlan(seed=3, specs=[
+        FaultSpec(kind=FaultKind.CHIP_UNHEALTHY, at_step=1, device_index=2),
+    ]))
+    inj.arm()
+    assert inj.specs_active() == 1
+    inj.observe_step(1)
+    out = inj.describe_full()
+    assert out["armed"] and out["seed"] == 3
+    assert out["active_chip_faults"] == {"2": "chip-unhealthy"}
+    assert any(e["kind"] == "chip-unhealthy" for e in out["events"])
+
+
+def test_process_active_registry():
+    assert faults.get_active() is None
+    inj = faults.activate(FaultPlan(seed=1, specs=[
+        FaultSpec(kind=FaultKind.HOST_SLOW, at_step=1),
+    ]))
+    assert faults.get_active() is inj
+    faults.clear_active()
+    assert faults.get_active() is None
+
+
+# ---------------------------------------------------------------------------
+# checkpoint seams: save IOError, retry+quarantine, restore corruption
+# ---------------------------------------------------------------------------
+
+
+def _np_state():
+    return {"w": np.arange(8, dtype=np.float32), "step": np.zeros((), np.int32)}
+
+
+def _abstract(state):
+    return jax.tree.map(lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype), state)
+
+
+def test_injected_save_fault_raises_and_retry_recovers(tmp_path):
+    inj = FaultInjector(FaultPlan(specs=[
+        FaultSpec(kind=FaultKind.CHECKPOINT_SAVE_IOERROR, at_step=1, count=2),
+    ]))
+    inj.arm()
+    mgr = TrainCheckpointManager(str(tmp_path), async_save=False, fault_injector=inj)
+    with pytest.raises(OSError, match="injected fault"):
+        mgr.save(1, _np_state(), wait=True)
+    # One budget unit left → first retry attempt fails, second succeeds.
+    attempts = []
+    ok = mgr.save_with_retry(
+        1, _np_state(), retries=3, backoff_base_s=0.001,
+        on_attempt=lambda n, err: attempts.append((n, err)),
+    )
+    assert ok
+    assert len(attempts) == 1 and "injected fault" in attempts[0][1]
+    assert mgr.all_steps() == [1]
+    assert mgr.quarantined_steps() == []
+
+
+def test_persistent_save_failure_quarantines_and_never_raises(tmp_path):
+    inj = FaultInjector(FaultPlan(specs=[
+        FaultSpec(kind=FaultKind.CHECKPOINT_SAVE_IOERROR, at_step=2, count=100),
+    ]))
+    inj.arm()
+    mgr = TrainCheckpointManager(str(tmp_path), async_save=False, fault_injector=inj)
+    attempts = []
+    ok = mgr.save_with_retry(
+        2, _np_state(), retries=2, backoff_base_s=0.001,
+        on_attempt=lambda n, err: attempts.append(n),
+    )
+    assert not ok
+    assert attempts == [1, 2, 3]  # initial try + 2 retries, all observed
+    assert mgr.quarantined_steps() == [2]
+
+
+def test_injected_restore_corruption_falls_back_to_older_step(tmp_path):
+    mgr = TrainCheckpointManager(str(tmp_path), async_save=False)
+    state = _np_state()
+    mgr.save(1, state, wait=True)
+    state2 = {"w": np.arange(8, dtype=np.float32) + 1.0,
+              "step": np.full((), 2, np.int32)}
+    mgr.save(2, state2, wait=True)
+    inj = FaultInjector(FaultPlan(specs=[
+        FaultSpec(kind=FaultKind.CHECKPOINT_RESTORE_CORRUPTION, at_step=2),
+    ]))
+    inj.arm()
+    mgr._fault_injector = inj
+    step, restored = mgr.restore(_abstract(state))
+    # Step 2 "corrupted" → quarantined → step 1 restored instead.
+    assert step == 1
+    np.testing.assert_allclose(np.asarray(restored["w"]), state["w"])
+    assert 2 in mgr.quarantined_steps()
+    assert mgr.latest_step() == 1
+
+
+# ---------------------------------------------------------------------------
+# mark_stable crash atomicity
+# ---------------------------------------------------------------------------
+
+
+def test_mark_stable_survives_torn_write(tmp_path):
+    mgr = TrainCheckpointManager(str(tmp_path), async_save=False)
+    mgr.save(3, _np_state(), wait=True)
+    mgr.mark_stable(3)
+    assert mgr.last_stable_step() == 3
+    pointer = os.fspath(mgr._stable_path())
+    # A crash mid-write leaves garbage in the temp file; the pointer itself
+    # must still read the last committed value.
+    with open(pointer + ".tmp", "w") as f:
+        f.write('{"step": 99')  # torn JSON
+    assert mgr.last_stable_step() == 3
+    # And a failed replace (ENOSPC etc.) must not corrupt the pointer.
+    orig_replace = os.replace
+
+    def exploding_replace(src, dst):
+        if dst == pointer:
+            raise OSError(28, "No space left on device")
+        return orig_replace(src, dst)
+
+    mgr.save(5, _np_state(), wait=True)
+    try:
+        os.replace = exploding_replace
+        with pytest.raises(OSError):
+            mgr.mark_stable(5)
+    finally:
+        os.replace = orig_replace
+    with open(pointer) as f:
+        assert json.load(f)["step"] == 3
+    assert mgr.last_stable_step() == 3
+
+
+# ---------------------------------------------------------------------------
+# GCE metadata probe + watcher backoff
+# ---------------------------------------------------------------------------
+
+
+class _FakeResponse:
+    def __init__(self, body: bytes, status: int = 200):
+        self._body = body
+        self.status = status
+
+    def read(self, n: int = -1) -> bytes:
+        return self._body[:n] if n >= 0 else self._body
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def test_probe_tri_state(monkeypatch):
+    import urllib.request as _req
+
+    monkeypatch.setattr(_req, "urlopen", lambda *a, **k: _FakeResponse(b"TRUE"))
+    assert probe_gce_preempted() is True
+    monkeypatch.setattr(_req, "urlopen", lambda *a, **k: _FakeResponse(b"FALSE\n"))
+    assert probe_gce_preempted() is False
+    monkeypatch.setattr(_req, "urlopen", lambda *a, **k: _FakeResponse(b"TRUE", status=503))
+    assert probe_gce_preempted() is None  # HTTP error → unknown, not False
+    def _boom(*a, **k):
+        raise OSError("no route to metadata.google.internal")
+    monkeypatch.setattr(_req, "urlopen", _boom)
+    assert probe_gce_preempted() is None
+
+
+def test_watcher_backoff_on_probe_failure():
+    w = PreemptionWatcher(
+        on_preemption=lambda reason: None,
+        check_interval_s=0.5,
+        metadata_check=lambda: None,
+        max_backoff_s=8.0,
+    )
+    assert w._wait_s() == 0.5
+    for _ in range(3):
+        assert w._poll_once() is None
+    assert w.metadata_failures == 3
+    assert w._wait_s() == 4.0       # 0.5 * 2**3
+    for _ in range(10):
+        w._poll_once()
+    assert w._wait_s() == 8.0       # capped
+    # A successful probe resets the backoff.
+    w.metadata_check = lambda: False
+    assert w._poll_once() is None
+    assert w.metadata_failures == 0
+    assert w._wait_s() == 0.5
+
+
+def test_raising_metadata_check_does_not_kill_watcher():
+    fired = threading.Event()
+
+    def exploding_check():
+        raise RuntimeError("metadata server melted")
+
+    w = PreemptionWatcher(
+        on_preemption=lambda reason: fired.set(),
+        check_interval_s=0.01,
+        metadata_check=exploding_check,
+        max_backoff_s=0.02,
+    )
+    w.start()
+    try:
+        # The old code died on the first raise; the hardened loop keeps
+        # polling (with backoff) and still honours the simulation seam.
+        assert not fired.wait(0.05)
+        assert w._thread.is_alive()
+        assert w.metadata_failures >= 1
+        w.simulate_interruption()
+        assert fired.wait(2.0)
+    finally:
+        w.stop()
+
+
+def test_watcher_fires_on_metadata_true():
+    fired = []
+    w = PreemptionWatcher(
+        on_preemption=fired.append,
+        check_interval_s=0.01,
+        metadata_check=lambda: True,
+    )
+    w.start()
+    try:
+        deadline = threading.Event()
+        deadline.wait(0.0)
+        for _ in range(200):
+            if fired:
+                break
+            deadline.wait(0.01)
+        assert fired == ["gce-metadata"]
+    finally:
+        w.stop()

@@ -480,3 +480,117 @@ def test_fleet_hbm_utilization_view(sched_factory):
     assert 0 < view["utilization_pct"] <= 100
     # No fleet source → no honest utilization number.
     assert sched_factory().fleet_hbm_utilization() is None
+
+
+# ---------------------------------------------------------------------------
+# elastic-shrink admission / grow-back / ledger release
+# ---------------------------------------------------------------------------
+
+
+def _chip(i, **kw):
+    base = dict(
+        index=i, device_kind="TPU v5e", hbm_total_gb=16.0, hbm_used_gb=4.0,
+        duty_cycle_pct=50.0, temperature_c=50.0,
+    )
+    base.update(kw)
+    return base
+
+
+def _degraded_fleet():
+    """8 chips, chip 0 thermally CRITICAL → 7 healthy."""
+    mgr = TPUManager()
+    return mgr.get_fleet_status(
+        metrics=[_chip(0, temperature_c=91.0)] + [_chip(i) for i in range(1, 8)]
+    )
+
+
+def _healthy_fleet():
+    mgr = TPUManager()
+    return mgr.get_fleet_status(metrics=[_chip(i) for i in range(8)])
+
+
+def elastic_cfg(**kw):
+    base = dict(mesh=MeshConfig(data=4, fsdp=2), elastic_min_devices=2)
+    base.update(kw)
+    return cfg(**base)
+
+
+def test_elastic_shrink_admission_on_degraded_fleet(sched_factory):
+    s = sched_factory(max_concurrent_jobs=1, fleet_fn=_degraded_fleet)
+    sub = s.submit(elastic_cfg())
+    assert wait_until(lambda: sub.state == SubmissionState.RUNNING)
+    # Gang 8 > 7 healthy, but elastic bounds admit data=3 × fsdp=2 on 6.
+    assert sub.admitted_gang == 6
+    assert sub.shrunk_mesh["data"] == 3 and sub.shrunk_mesh["fsdp"] == 2
+    # The CRITICAL chip is never in the placement.
+    assert 0 not in sub.placement and len(sub.placement) == 6
+    st = s.stats()
+    assert st["elastic_shrinks_total"] == 1
+    assert st["running_shrunk"] == 1
+    assert st["reserved_hbm_gib"] > 0
+
+
+def test_non_elastic_job_still_skips_on_degraded_fleet(sched_factory):
+    s = sched_factory(max_concurrent_jobs=1, fleet_fn=_degraded_fleet)
+    sub = s.submit(cfg(mesh=MeshConfig(data=4, fsdp=2)))  # no elastic bounds
+    time.sleep(0.1)
+    assert sub.state == SubmissionState.QUEUED
+    assert "gang of 8 device(s) > 7 healthy chip(s)" in sub.last_skip_reason
+    assert s.stats()["elastic_shrinks_total"] == 0
+
+
+def test_ledger_release_on_cancel_of_elastic_job(sched_factory):
+    s = sched_factory(max_concurrent_jobs=1, fleet_fn=_degraded_fleet)
+    sub = s.submit(elastic_cfg())
+    assert wait_until(lambda: sub.state == SubmissionState.RUNNING)
+    assert s.stats()["reserved_hbm_gib"] > 0
+    assert s.cancel(sub.submission_id)
+    assert wait_until(lambda: sub.state == SubmissionState.CANCELLED)
+    # Every per-device reservation of the shrunk placement is returned.
+    assert s.stats()["reserved_hbm_gib"] == 0.0
+    assert s.stats()["running_shrunk"] == 0
+
+
+def test_grow_back_when_fleet_heals(sched_factory):
+    fleet_holder = {"fleet": _degraded_fleet()}
+    s = sched_factory(
+        max_concurrent_jobs=1, fleet_fn=lambda: fleet_holder["fleet"],
+    )
+    sub = s.submit(elastic_cfg())
+    assert wait_until(lambda: sub.state == SubmissionState.RUNNING)
+    assert sub.admitted_gang == 6
+    # Chip 0 cools down → the full gang fits again → preempt-requeue-regrow.
+    fleet_holder["fleet"] = _healthy_fleet()
+    assert wait_until(
+        lambda: sub.state == SubmissionState.RUNNING and sub.admitted_gang == 8,
+        timeout=10.0,
+    )
+    assert sub.shrunk_mesh is None
+    assert sub.attempts == 2
+    st = s.stats()
+    assert st["grow_backs_total"] == 1
+    assert st["requeues_total"] == 1
+    assert st["running_shrunk"] == 0
+    # The ledger re-reserved for the full gang exactly once: all 8 chips,
+    # and everything is returned when the job finishes.
+    s._stub_jobs[-1].finish()
+    assert wait_until(lambda: sub.state == SubmissionState.COMPLETED)
+    assert s.stats()["reserved_hbm_gib"] == 0.0
+
+
+def test_grow_back_waits_for_queued_work(sched_factory):
+    """Queued submissions have first claim on freed chips — a shrunk job is
+    not grown while anything is waiting in the queue."""
+    fleet_holder = {"fleet": _degraded_fleet()}
+    s = sched_factory(
+        max_concurrent_jobs=1, fleet_fn=lambda: fleet_holder["fleet"],
+    )
+    sub = s.submit(elastic_cfg())
+    assert wait_until(lambda: sub.state == SubmissionState.RUNNING)
+    blocked = s.submit(cfg())  # queued: max_concurrent_jobs=1
+    fleet_holder["fleet"] = _healthy_fleet()
+    time.sleep(0.2)
+    assert sub.admitted_gang == 6  # no grow-back while the queue is non-empty
+    assert s.stats()["grow_backs_total"] == 0
+    s._stub_jobs[0].finish()
+    assert wait_until(lambda: blocked.state == SubmissionState.RUNNING)

@@ -143,3 +143,90 @@ def test_fleet_cli_renders_table(capsys):
     assert any(line.startswith("!") for line in out.splitlines())
     assert main(["--mock", "--json"]) == 0
     assert '"total_devices":8' in capsys.readouterr().out.replace(" ", "")
+
+
+# ---------------------------------------------------------------------------
+# _assess_health edge cases: corrupt / degenerate telemetry, recovery
+# ---------------------------------------------------------------------------
+
+
+def test_nan_telemetry_is_sanitized_not_propagated():
+    mgr = TPUManager()
+    nan = float("nan")
+    (dev,) = mgr.parse_metrics([_chip(duty_cycle_pct=nan, hbm_used_gb=nan)])
+    # Corrupt fields are discarded, never classified against thresholds.
+    assert dev.duty_cycle_pct is None
+    assert dev.hbm_used_gb == 0.0
+    assert dev.hbm_utilization_pct == 0.0
+    assert any("non-finite telemetry" in a for a in dev.alerts)
+    # Not *known* healthy, but not known bad → stays schedulable.
+    assert dev.health_status == TPUHealthStatus.UNKNOWN
+    assert dev.is_available
+
+
+def test_nan_chip_does_not_poison_fleet_aggregates():
+    import math
+
+    mgr = TPUManager()
+    fleet = mgr.get_fleet_status(
+        metrics=[_chip(0), _chip(1, hbm_used_gb=float("nan"),
+                                 temperature_c=float("inf"))]
+    )
+    assert math.isfinite(fleet.used_hbm_gb)
+    assert fleet.average_temperature_c is None or math.isfinite(
+        fleet.average_temperature_c
+    )
+    assert fleet.available_devices == 2  # UNKNOWN chip stays eligible
+
+
+def test_zero_and_missing_hbm_never_divide_or_alert():
+    mgr = TPUManager()
+    zero, missing = mgr.parse_metrics([
+        _chip(0, hbm_total_gb=0.0, hbm_used_gb=0.0),
+        {"index": 1, "device_kind": "TPU v5e"},  # no HBM keys at all
+    ])
+    for dev in (zero, missing):
+        assert dev.hbm_utilization_pct == 0.0
+        assert not any("HBM" in a for a in dev.alerts)
+    assert missing.health_status == TPUHealthStatus.HEALTHY
+
+
+def test_duplicate_indices_are_parsed_independently():
+    mgr = TPUManager()
+    devs = mgr.parse_metrics([_chip(3), _chip(3, temperature_c=91.0)])
+    assert [d.index for d in devs] == [3, 3]
+    assert devs[0].health_status == TPUHealthStatus.HEALTHY
+    assert devs[1].health_status == TPUHealthStatus.CRITICAL
+
+
+def test_health_recovers_when_telemetry_clears():
+    mgr = TPUManager()
+    (dev,) = mgr.parse_metrics([_chip(temperature_c=91.0)])
+    assert dev.health_status == TPUHealthStatus.CRITICAL
+    # Same chip, next poll: back under every threshold → fully HEALTHY.
+    (dev,) = mgr.parse_metrics([_chip(temperature_c=50.0)])
+    assert dev.health_status == TPUHealthStatus.HEALTHY
+    assert dev.alerts == []
+    assert dev.is_available
+
+
+def test_injected_chip_faults_overlay_fleet_snapshot():
+    from tpu_engine import faults
+    from tpu_engine.faults import FaultKind, FaultPlan, FaultSpec
+
+    mgr = TPUManager()
+    inj = faults.activate(FaultPlan(specs=[
+        FaultSpec(kind=FaultKind.CHIP_UNHEALTHY, at_step=1, device_index=0),
+        FaultSpec(kind=FaultKind.TELEMETRY_NAN, at_step=1, device_index=1),
+    ]))
+    try:
+        inj.observe_step(1)
+        fleet = mgr.get_fleet_status(metrics=[_chip(0), _chip(1), _chip(2)])
+        bad, nan, ok = fleet.devices
+        assert bad.health_status == TPUHealthStatus.CRITICAL
+        assert any("injected fault: chip-unhealthy" in a for a in bad.alerts)
+        assert nan.health_status == TPUHealthStatus.UNKNOWN
+        assert any("non-finite telemetry" in a for a in nan.alerts)
+        assert ok.health_status == TPUHealthStatus.HEALTHY
+    finally:
+        faults.clear_active()

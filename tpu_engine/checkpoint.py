@@ -56,7 +56,12 @@ class TrainCheckpointManager:
         max_to_keep: int = 3,
         save_interval_steps: int = 1,
         async_save: bool = True,
+        fault_injector: Optional[Any] = None,
     ):
+        # Explicit injector wins; otherwise the process-active one (if armed)
+        # is consulted per call, so tests/chaos runs can arm faults after
+        # construction. None armed → the seams are single-attribute no-ops.
+        self._fault_injector = fault_injector
         self.directory = resolve_checkpoint_dir(directory)
         # Remote schemes (gs://, s3://): Orbax/tensorstore own directory
         # creation (``create=True`` below); a local mkdir on the mangled
@@ -75,6 +80,13 @@ class TrainCheckpointManager:
         self._lock = threading.Lock()
         self._quarantined: set[int] = set()
 
+    def _injector(self):
+        if self._fault_injector is not None:
+            return self._fault_injector
+        from tpu_engine import faults
+
+        return faults.get_active()
+
     # -- save ----------------------------------------------------------------
 
     def save(
@@ -87,6 +99,9 @@ class TrainCheckpointManager:
     ) -> bool:
         """Async save (sync when ``wait=True`` — the preemption path)."""
         with self._lock:
+            inj = self._injector()
+            if inj is not None and inj.take_save_fault(step):
+                raise OSError(f"injected fault: checkpoint-save-ioerror at step {step}")
             try:
                 saved = self._mgr.save(
                     step,
@@ -99,6 +114,41 @@ class TrainCheckpointManager:
             if wait:
                 self._mgr.wait_until_finished()
             return bool(saved)
+
+    def save_with_retry(
+        self,
+        step: int,
+        state: Any,
+        metrics: Optional[dict[str, float]] = None,
+        retries: int = 3,
+        backoff_base_s: float = 0.05,
+        on_attempt: Optional[Any] = None,
+    ) -> bool:
+        """Synchronous save with bounded exponential-backoff retry.
+
+        The emergency-save path for the self-healing supervisor: a transient
+        I/O failure (real or injected) must not turn a recoverable chip fault
+        into lost training progress. After ``retries`` extra attempts the
+        step is **quarantined** — a partial write must never be auto-resumed
+        into — and False is returned; this method never raises.
+        ``on_attempt(attempt_no, error_str)`` observes each failure.
+        """
+        delay = backoff_base_s
+        for attempt in range(retries + 1):
+            try:
+                self.save(step, state, metrics=metrics, force=True, wait=True)
+                return True
+            except Exception as e:  # noqa: BLE001 — retry path must survive anything
+                if on_attempt is not None:
+                    try:
+                        on_attempt(attempt + 1, f"{type(e).__name__}: {e}")
+                    except Exception:
+                        pass
+                if attempt < retries:
+                    time.sleep(delay)
+                    delay = min(delay * 2.0, 2.0)
+        self.quarantine(step)
+        return False
 
     def wait_until_finished(self) -> None:
         self._mgr.wait_until_finished()
@@ -113,9 +163,11 @@ class TrainCheckpointManager:
     def mark_stable(self, step: int) -> None:
         """Record ``step`` as the newest known-good checkpoint.
 
-        Local filesystems get a crash-atomic tmp+rename; object stores
-        (no rename) get a direct write — GCS object writes are already
-        atomic at the object level."""
+        Local filesystems get a crash-atomic tmp + fsync + rename (the fsync
+        matters: without it a power loss after the rename can surface a
+        zero-length or torn pointer on ext4/xfs, exactly the corruption the
+        pointer exists to prevent); object stores (no rename) get a direct
+        write — GCS object writes are already atomic at the object level."""
         payload = json.dumps({"step": int(step), "timestamp": time.time()})
         path = self._stable_path()
         if "://" in self.directory:
@@ -124,7 +176,19 @@ class TrainCheckpointManager:
         tmp = os.fspath(path) + ".tmp"
         with open(tmp, "w") as f:
             f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, os.fspath(path))
+        # Persist the rename itself (directory entry) — best effort: not
+        # every filesystem lets you open a directory for fsync.
+        try:
+            dfd = os.open(os.path.dirname(os.fspath(path)) or ".", os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass
 
     def last_stable_step(self) -> Optional[int]:
         try:
@@ -137,6 +201,13 @@ class TrainCheckpointManager:
 
     def all_steps(self) -> list[int]:
         return sorted(s for s in self._mgr.all_steps() if s not in self._quarantined)
+
+    def quarantine(self, step: int) -> None:
+        """Exclude ``step`` from restore/latest candidates (suspect data)."""
+        self._quarantined.add(int(step))
+
+    def quarantined_steps(self) -> list[int]:
+        return sorted(self._quarantined)
 
     def delete_after(self, step: int) -> None:
         """Delete checkpoints newer than ``step``.
@@ -181,6 +252,13 @@ class TrainCheckpointManager:
             candidates = list(reversed(self.all_steps()))
         for s in candidates:
             try:
+                # Injected corruption raises INSIDE the try so it rides the
+                # exact quarantine-and-fall-back path real corruption takes.
+                inj = self._injector()
+                if inj is not None and inj.take_restore_fault(s):
+                    raise OSError(
+                        f"injected fault: checkpoint-restore-corruption at step {s}"
+                    )
                 state = self._mgr.restore(s, args=ocp.args.StandardRestore(abstract_state))
                 return s, state
             except Exception:

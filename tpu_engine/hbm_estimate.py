@@ -110,6 +110,44 @@ def gang_size(config: TPUTrainConfig, available: Optional[int] = None) -> int:
     return (available // fixed) * fixed
 
 
+def elastic_shrink_plan(
+    config: TPUTrainConfig,
+    n_eligible: int,
+    estimate_fn: Any = None,
+) -> Optional[tuple[Any, int, Optional[HBMEstimate]]]:
+    """Largest elastic mesh admissible on ``n_eligible`` healthy chips.
+
+    The scheduler's elastic-shrink admission path: when a job's configured
+    gang exceeds the healthy fleet but the job declared elastic bounds,
+    admit it shrunk instead of skipping it (Poplar's keep-goodput-on-a-
+    degraded-fleet stance, arXiv:2408.12596). Returns
+    ``(mesh, n_devices, estimate)`` — the derived explicit mesh, the gang it
+    occupies, and the HBM projection *at that shrunken shape* (None when the
+    model is unknown) — or None when the config is not elastic or no mesh
+    within its bounds fits.
+    """
+    if not (config.elastic_resume and config.elastic_min_devices is not None):
+        return None
+    from tpu_engine.mesh_runtime import derive_elastic_mesh
+
+    try:
+        mesh = derive_elastic_mesh(
+            config.mesh, n_eligible, config.elastic_min_devices, config.elastic_max_devices
+        )
+    except ValueError:
+        return None
+    n_use = mesh.data * mesh.fsdp * mesh.pipe * mesh.sequence * mesh.model
+    if n_use > n_eligible:
+        return None
+    est: Optional[HBMEstimate] = None
+    try:
+        fn = estimate_fn if estimate_fn is not None else estimate_job_hbm
+        est = fn(config.model_copy(update={"mesh": mesh}), n_use)
+    except Exception:  # estimator must never block admission
+        est = None
+    return mesh, n_use, est
+
+
 def estimate_job_hbm(
     config: TPUTrainConfig, available_devices: Optional[int] = None
 ) -> Optional[HBMEstimate]:

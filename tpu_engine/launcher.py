@@ -28,7 +28,7 @@ from typing import Any, Callable, Optional
 import jax
 from pydantic import BaseModel, Field
 
-from tpu_engine import comm, quant_train
+from tpu_engine import comm, faults, quant_train
 from tpu_engine import scheduler as scheduler_mod
 from tpu_engine.mesh_runtime import MESH_AXES
 from tpu_engine.parallel import pipeline_zb
@@ -45,6 +45,7 @@ from tpu_engine.sharding import (
     resolve_pipeline_schedule,
 )
 from tpu_engine.supervisor import JobStatus, TrainingJob
+from tpu_engine.tpu_manager import TPUManager
 
 
 class LaunchResult(BaseModel):
@@ -81,9 +82,13 @@ class TPULauncher:
         tiny-model multi-tenancy). Enforced by the scheduler."""
         self._jobs: dict[str, TrainingJob] = {}
         self._lock = threading.Lock()
+        # Default to a live fleet view: without one, admission is
+        # capacity-only and the elastic shrink path can never engage — a
+        # self-healed job would be re-admitted onto the same bad chip.
         self.scheduler = scheduler or FleetScheduler(
             max_concurrent_jobs=max_concurrent_jobs,
             job_factory=self._make_job,
+            fleet_fn=TPUManager().get_fleet_status,
         )
         if scheduler is not None:
             self.scheduler.job_factory = self._make_job
@@ -240,6 +245,25 @@ class TPULauncher:
                 "preserve_effective_batch": True,
                 "note": "TPU slices are fixed-shape; live resize is not a TPU concept "
                 "(reference elasticity block: deepspeed_launcher.py:226-238)",
+            },
+            # Self-healing recovery pipeline (tpu_engine/faults.py +
+            # supervisor/scheduler seams): what happens when a mesh chip
+            # goes unhealthy mid-training, and whether chaos injection is
+            # currently armed in this process.
+            "fault_tolerance": {
+                "self_heal": bool(config.elastic_resume),
+                "recovery_path": (
+                    "detect unhealthy mesh chip -> synchronous emergency save "
+                    "(bounded exponential-backoff retry; quarantine the step "
+                    "on persistent I/O failure) -> requeue -> elastic-shrink "
+                    "re-admission on the healthy remainder -> resume from the "
+                    "emergency checkpoint (zero lost steps)"
+                ),
+                "elastic_shrink_on_admission": bool(
+                    config.elastic_resume and config.elastic_min_devices is not None
+                ),
+                "grow_back_when_chips_recover": True,
+                "fault_injection_armed": faults.get_active() is not None,
             },
         }
         return plan

@@ -41,16 +41,36 @@ GCE_PREEMPTION_URL = (
 )
 
 
+def probe_gce_preempted(timeout: float = 1.0) -> Optional[bool]:
+    """One metadata probe, hardened against every request failure mode.
+
+    Returns True/False from a successful read, or **None** when the probe
+    could not determine anything: no route / DNS failure / connection
+    refused / socket timeout / HTTP error status / undecodable body. The
+    tri-state matters — callers distinguish "not preempted" from "metadata
+    server unreachable", which is what drives the watcher's backoff so a
+    flapping endpoint can't spin the poll loop at full rate.
+    """
+    req = urllib.request.Request(
+        GCE_PREEMPTION_URL, headers={"Metadata-Flavor": "Google"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            status = getattr(resp, "status", 200)
+            if status is not None and not (200 <= int(status) < 300):
+                return None
+            body = resp.read(64)
+    except Exception:  # URLError, timeout, OSError, anything urllib raises
+        return None
+    try:
+        return body.decode("utf-8", "replace").strip().upper() == "TRUE"
+    except Exception:
+        return None
+
+
 def check_gce_preempted(timeout: float = 1.0) -> bool:
     """Poll the GCE metadata server; False on any error (not on GCE, etc.)."""
-    try:
-        req = urllib.request.Request(
-            GCE_PREEMPTION_URL, headers={"Metadata-Flavor": "Google"}
-        )
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return resp.read().decode().strip().upper() == "TRUE"
-    except Exception:
-        return False
+    return probe_gce_preempted(timeout) is True
 
 
 class PreemptionWatcher:
@@ -66,11 +86,16 @@ class PreemptionWatcher:
         on_preemption: Callable[[str], None],
         check_interval_s: float = 5.0,  # reference poll interval, spot_resiliency.py:13
         install_signal_handlers: bool = False,
-        metadata_check: Optional[Callable[[], bool]] = check_gce_preempted,
+        metadata_check: Optional[Callable[[], Optional[bool]]] = probe_gce_preempted,
+        max_backoff_s: float = 60.0,
     ):
         self.on_preemption = on_preemption
         self.check_interval_s = check_interval_s
         self.metadata_check = metadata_check
+        self.max_backoff_s = max_backoff_s
+        #: consecutive probe failures (None result or raised exception) —
+        #: drives exponential backoff; reset on any successful probe.
+        self.metadata_failures = 0
         self._install_signals = install_signal_handlers
         self._simulated = threading.Event()
         self._stop = threading.Event()
@@ -125,12 +150,40 @@ class PreemptionWatcher:
         except Exception:
             log.exception("preemption callback failed")
 
+    def _poll_once(self) -> Optional[str]:
+        """One watcher tick → fire reason, or None to keep waiting.
+
+        A raising ``metadata_check`` must NOT kill the watcher thread (it
+        used to — an exception here silently disabled preemption handling
+        for the rest of the job); raised exceptions count as probe failures
+        and feed the same backoff as a None result.
+        """
+        if self._simulated.is_set():
+            return "simulated"
+        if self.metadata_check is None:
+            return None
+        try:
+            result = self.metadata_check()
+        except Exception:
+            log.exception("metadata preemption check raised; backing off")
+            result = None
+        if result is None:
+            self.metadata_failures += 1
+        else:
+            self.metadata_failures = 0
+            if result:
+                return "gce-metadata"
+        return None
+
+    def _wait_s(self) -> float:
+        """Poll interval with exponential backoff while the probe is failing."""
+        backoff = self.check_interval_s * (2 ** min(self.metadata_failures, 20))
+        return min(backoff, max(self.max_backoff_s, self.check_interval_s))
+
     def _loop(self) -> None:
         while not self._stop.is_set():
-            if self._simulated.is_set():
-                self._fire("simulated")
+            reason = self._poll_once()
+            if reason is not None:
+                self._fire(reason)
                 return
-            if self.metadata_check is not None and self.metadata_check():
-                self._fire("gce-metadata")
-                return
-            self._stop.wait(self.check_interval_s)
+            self._stop.wait(self._wait_s())

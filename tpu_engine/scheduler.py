@@ -41,7 +41,12 @@ from typing import Any, Callable, Optional
 
 import jax
 
-from tpu_engine.hbm_estimate import HBMEstimate, estimate_job_hbm, gang_size
+from tpu_engine.hbm_estimate import (
+    HBMEstimate,
+    elastic_shrink_plan,
+    estimate_job_hbm,
+    gang_size,
+)
 from tpu_engine.sharding import TPUTrainConfig
 from tpu_engine.supervisor import JobStatus, TrainingJob
 from tpu_engine.tpu_manager import TPUFleetStatus
@@ -118,6 +123,11 @@ class Submission:
         self.last_skip_reason: Optional[str] = None
         self.estimate: Optional[HBMEstimate] = None
         self.placement: list[int] = []  # fleet device indices reserved for it
+        # Elastic-shrink admission: the mesh this attempt actually runs at
+        # (None = configured shape) and the gang it occupies — grow-back
+        # compares the healthy fleet against admitted_gang.
+        self.shrunk_mesh: Optional[dict[str, int]] = None
+        self.admitted_gang: Optional[int] = None
 
     @property
     def preemptible(self) -> bool:
@@ -153,6 +163,8 @@ class Submission:
             "last_skip_reason": self.last_skip_reason,
             "hbm_estimate": self.estimate.model_dump() if self.estimate else None,
             "placement": self.placement,
+            "shrunk_mesh": self.shrunk_mesh,
+            "admitted_gang": self.admitted_gang,
             "job": self.job.describe() if self.job is not None else None,
         }
 
@@ -192,7 +204,9 @@ class FleetScheduler:
         quotas: Optional[dict[str, int]] = None,
         checkpoint_root: Optional[str] = None,
         poll_interval_s: float = 0.1,
+        grow_back: bool = True,
     ):
+        self.grow_back = grow_back
         self.max_concurrent_jobs = max_concurrent_jobs
         self.fleet_fn = fleet_fn
         self.job_factory = job_factory
@@ -217,6 +231,9 @@ class FleetScheduler:
         self.completed_total = 0
         self.failed_total = 0
         self.cancelled_total = 0
+        self.elastic_shrinks_total = 0
+        self.grow_backs_total = 0
+        self.self_heal_requeues_total = 0
         self._wait_samples: list[float] = []  # bounded; admitted-wait seconds
 
         self._shutdown = threading.Event()
@@ -320,6 +337,7 @@ class FleetScheduler:
             self._reap()
             if not self._draining:
                 self._admit()
+                self._maybe_grow()
 
     def wait(self, submission_id: str, timeout: Optional[float] = None) -> Submission:
         """Block until the submission reaches a terminal state."""
@@ -386,6 +404,8 @@ class FleetScheduler:
                 sub.preemptions += 1
                 sub.job = None
                 self.requeues_total += 1
+                if str(getattr(job, "preemption_reason", "") or "").startswith("self-heal"):
+                    self.self_heal_requeues_total += 1
                 log.info(
                     "scheduler: %s preempted at step %s — requeued",
                     sub.submission_id, job.current_step,
@@ -470,12 +490,22 @@ class FleetScheduler:
         sub.estimate = est
 
         placement: list[int] = []
+        shrunk_mesh = None
         if eligible is not None:
             if gang > len(eligible):
-                sub.last_skip_reason = (
-                    f"gang of {gang} device(s) > {len(eligible)} healthy chip(s)"
-                )
-                return False
+                # Elastic-shrink admission: a job with declared elastic
+                # bounds is admitted at the largest mesh its bounds allow on
+                # the healthy remainder instead of being skipped — the
+                # paper's keep-training-on-a-degraded-fleet behavior.
+                shrink = elastic_shrink_plan(sub.config, len(eligible), self.estimate_fn)
+                if shrink is None:
+                    sub.last_skip_reason = (
+                        f"gang of {gang} device(s) > {len(eligible)} healthy chip(s)"
+                    )
+                    return False
+                shrunk_mesh, gang, est = shrink
+                sub.estimate = est
+                sub.last_skip_reason = None
             # HBM gate only when the fleet actually reports HBM (CPU chips
             # report 0 total — capacity-only there).
             hbm_known = all(d.hbm_total_gb > 0 for d in eligible)
@@ -500,6 +530,26 @@ class FleetScheduler:
             else:
                 placement = [d.index for d in eligible[:gang]]
 
+        # Shrunk admission pins the attempt to the healthy chips it was
+        # placed on — without pinning, the job would span ALL visible
+        # devices, unhealthy one included. The factory receives the pin via
+        # job_kwargs (stub factories that ignore kwargs are unaffected).
+        sub.job_kwargs.pop("devices", None)
+        # Self-healing detection: the supervisor watches the same fleet
+        # health view admission uses (explicit caller wiring wins).
+        if self.fleet_fn is not None:
+            sub.job_kwargs.setdefault("fleet_fn", self.fleet_fn)
+        if shrunk_mesh is not None and placement:
+            devs = self._runtime_devices_for(placement)
+            if devs is None:
+                sub.last_skip_reason = (
+                    f"elastic shrink to {gang} device(s) admissible, but the "
+                    f"fleet indices {placement} do not map onto this "
+                    "process's runtime devices"
+                )
+                return False
+            sub.job_kwargs["devices"] = devs
+
         try:
             job = self.job_factory(sub)
         except Exception as e:  # noqa: BLE001 — constructor boundary
@@ -514,6 +564,15 @@ class FleetScheduler:
         sub.state = SubmissionState.RUNNING
         sub.last_skip_reason = None
         sub.placement = placement
+        sub.admitted_gang = gang
+        sub.shrunk_mesh = shrunk_mesh.model_dump() if shrunk_mesh is not None else None
+        if shrunk_mesh is not None:
+            self.elastic_shrinks_total += 1
+            log.warning(
+                "scheduler: elastic-shrink admission of %s — configured gang "
+                "does not fit the healthy fleet; admitted at %s on %d chip(s)",
+                sub.submission_id, sub.shrunk_mesh, gang,
+            )
         if est is not None:
             for idx in placement:
                 self._reserved[idx] = (
@@ -531,6 +590,72 @@ class FleetScheduler:
             sub.priority.name, sub.attempts, gang,
         )
         return True
+
+    @staticmethod
+    def _runtime_devices_for(placement: list[int]) -> Optional[list[jax.Device]]:
+        """Map fleet snapshot indices onto this process's runtime devices.
+
+        Valid on the live path where the fleet is built from jax.devices()
+        in order; None when the indices don't map (injected/mock fleet over
+        a differently-sized runtime) — the caller then declines the shrink
+        rather than pinning the wrong chips."""
+        try:
+            devs = list(jax.devices())
+        except Exception:
+            return None
+        if any(i < 0 or i >= len(devs) for i in placement):
+            return None
+        return [devs[i] for i in placement]
+
+    def _maybe_grow(self) -> None:
+        """Grow elastic jobs back when quarantined chips recover.
+
+        A RUNNING job admitted shrunk is preempt-requeued (checkpoint →
+        requeue → re-admit) when the healthy fleet now supports a strictly
+        larger gang for it — one per pass, only when the queue is empty
+        (queued work has first claim on freed chips) and no other
+        preemption is in flight."""
+        if not self.grow_back or self._draining or self._queued():
+            return
+        if any(s.state == SubmissionState.PREEMPTING for s in self._subs.values()):
+            return
+        fleet = self._fleet()
+        if fleet is None or not fleet.devices:
+            return
+        # Health-keyed, not availability-keyed: the candidate's OWN chips
+        # are busy (it is running on them) but still count toward the gang
+        # it could occupy after the requeue round-trip.
+        from tpu_engine.tpu_manager import TPUHealthStatus
+
+        healthy = sum(
+            1 for d in fleet.devices if d.health_status != TPUHealthStatus.CRITICAL
+        )
+        for sub in self._subs.values():
+            if (
+                sub.state != SubmissionState.RUNNING
+                or sub.shrunk_mesh is None
+                or sub.admitted_gang is None
+                or not sub.preemptible
+            ):
+                continue
+            full = gang_size(sub.config, healthy)
+            if full <= healthy and full > sub.admitted_gang:
+                target = full
+            else:
+                plan = elastic_shrink_plan(sub.config, healthy, self.estimate_fn)
+                if plan is None or plan[1] <= sub.admitted_gang:
+                    continue
+                target = plan[1]
+            self.grow_backs_total += 1
+            sub.state = SubmissionState.PREEMPTING
+            self.preemptions_total += 1
+            log.info(
+                "scheduler: growing %s back — %d healthy chip(s) now admit "
+                "gang %d (> current %d); checkpoint-requeue to resize",
+                sub.submission_id, healthy, target, sub.admitted_gang,
+            )
+            sub.job.watcher.simulate_interruption()
+            return
 
     def _maybe_preempt(self, head: Submission) -> None:
         """Evict the lowest-priority running job strictly below ``head``'s
@@ -619,6 +744,14 @@ class FleetScheduler:
             "completed_total": self.completed_total,
             "failed_total": self.failed_total,
             "cancelled_total": self.cancelled_total,
+            "elastic_shrinks_total": self.elastic_shrinks_total,
+            "grow_backs_total": self.grow_backs_total,
+            "self_heal_requeues_total": self.self_heal_requeues_total,
+            "running_shrunk": sum(
+                1
+                for s in self._subs.values()
+                if s.state == SubmissionState.RUNNING and s.shrunk_mesh is not None
+            ),
             "reserved_hbm_gib": round(sum(self._reserved.values()), 3),
             "draining": self._draining,
         }

@@ -82,6 +82,12 @@ class TrainingJob:
         install_signal_handlers: bool = False,
         simulate_preemption_check: Optional[Callable[[], bool]] = None,
         devices: Optional[Sequence[jax.Device]] = None,
+        fault_injector: Optional[Any] = None,
+        fleet_fn: Optional[Callable[[], Any]] = None,
+        self_heal: Optional[bool] = None,
+        health_check_interval_steps: int = 1,
+        emergency_save_retries: int = 3,
+        emergency_save_backoff_s: float = 0.05,
     ):
         self.job_id = job_id
         self.config = config
@@ -110,6 +116,25 @@ class TrainingJob:
             if config.elastic_target_batch_size is not None
             else config.effective_batch_size
         )
+
+        # Self-healing / fault-injection seams. A private injector wins;
+        # otherwise the process-active one (tpu_engine.faults.get_active)
+        # is consulted per step. fleet_fn gives the loop a live health view
+        # (the scheduler wires TPUManager.get_fleet_status here); self_heal
+        # defaults to the config's elastic_resume — a job that declared
+        # elasticity wants to survive chip loss, one that didn't should
+        # fail loudly as before.
+        self.fault_injector = fault_injector
+        self.fleet_fn = fleet_fn
+        self.self_heal = self_heal if self_heal is not None else bool(config.elastic_resume)
+        self.health_check_interval_steps = max(1, int(health_check_interval_steps))
+        self.emergency_save_retries = emergency_save_retries
+        self.emergency_save_backoff_s = emergency_save_backoff_s
+        #: None | detected | saving | saved | save-failed — the recovery
+        #: state machine position, surfaced via describe()/HTTP.
+        self.recovery_state: Optional[str] = None
+        self.recovery_events: list[dict[str, Any]] = []
+        self.unhealthy_devices: list[int] = []
 
         self.status = JobStatus.PENDING
         self.error: Optional[str] = None
@@ -148,6 +173,7 @@ class TrainingJob:
                 config.checkpoint_dir,
                 max_to_keep=config.max_checkpoints_to_keep,
                 save_interval_steps=1,
+                fault_injector=fault_injector,
             )
 
         self.watcher: Optional[PreemptionWatcher] = None
@@ -191,6 +217,107 @@ class TrainingJob:
         log.warning("job %s: preemption (%s) — emergency checkpoint", self.job_id, reason)
         self.preemption_reason = reason
         self._stop.set()
+
+    # -- self-healing ---------------------------------------------------------
+
+    def _injector(self):
+        if self.fault_injector is not None:
+            return self.fault_injector
+        from tpu_engine import faults
+
+        return faults.get_active()
+
+    def _record_recovery(self, kind: str, step: int, detail: str = "") -> None:
+        self.recovery_events.append(
+            {"kind": kind, "step": step, "detail": detail, "timestamp": time.time()}
+        )
+        del self.recovery_events[:-100]
+        inj = self._injector()
+        if inj is not None:
+            inj.record(f"recovery:{kind}", step=step, detail=f"job {self.job_id}: {detail}")
+
+    def _unhealthy_mesh_devices(self) -> list[int]:
+        """Fleet device indices that are CRITICAL *and* inside this job's
+        mesh. Keyed on health, not ``is_available`` — this job's own HBM
+        footprint and duty cycle must never read as a failure."""
+        prog = self.program
+        if prog is None:
+            return []
+        mesh_ids = {int(d.id) for d in prog.runtime.mesh.devices.flat}
+        try:
+            all_devs = list(jax.devices())
+        except Exception:
+            all_devs = []
+
+        def in_mesh(fleet_index: int) -> bool:
+            return (
+                0 <= fleet_index < len(all_devs)
+                and int(all_devs[fleet_index].id) in mesh_ids
+            )
+
+        bad: set[int] = set()
+        inj = self._injector()
+        if inj is not None:
+            from tpu_engine.faults import FaultKind
+
+            for idx, kind in inj.chip_overlay().items():
+                if kind is FaultKind.CHIP_UNHEALTHY and in_mesh(idx):
+                    bad.add(idx)
+        if self.fleet_fn is not None:
+            from tpu_engine.tpu_manager import TPUHealthStatus
+
+            try:
+                fleet = self.fleet_fn()
+            except Exception:
+                fleet = None
+            if fleet is not None:
+                for dev in fleet.devices:
+                    if dev.health_status == TPUHealthStatus.CRITICAL and in_mesh(dev.index):
+                        bad.add(dev.index)
+        return sorted(bad)
+
+    def _begin_self_heal(self, step: int, bad: list[int]) -> None:
+        """Detect → (loop exit) → emergency save → PREEMPTED → scheduler
+        requeues and re-admits on the healthy remainder (elastic shrink)."""
+        self.unhealthy_devices = bad
+        self.recovery_state = "detected"
+        self._record_recovery("detected", step, f"unhealthy mesh device(s) {bad}")
+        log.warning(
+            "job %s: unhealthy device(s) %s in live mesh at step %d — "
+            "self-healing: emergency save then elastic requeue",
+            self.job_id, bad, step,
+        )
+        # Riding the preemption path gives us the whole proven machinery:
+        # synchronous save, PREEMPTED status, scheduler requeue-with-seq.
+        self.preemption_reason = f"self-heal: unhealthy device(s) {bad}"
+        self._stop.set()
+
+    def _final_save(self, step: int) -> bool:
+        """Final/emergency checkpoint with bounded retry; never raises.
+
+        On persistent I/O failure the step is quarantined (partial write)
+        and the job falls back to the last good periodic checkpoint on
+        resume — progress loss is bounded by checkpoint_interval_steps
+        instead of the whole run."""
+        if self.recovery_state == "detected":
+            self.recovery_state = "saving"
+        ok = self.ckpt.save_with_retry(
+            step,
+            self._state,
+            retries=self.emergency_save_retries,
+            backoff_base_s=self.emergency_save_backoff_s,
+            on_attempt=lambda attempt, err: self._record_recovery(
+                "save-retry", step, f"attempt {attempt}: {err}"
+            ),
+        )
+        if self.recovery_state is not None:
+            self.recovery_state = "saved" if ok else "save-failed"
+            self._record_recovery(
+                self.recovery_state, step,
+                "emergency checkpoint persisted" if ok
+                else "emergency save failed after retries — step quarantined",
+            )
+        return ok
 
     # -- training loop -------------------------------------------------------
 
@@ -480,6 +607,30 @@ class TrainingJob:
                 step = int(host["step"])
                 self.current_step = step
 
+                # Fault-injection seams + self-healing health check.
+                inj = self._injector()
+                if inj is not None:
+                    inj.observe_step(step)
+                    slow = inj.host_slow_penalty_s(step)
+                    if slow > 0:
+                        # Host-slow is a *reported* stall (step time +
+                        # throughput degrade) — never an actual sleep, so
+                        # chaos runs stay deterministic and fast.
+                        self.last_step_time_s = dt + slow
+                        self.tokens_per_sec = tokens_per_batch / self.last_step_time_s
+                    if inj.preempt_due(step):
+                        # Synchronous injection (not via the watcher thread):
+                        # the step that triggers is the step that saves.
+                        self._on_preemption("fault-injected:preemption-signal")
+                if (
+                    self.self_heal
+                    and self.preemption_reason is None
+                    and step % self.health_check_interval_steps == 0
+                ):
+                    bad = self._unhealthy_mesh_devices()
+                    if bad:
+                        self._begin_self_heal(step, bad)
+
                 alerts = self.monitor.ingest(
                     TrainingMetrics(
                         step=step,
@@ -533,8 +684,8 @@ class TrainingJob:
             if self.ckpt is not None and self._state is not None:
                 with self._state_lock:
                     self._flush_state()
-                self.ckpt.save(step, self._state, force=True, wait=True)
-                self._advance_stable(step)
+                if self._final_save(step):
+                    self._advance_stable(step)
             if self.preemption_reason is not None:
                 self.status = JobStatus.PREEMPTED
             elif self._stop.is_set() and step < self.max_steps:
@@ -968,6 +1119,9 @@ class TrainingJob:
             "resumed_from_step": self.resumed_from_step,
             "elastic_mesh": self.elastic_mesh,
             "preemption_reason": self.preemption_reason,
+            "recovery_state": self.recovery_state,
+            "recovery_events": list(self.recovery_events),
+            "unhealthy_devices": list(self.unhealthy_devices),
             "started_at": self.started_at,
             "finished_at": self.finished_at,
             "last_step_time_s": self.last_step_time_s,

@@ -285,8 +285,48 @@ class TPUManager:
 
     # -- health --------------------------------------------------------------
 
+    @staticmethod
+    def _sanitize_telemetry(dev: TPUDevice) -> list[str]:
+        """Discard non-finite (NaN/inf) telemetry before classification.
+
+        Corrupt telemetry (a flaky collector, or an injected `telemetry-nan`
+        fault) must not poison the fleet aggregates — a single NaN
+        ``hbm_used_gb`` would turn the fleet-wide HBM sums NaN and wreck the
+        scheduler's admission math. Optional fields revert to None (unknown),
+        HBM fields to 0.0; the affected field names are returned so the
+        caller can alert on them.
+        """
+        import math
+
+        def bad(v: Any) -> bool:
+            return isinstance(v, float) and not math.isfinite(v)
+
+        dropped: list[str] = []
+        for field in (
+            "duty_cycle_pct",
+            "tensorcore_util_pct",
+            "temperature_c",
+            "power_draw_w",
+            "power_limit_w",
+        ):
+            if bad(getattr(dev, field)):
+                setattr(dev, field, None)
+                dropped.append(field)
+        for field in ("hbm_total_gb", "hbm_used_gb", "hbm_utilization_pct"):
+            if bad(getattr(dev, field)):
+                setattr(dev, field, 0.0)
+                dropped.append(field)
+        if "hbm_used_gb" in dropped or "hbm_total_gb" in dropped:
+            dev.hbm_utilization_pct = (
+                round(dev.hbm_used_gb / dev.hbm_total_gb * 100.0, 2)
+                if dev.hbm_total_gb > 0
+                else 0.0
+            )
+        return dropped
+
     def _assess_health(self, dev: TPUDevice) -> None:
         """Classify health; mirrors reference ``_assess_health`` (``gpu_manager.py:348-379``)."""
+        dropped = self._sanitize_telemetry(dev)
         alerts: list[str] = []
         status = TPUHealthStatus.HEALTHY
 
@@ -342,8 +382,45 @@ class TPUManager:
             if status == TPUHealthStatus.HEALTHY:
                 status = TPUHealthStatus.WARNING
 
+        if dropped:
+            alerts.append(
+                "WARNING: non-finite telemetry discarded for " + ", ".join(dropped)
+            )
+            # A chip whose telemetry is corrupt is not *known* healthy —
+            # but it's not known bad either, so it stays schedulable
+            # (is_available treats UNKNOWN as eligible) while the alert flags it.
+            if status == TPUHealthStatus.HEALTHY:
+                status = TPUHealthStatus.UNKNOWN
+
         dev.alerts = alerts
         dev.health_status = status
+
+    def _apply_fault_overlay(self, devices: list[TPUDevice], injector: Any) -> None:
+        """Lay active injected chip faults over a fleet snapshot.
+
+        `chip-unhealthy` forces CRITICAL (the chip drops out of
+        ``is_available`` and the scheduler's eligible set); `telemetry-nan`
+        poisons the chip's metrics with NaN and re-assesses, which drives
+        the exact sanitization path corrupt real telemetry would.
+        """
+        overlay = injector.chip_overlay()
+        if not overlay:
+            return
+        from tpu_engine.faults import FaultKind
+
+        by_index = {d.index: d for d in devices}
+        for idx, kind in overlay.items():
+            dev = by_index.get(idx)
+            if dev is None:
+                continue
+            if kind is FaultKind.TELEMETRY_NAN:
+                dev.duty_cycle_pct = float("nan")
+                dev.hbm_used_gb = float("nan")
+                self._assess_health(dev)
+            elif kind is FaultKind.CHIP_UNHEALTHY:
+                self._assess_health(dev)
+                dev.alerts = [*dev.alerts, "CRITICAL: injected fault: chip-unhealthy"]
+                dev.health_status = TPUHealthStatus.CRITICAL
 
     # -- fleet ---------------------------------------------------------------
 
@@ -420,6 +497,15 @@ class TPUManager:
                     refs = attribution.get(int(getattr(d, "id", dev.index)))
                     if refs:
                         dev.jobs = [TPUJobRef(**r) for r in refs]
+
+        # Fault-injection overlay (tpu_engine.faults): applied to EVERY
+        # snapshot path — injected, mock, and live — so the chaos harness
+        # exercises the same detection pipeline real degradation would.
+        from tpu_engine import faults as faults_mod
+
+        injector = faults_mod.get_active()
+        if injector is not None:
+            self._apply_fault_overlay(devices, injector)
 
         fleet_alerts: list[str] = []
         if ici_links:
