@@ -75,6 +75,46 @@ class ServingSubmitRequest(BaseModel):
     temperature: float = Field(default=0.0, ge=0.0)
 
 
+class FleetStartRequest(BaseModel):
+    """Launch a scheduler-managed serving fleet: N decode replicas, each a
+    first-class ``workload="serving"`` submission through the SAME
+    FleetScheduler (priority queue, quota, HBM ledger, preemption) that
+    places training jobs."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    # Weight source (exactly one): a named model (fresh init) or an int8
+    # serving snapshot directory (quantize once, serve N replicas).
+    model_name: Optional[str] = None
+    snapshot_dir: Optional[str] = None
+    max_slots: int = Field(default=8, ge=1, le=256)
+    max_len: int = Field(default=1024, ge=8)
+    decode_chunk_steps: int = Field(default=8, ge=1, le=256)
+    prefill_chunk: int = Field(default=256, ge=16)
+    eos_id: Optional[int] = Field(default=None, ge=0)
+    seed: int = 0
+    tensor_parallel: int = Field(default=1, ge=1)
+    quantize: Optional[str] = Field(default=None, pattern="^int8$")
+    kv_cache: Optional[str] = Field(default=None, pattern="^int8$")
+    prefix_cache_tokens: int = Field(default=0, ge=0)
+    # Autoscaler envelope + SLO.
+    min_replicas: int = Field(default=1, ge=0)
+    max_replicas: int = Field(default=4, ge=1)
+    target_queue_per_replica: float = Field(default=4.0, gt=0)
+    p99_slo_ms: float = Field(default=2000.0, gt=0)
+    scale_down_cooldown_s: float = Field(default=60.0, ge=0)
+    # Scheduler identity: serving replicas share the training queue, so
+    # they carry a priority and a quota-bearing submitter like any job.
+    priority: str = Field(default="normal", pattern="^(low|normal|high|critical)$")
+    submitter: str = "serving-fleet"
+
+
+class FleetScaleRequest(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    replicas: int = Field(ge=0, le=256)
+
+
 _server: Any = None
 _stop: Optional[threading.Event] = None
 _thread: Optional[threading.Thread] = None
@@ -389,6 +429,136 @@ async def stream(request: web.Request) -> web.StreamResponse:
     return resp
 
 
+# ---------------------------------------------------------------------------
+# Serving fleet: scheduler-managed replicas (tpu_engine/serving_fleet.py).
+# One fleet per process — it owns N engines' worth of weights + KV pools.
+# ---------------------------------------------------------------------------
+
+_fleet: Any = None
+
+
+@body(FleetStartRequest)
+async def fleet_start(request: web.Request) -> web.Response:
+    req = await parse_body(request, FleetStartRequest)
+    if sum(s is not None for s in (req.model_name, req.snapshot_dir)) != 1:
+        raise ApiError(422, "provide exactly one of model_name / snapshot_dir")
+
+    def _start():
+        from tpu_engine.scheduler import JobPriority
+        from tpu_engine.serving_fleet import (
+            AutoscalerConfig, ReplicaAutoscaler, ServingFleet,
+            ServingReplicaSpec,
+        )
+
+        global _fleet
+        with _lock:
+            if _fleet is not None:
+                raise ApiError(
+                    409, "a serving fleet is already running; stop it first"
+                )
+            spec = ServingReplicaSpec(
+                model_name=req.model_name or "",
+                snapshot_dir=req.snapshot_dir,
+                max_slots=req.max_slots, max_len=req.max_len,
+                tensor_parallel=req.tensor_parallel,
+                weight_quant=req.quantize,
+                kv_quant=req.kv_cache == "int8",
+                prefill_chunk=req.prefill_chunk,
+                prefix_cache_tokens=req.prefix_cache_tokens,
+                decode_chunk_steps=req.decode_chunk_steps,
+                eos_id=req.eos_id, seed=req.seed,
+            )
+            if req.snapshot_dir is not None:
+                from tpu_engine.quant import load_quantized_config
+
+                cfg = load_quantized_config(req.snapshot_dir)
+                if cfg is None:
+                    raise ApiError(
+                        404, f"no readable quantized snapshot at "
+                             f"'{req.snapshot_dir}'"
+                    )
+                spec = spec.model_copy(update={"model_name": cfg.name})
+            if spec.estimate() is None:
+                raise ApiError(404, f"unknown model '{spec.model_name}'")
+            fleet = ServingFleet(
+                state.scheduler, spec,
+                autoscaler=ReplicaAutoscaler(AutoscalerConfig(
+                    min_replicas=req.min_replicas,
+                    max_replicas=req.max_replicas,
+                    target_queue_per_replica=req.target_queue_per_replica,
+                    p99_slo_ms=req.p99_slo_ms,
+                    scale_down_cooldown_s=req.scale_down_cooldown_s,
+                )),
+                priority=JobPriority[req.priority.upper()],
+                submitter=req.submitter,
+            )
+            fleet.start()
+            _fleet = fleet
+        return spec.model_name
+
+    model = await asyncio.to_thread(_start)
+    return json_response({
+        "started": True, "model": model,
+        "min_replicas": req.min_replicas, "max_replicas": req.max_replicas,
+    })
+
+
+def _require_fleet():
+    if _fleet is None:
+        raise ApiError(
+            409, "no serving fleet is running; POST /serving/fleet/start"
+        )
+    return _fleet
+
+
+async def fleet_status(request: web.Request) -> web.Response:
+    fleet = _require_fleet()
+    # A status read doubles as a control-loop tick: flush held requests,
+    # refresh router weights, drive the autoscaler.
+    return json_response(await asyncio.to_thread(fleet.tick))
+
+
+@body(FleetScaleRequest)
+async def fleet_scale(request: web.Request) -> web.Response:
+    fleet = _require_fleet()
+    req = await parse_body(request, FleetScaleRequest)
+    n = await asyncio.to_thread(fleet.scale_to, req.replicas)
+    return json_response({"desired_replicas": n})
+
+
+async def fleet_stop(request: web.Request) -> web.Response:
+    def _stop_sync():
+        global _fleet
+        with _lock:
+            fleet = _require_fleet()
+            fleet.stop()
+            _fleet = None
+
+    await asyncio.to_thread(_stop_sync)
+    return json_response({"stopped": True})
+
+
+@body(ServingSubmitRequest)
+async def fleet_submit(request: web.Request) -> web.Response:
+    fleet = _require_fleet()
+    req = await parse_body(request, ServingSubmitRequest)
+    fid = await asyncio.to_thread(
+        fleet.submit_request, req.prompt,
+        req.max_new_tokens, req.temperature,
+    )
+    return json_response({"request_id": fid})
+
+
+@pathparams({"request_id": "string"})
+async def fleet_result(request: web.Request) -> web.Response:
+    fleet = _require_fleet()
+    rid = request.match_info["request_id"]
+    try:
+        return json_response(await asyncio.to_thread(fleet.result, rid))
+    except KeyError:
+        raise ApiError(404, f"request '{rid}' not found")
+
+
 def setup(app: web.Application, prefix: str = "/api/v1/serving") -> None:
     app.router.add_post(f"{prefix}/start", start_server)
     app.router.add_post(f"{prefix}/stop", stop_server)
@@ -396,3 +566,9 @@ def setup(app: web.Application, prefix: str = "/api/v1/serving") -> None:
     app.router.add_get(f"{prefix}/result/{{request_id}}", result)
     app.router.add_get(f"{prefix}/stream/{{request_id}}", stream)
     app.router.add_get(f"{prefix}/stats", stats)
+    app.router.add_post(f"{prefix}/fleet/start", fleet_start)
+    app.router.add_post(f"{prefix}/fleet/stop", fleet_stop)
+    app.router.add_post(f"{prefix}/fleet/scale", fleet_scale)
+    app.router.add_post(f"{prefix}/fleet/submit", fleet_submit)
+    app.router.add_get(f"{prefix}/fleet/result/{{request_id}}", fleet_result)
+    app.router.add_get(f"{prefix}/fleet/status", fleet_status)

@@ -180,6 +180,9 @@ def main() -> None:
     chaos_line = _chaos_metric()
     if chaos_line is not None:
         print(json.dumps(chaos_line))
+    serving_line = _serving_fleet_metric()
+    if serving_line is not None:
+        print(json.dumps(serving_line))
 
 
 def _comm_compress_metric(n_dev: int) -> dict | None:
@@ -400,6 +403,37 @@ def _pipeline_schedule_metric(n_dev: int) -> dict | None:
             line["measured_pipe_stages"] = 2
             line["measured_microbatches"] = 8
         return line
+    except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
+        return None
+
+
+def _serving_fleet_metric() -> dict | None:
+    """Seventh JSON line: serving-fleet throughput on the seeded bursty
+    open-loop trace — scheduler-managed autoscaled replicas (real router +
+    autoscaler over the capacity sim, benchmarks/serving_fleet_sim.py) vs
+    a static single replica. Never fails the bench: any error degrades to
+    None."""
+    try:
+        from benchmarks.serving_fleet_sim import run_trace
+
+        trace = run_trace(seed=0)
+        auto = trace["autoscaled"]
+        return {
+            "metric": "serving_fleet_throughput_vs_static_1",
+            "value": trace["throughput_improvement"],
+            "unit": "x aggregate tokens/s (static single replica = 1.0)",
+            "tokens_per_sec": round(auto["tokens_per_sec"], 1),
+            "tokens_per_sec_per_chip": round(auto["tokens_per_sec_per_chip"], 1),
+            "p50_ms": auto["p50_ms"],
+            "p99_ms": auto["p99_ms"],
+            "p99_within_slo": auto["p99_within_slo"],
+            "p99_slo_ms": trace["p99_slo_ms"],
+            "replica_trace": auto["replica_trace"],
+            "max_replicas_used": auto["max_replicas_used"],
+            "router_weights": auto["router"]["weights"],
+            "prefix_hit_rate": auto["prefix_hit_rate"],
+            "static_p99_ms": trace["static_1_replica"]["p99_ms"],
+        }
     except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
         return None
 

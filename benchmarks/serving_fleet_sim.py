@@ -1,0 +1,322 @@
+"""Serving-fleet sim: autoscaled replicas vs a static single replica.
+
+Deterministic discrete-event comparison (virtual clock — no threads, no
+JAX, identical numbers every run) of two fleet policies on the same
+seeded bursty open-loop request trace:
+
+- **static-1** — what ``tpu_engine/serving.py`` alone gives you: one
+  decode replica; every burst queues behind its slot pool.
+- **autoscaled** — this repo's :class:`ServingFleet` control plane: the
+  REAL :class:`~tpu_engine.serving_fleet.FleetRouter` (throughput ×
+  free-slot smooth WRR + shared-prefix affinity) and the REAL
+  :class:`~tpu_engine.serving_fleet.ReplicaAutoscaler` (sliding-window
+  queue depth + p99 SLO, scale-down hysteresis) drive replica count
+  between min and max. New replicas pay a startup delay (scheduler
+  admission + weight load + compile), exactly the lag hysteresis exists
+  to hide.
+
+Replicas are capacity models, not transformers: ``SLOTS`` concurrent
+requests each decoding ``per-slot tokens/sec`` (one replica runs on a
+degraded host at a fraction of that — the router's weights, not a
+health-check binary, decide how much traffic it still deserves). A
+request's prompt opens with one of a few shared system prefixes;
+replica-side prefix caches skip the prefill for resident prefixes, which
+is what router affinity is for.
+
+Reports aggregate tokens/sec (and per chip-second, so extra replicas
+don't get their throughput for free), p50/p99 latency vs the SLO, the
+replica-count trace, router weights and affinity hit rate;
+``bench.py`` reuses :func:`run_trace` for its serving-fleet line.
+
+Run: ``python -m benchmarks.serving_fleet_sim [--seed N]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_engine.serving_fleet import (  # noqa: E402
+    AutoscalerConfig,
+    FleetRouter,
+    ReplicaAutoscaler,
+)
+
+SIM_DURATION_S = 600.0
+DT_S = 0.05                  # sim tick
+CONTROL_PERIOD_S = 1.0       # autoscaler / router refresh cadence
+SLOTS = 8                    # decode slots per replica
+TOKENS_PER_SLOT_S = 30.0     # healthy per-slot decode rate
+DEGRADED_FRACTION = 0.4      # replica 0 runs on a slow host at this rate
+PREFILL_S = 1.2              # full prefill latency (cold prefix)
+PREFILL_HIT_S = 0.15         # prefix-cache hit: decode-only prefill remainder
+STARTUP_DELAY_S = 25.0       # admission + weight load + compile for a new replica
+CHIPS_PER_REPLICA = 1
+BASE_RATE_RPS = 1.0          # open-loop arrivals outside bursts
+BURST_RATE_RPS = 14.0        # arrivals inside a burst window
+BURST_EVERY_S = 120.0
+BURST_LEN_S = 35.0
+N_PREFIXES = 4               # shared system prompts
+PREFIX_LEN = 32
+MEAN_NEW_TOKENS = 96
+P99_SLO_MS = 25_000.0
+# Latency percentiles are steady-state: the first burst cycle is warmup
+# (it lands on the min fleet by construction — what it measures is the
+# startup delay, not the policy). Throughput counts everything.
+WARMUP_S = BURST_EVERY_S
+
+AUTOSCALER = AutoscalerConfig(
+    min_replicas=1,
+    max_replicas=8,
+    target_queue_per_replica=4.0,
+    low_water_queue_per_replica=0.5,
+    p99_slo_ms=P99_SLO_MS,
+    window_s=20.0,
+    scale_up_cooldown_s=3.0,
+    scale_down_cooldown_s=90.0,
+)
+
+
+def request_trace(seed: int) -> list[dict]:
+    """Seeded bursty open-loop arrivals: [{t, prefix_id, prompt, n_new}]."""
+    rng = random.Random(seed)
+    out, t = [], 0.0
+    while t < SIM_DURATION_S:
+        in_burst = (t % BURST_EVERY_S) < BURST_LEN_S
+        rate = BURST_RATE_RPS if in_burst else BASE_RATE_RPS
+        t += rng.expovariate(rate)
+        if t >= SIM_DURATION_S:
+            break
+        pid = rng.randrange(N_PREFIXES)
+        # Prompt = shared prefix tokens + a unique tail (router affinity
+        # keys on the first tokens; the tail keeps requests distinct).
+        prompt = [pid * PREFIX_LEN + i for i in range(PREFIX_LEN)]
+        prompt.append(10_000 + len(out))
+        out.append({
+            "t": t,
+            "prefix_id": pid,
+            "prompt": prompt,
+            "n_new": max(8, int(rng.expovariate(1.0 / MEAN_NEW_TOKENS))),
+        })
+    return out
+
+
+class SimReplica:
+    """Capacity model of one decode replica: a slot pool, a per-slot decode
+    rate, and a prefix cache that skips prefill for resident prefixes."""
+
+    def __init__(self, rid: str, rate_fraction: float, ready_at: float):
+        self.rid = rid
+        self.rate = TOKENS_PER_SLOT_S * rate_fraction
+        self.ready_at = ready_at
+        self.active: list[dict] = []      # {req, prefill_left, tokens_left}
+        self.prefix_cache: set[int] = set()
+        self.tokens_out = 0.0
+        self.draining = False
+
+    def ready(self, now: float) -> bool:
+        return now >= self.ready_at
+
+    def free_slots(self, now: float) -> int:
+        if not self.ready(now) or self.draining:
+            return 0
+        return SLOTS - len(self.active)
+
+    def admit(self, req: dict) -> None:
+        hit = req["prefix_id"] in self.prefix_cache
+        self.prefix_cache.add(req["prefix_id"])
+        self.active.append({
+            "req": req,
+            "prefill_left": PREFILL_HIT_S if hit else PREFILL_S,
+            "tokens_left": float(req["n_new"]),
+            "hit": hit,
+        })
+
+    def step(self, now: float, dt: float, done: list[dict]) -> None:
+        if not self.ready(now):
+            return
+        for sl in list(self.active):
+            if sl["prefill_left"] > 0:
+                sl["prefill_left"] -= dt
+                continue
+            produced = min(self.rate * dt, sl["tokens_left"])
+            sl["tokens_left"] -= produced
+            self.tokens_out += produced
+            if sl["tokens_left"] <= 0:
+                sl["req"]["done_at"] = now
+                sl["req"]["replica"] = self.rid
+                sl["req"]["prefix_hit"] = sl["hit"]
+                done.append(sl["req"])
+                self.active.remove(sl)
+
+    def router_stats(self, now: float) -> dict:
+        # tokens/sec the router would measure: rate × busy slots (plus a
+        # trickle when idle so a fresh replica is not weight-zero).
+        busy = sum(1 for s in self.active if s["prefill_left"] <= 0)
+        return {
+            "tokens_per_sec": self.rate * max(busy, 0.2),
+            "free_slots": self.free_slots(now),
+            "slots": SLOTS,
+        }
+
+
+def _percentile(vals: list[float], q: float) -> float:
+    if not vals:
+        return 0.0
+    vals = sorted(vals)
+    return vals[min(int(q * (len(vals) - 1)), len(vals) - 1)]
+
+
+def _simulate(trace: list[dict], autoscale: bool) -> dict:
+    router = FleetRouter(affinity_tokens=PREFIX_LEN)
+    scaler = ReplicaAutoscaler(AUTOSCALER)
+    replicas: dict[str, SimReplica] = {
+        # Replica 0 is the degraded host — present from t=0 in both modes;
+        # in static mode it is the whole fleet.
+        "r0": SimReplica("r0", DEGRADED_FRACTION, ready_at=0.0)
+    }
+    next_rid = 1
+    queue: list[dict] = []
+    done: list[dict] = []
+    idx = 0
+    next_control = 0.0
+    replica_trace: list[tuple[float, int]] = []
+    chip_seconds = 0.0
+    t = 0.0
+    while t < SIM_DURATION_S or queue or any(r.active for r in replicas.values()):
+        if t > SIM_DURATION_S * 3:  # safety: a sim bug must not spin forever
+            break
+        while idx < len(trace) and trace[idx]["t"] <= t:
+            queue.append(trace[idx])
+            idx += 1
+
+        if t >= next_control:
+            next_control = t + CONTROL_PERIOD_S
+            up = {
+                r.rid: r.router_stats(t)
+                for r in replicas.values()
+                if r.ready(t) and not r.draining
+            }
+            router.update(up)
+            ready_n = len(up)
+            # Change-point trace: one entry per replica-count transition
+            # keeps the bench JSON line readable.
+            if not replica_trace or replica_trace[-1][1] != ready_n:
+                replica_trace.append((round(t, 1), ready_n))
+            if autoscale and ready_n > 0:
+                lat = [
+                    (r["done_at"] - r["t"]) * 1000.0
+                    for r in done[-256:]
+                ]
+                desired = scaler.observe(
+                    t, len(queue), _percentile(lat, 0.99) if lat else None, ready_n
+                )
+                booting = sum(
+                    1 for r in replicas.values()
+                    if not r.ready(t) and not r.draining
+                )
+                while desired > ready_n + booting:
+                    replicas[f"r{next_rid}"] = SimReplica(
+                        f"r{next_rid}", 1.0, ready_at=t + STARTUP_DELAY_S
+                    )
+                    next_rid += 1
+                    booting += 1
+                if desired < ready_n:
+                    # Drain the emptiest ready replica (never the last one).
+                    cands = sorted(
+                        (r for r in replicas.values()
+                         if r.ready(t) and not r.draining and r.rid != "r0"),
+                        key=lambda r: len(r.active),
+                    )
+                    for r in cands[: ready_n - desired]:
+                        r.draining = True
+
+        # Dispatch through the real router (affinity keys on the prefix).
+        # Route only while the fleet has a free slot — an overloaded fleet
+        # must queue, not spin the router on unplaceable requests.
+        free_total = sum(r.free_slots(t) for r in replicas.values())
+        placed = 0
+        while queue and free_total > 0:
+            req = queue[0]
+            rid = router.route(req["prompt"])
+            rep = replicas.get(rid) if rid else None
+            if rep is not None and rep.free_slots(t) > 0:
+                rep.admit(queue.pop(0))
+                free_total -= 1
+                placed += 1
+            else:
+                # Router picked a full/draining replica: stop this tick,
+                # weights refresh at the next control period.
+                break
+            if placed > SLOTS * len(replicas):
+                break
+
+        for r in list(replicas.values()):
+            r.step(t, DT_S, done)
+            if r.draining and not r.active:
+                del replicas[r.rid]
+        chip_seconds += DT_S * CHIPS_PER_REPLICA * sum(
+            1 for r in replicas.values() if r.ready(t)
+        )
+        t += DT_S
+
+    lat_ms = [
+        (r["done_at"] - r["t"]) * 1000.0 for r in done if r["t"] >= WARMUP_S
+    ]
+    # Count tokens from completed requests, not replica counters — drained
+    # replicas leave the dict and would take their counters with them.
+    total_tokens = float(sum(req["n_new"] for req in done))
+    makespan = max((r["done_at"] for r in done), default=DT_S)
+    p99 = _percentile(lat_ms, 0.99)
+    return {
+        "completed": len(done),
+        "total_tokens": total_tokens,
+        "tokens_per_sec": total_tokens / makespan,
+        "tokens_per_sec_per_chip": total_tokens / max(chip_seconds, DT_S),
+        "p50_ms": round(_percentile(lat_ms, 0.50), 1),
+        "p99_ms": round(p99, 1),
+        "p99_within_slo": p99 <= P99_SLO_MS,
+        "makespan_s": round(makespan, 1),
+        "replica_trace": replica_trace,
+        "max_replicas_used": max(n for _, n in replica_trace),
+        "prefix_hit_rate": round(
+            sum(1 for r in done if r.get("prefix_hit")) / max(len(done), 1), 3
+        ),
+        "router": router.stats(),
+        "autoscaler": scaler.stats(),
+    }
+
+
+def run_trace(seed: int = 0) -> dict:
+    trace = request_trace(seed)
+    auto = _simulate(trace, autoscale=True)
+    static = _simulate(trace, autoscale=False)
+    return {
+        "seed": seed,
+        "n_requests": len(trace),
+        "autoscaled": auto,
+        "static_1_replica": static,
+        "throughput_improvement": round(
+            auto["tokens_per_sec"] / max(static["tokens_per_sec"], 1e-9), 2
+        ),
+        "p99_improvement": round(
+            static["p99_ms"] / max(auto["p99_ms"], 1e-9), 2
+        ),
+        "p99_slo_ms": P99_SLO_MS,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    print(json.dumps(run_trace(args.seed), indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -120,6 +120,9 @@ def sched_factory():
 
         kw.setdefault("job_factory", factory)
         kw.setdefault("poll_interval_s", 0.01)
+        # Hysteresis off by default so resize tests run at test speed; the
+        # flap-plan regression test opts in with a real cooldown.
+        kw.setdefault("grow_back_cooldown_s", 0.0)
         s = FleetScheduler(**kw)
         s._stub_jobs = jobs
         created.append(s)
@@ -594,3 +597,85 @@ def test_grow_back_waits_for_queued_work(sched_factory):
     assert s.stats()["grow_backs_total"] == 0
     s._stub_jobs[0].finish()
     assert wait_until(lambda: blocked.state == SubmissionState.RUNNING)
+
+
+def test_grow_back_hysteresis_rides_out_chip_flap(sched_factory):
+    """A chip flapping healthy/unhealthy faster than the cooldown costs the
+    job ONE elastic shrink — not a preempt-requeue storm. Regression for
+    the pre-cooldown behavior where every heal window fired a grow-back
+    that the next fault immediately re-shrank."""
+    from tpu_engine import faults as faults_mod
+    from tpu_engine.faults import FaultInjector, FaultKind, FaultPlan, FaultSpec
+
+    # Chip 0 flaps: unhealthy for one injector step at steps 0, 2, 4, ...
+    plan = FaultPlan(specs=[
+        FaultSpec(
+            kind=FaultKind.CHIP_UNHEALTHY, at_step=at, device_index=0,
+            duration_steps=1,
+        )
+        for at in (0, 2, 4, 6, 8)
+    ])
+    inj = FaultInjector(plan)
+    faults_mod.set_active(inj)
+    try:
+        inj.observe_step(0)  # chip 0 down at admission time
+        mgr = TPUManager()
+        s = sched_factory(
+            max_concurrent_jobs=1,
+            fleet_fn=lambda: mgr.get_fleet_status(
+                metrics=[_chip(i) for i in range(8)]
+            ),
+            grow_back_cooldown_s=3600.0,  # cooldown >> the whole flap train
+        )
+        sub = s.submit(elastic_cfg())
+        assert wait_until(lambda: sub.state == SubmissionState.RUNNING)
+        assert sub.admitted_gang == 6 and 0 not in sub.placement
+        # Drive the flap train: each odd step heals chip 0, each even step
+        # re-faults it, with several scheduler passes inside every phase.
+        for step in range(1, 10):
+            inj.observe_step(step)
+            time.sleep(0.06)
+        st = s.stats()
+        assert st["grow_backs_total"] == 0
+        assert st["requeues_total"] == 0
+        assert sub.attempts == 1 and sub.admitted_gang == 6
+        assert sub.state == SubmissionState.RUNNING
+        # Flap train exhausted (chip stays healthy). Once the operator's
+        # cooldown has elapsed the ONE grow-back proceeds as usual.
+        s.grow_back_cooldown_s = 0.0
+        assert wait_until(
+            lambda: sub.state == SubmissionState.RUNNING
+            and sub.admitted_gang == 8,
+            timeout=10.0,
+        )
+        assert s.stats()["grow_backs_total"] == 1
+    finally:
+        faults_mod.set_active(None)
+
+
+def test_per_submitter_wait_and_goodput_stats(sched_factory):
+    """Multi-tenant observability: queue wait and device-holding goodput
+    are attributed per submitter, so a noisy neighbour shows up as THEIR
+    numbers, not an anonymous fleet average."""
+    s = sched_factory(max_concurrent_jobs=1)
+    a = s.submit(cfg(), submitter="alice")
+    assert wait_until(lambda: a.state == SubmissionState.RUNNING)
+    b = s.submit(cfg(), submitter="bob")  # queued behind alice
+    time.sleep(0.05)
+    per = s.stats()["per_submitter"]
+    assert per["alice"]["running"] == 1 and per["alice"]["queued"] == 0
+    assert per["bob"]["queued"] == 1 and per["bob"]["running"] == 0
+
+    s._stub_jobs[0].finish()
+    assert wait_until(lambda: a.state == SubmissionState.COMPLETED)
+    assert wait_until(lambda: b.state == SubmissionState.RUNNING)
+    s._stub_jobs[1].finish()
+    assert wait_until(lambda: b.state == SubmissionState.COMPLETED)
+    per = s.stats()["per_submitter"]
+    assert per["alice"]["completed_total"] == 1
+    assert per["bob"]["completed_total"] == 1
+    # Goodput: both held the device for a measurable interval.
+    assert per["alice"]["goodput_busy_s"] > 0
+    assert per["bob"]["goodput_busy_s"] > 0
+    # Bob queued behind alice's run; alice was admitted immediately.
+    assert per["bob"]["mean_wait_s"] >= per["alice"]["mean_wait_s"]

@@ -862,3 +862,34 @@ def test_prefix_cache_store_policy():
     # Budget-capped lookup: a long prompt probes only up to the budget.
     L, e = c.lookup(list(range(200, 264)) + list(range(500, 600)))
     assert L == 64 and e is not None
+
+
+def test_prefix_cache_rejects_oversized_entry():
+    """An entry whose DEVICE footprint (its lane count) exceeds the whole
+    budget is rejected outright — the old behavior evicted every resident
+    prefix to admit an entry that could never pay for itself. The ledger
+    now charges entry lanes, the same unit eviction credits, so an entry
+    with more lanes than key tokens can no longer drive the token count
+    negative (which permanently disabled eviction)."""
+    from tpu_engine.serving import _PrefixCache
+
+    class _E:  # stands in for a KVCache slice
+        def __init__(self, n):
+            self.max_len = n
+
+    c = _PrefixCache(budget_tokens=96, chunk=16)
+    c.insert(tuple(range(48)), _E(48))
+    assert c.tokens == 48
+    # Key fits the budget but the KV slice does not (ring lanes can exceed
+    # the key length): rejected, the resident working set is untouched.
+    c.insert(tuple(range(100, 180)), _E(128))
+    assert c.tokens == 48 and len(c._entries) == 1
+    assert c.lookup(list(range(48)))[1] is not None
+    # Ledger symmetry: a 32-token key over a 90-lane slice charges 90 —
+    # inserting it evicts the 48 (48 + 90 > 96) and the count stays exact.
+    c.insert(tuple(range(200, 232)), _E(90))
+    assert c.tokens == 90 and len(c._entries) == 1
+    # Eviction credits the same 90 it charged: never negative, and the
+    # budget keeps evicting correctly afterwards.
+    c.insert(tuple(range(300, 396)), _E(96))
+    assert c.tokens == 96 and len(c._entries) == 1

@@ -90,6 +90,10 @@ class HBMEstimate(BaseModel):
     logits_gib: float  # fp32 loss logits chunk
     device_total_gib: float  # sum of the device-resident terms
     host_gib: float  # offloaded (pinned_host / disk-staging) state
+    # Serving-only plane: the slot-pool KV cache (max_slots × lanes at the
+    # replica's KV dtype). Zero for training jobs — their KV never outlives
+    # a forward pass, so it rides the activations term.
+    kv_pool_gib: float = 0.0
     notes: list[str] = Field(default_factory=list)
 
 
@@ -289,5 +293,110 @@ def estimate_job_hbm(
         logits_gib=round(logits_dev / _GIB, 4),
         device_total_gib=round(total / _GIB, 4),
         host_gib=round(host_bytes / _GIB, 4),
+        notes=notes,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Serving plane: KV-pool projection for a decode replica.
+# ---------------------------------------------------------------------------
+
+
+def estimate_serving_hbm(
+    model_name: str,
+    max_slots: int,
+    max_len: int,
+    *,
+    tensor_parallel: int = 1,
+    compute_dtype: Precision = Precision.BF16,
+    kv_quant: bool = False,
+    weight_quant: Optional[str] = None,
+    prefill_chunk: int = 256,
+    prefix_cache_tokens: int = 0,
+) -> Optional[HBMEstimate]:
+    """Per-device HBM projection for one decode replica.
+
+    The training estimator's weight-shaped terms mostly vanish here (no
+    grads, no optimizer state, no saved activations); what dominates instead
+    is the **KV pool** — ``max_slots`` fully-committed slots of
+    ``ring_lanes(max_len)`` each, the cost the training plane never pays and
+    the reason serving admission needs its own estimate. Mirrors the actual
+    allocation in ``tpu_engine/serving.py``:
+
+    - params at the serving dtype, or int8 codes + per-channel fp32 scales
+      when the replica loads a ``quant.py`` snapshot (``weight_quant="int8"``),
+      divided over the ``model`` (tensor-parallel) axis;
+    - K and V per layer: ``[slots, lanes, n_kv_heads, head_dim]`` at the
+      compute dtype, or int8 codes plus per-(lane, kv-head) fp32 scales when
+      ``kv_quant`` — the exact layout ``init_slot_cache`` builds, kv-heads
+      sharded over the model axis when divisible;
+    - the shared-prefix cache's budgeted lanes, plus a rounded-up decode /
+      prefill workspace (one chunk's activations and the fp32 logits rows).
+
+    Returns None for unknown model names — the scheduler then degrades the
+    serving submission to capacity-only admission, same as training.
+    """
+    from tpu_engine.generate import ring_lanes
+    from tpu_engine.models import transformer as tfm
+
+    cfg = tfm.MODEL_CONFIGS.get(model_name)
+    if cfg is None:
+        return None
+
+    tp = max(int(tensor_parallel), 1)
+    slots = max(int(max_slots), 1)
+    compute_b = _itemsize(compute_dtype)
+    notes: list[str] = []
+
+    n_params = tfm.param_count(cfg)
+    if weight_quant == "int8":
+        # quant.py stores int8 codes + one fp32 scale per output channel of
+        # each kernel (~4/d_model of the kernel's size); 2% rounds that up.
+        params_dev = n_params * 1.02 / tp
+        notes.append("weights: int8 snapshot (codes + per-channel fp32 scales)")
+    else:
+        params_dev = n_params * compute_b / tp
+
+    # KV pool: k and v, [L, slots, lanes, KV, HD]; kv-heads shard over the
+    # model axis only when divisible (serving.py falls back to replicated).
+    lanes = ring_lanes(cfg, int(max_len), int(prefill_chunk))
+    kv_shard = tp if cfg.n_kv_heads % tp == 0 else 1
+    if kv_shard == 1 and tp > 1:
+        notes.append(f"kv pool replicated: {cfg.n_kv_heads} kv-heads !% model={tp}")
+    kv_cells = 2 * cfg.n_layers * slots * lanes * cfg.n_kv_heads * cfg.head_dim
+    if kv_quant:
+        # int8 codes + fp32 scale per (lane, kv-head) row of each of k/v.
+        kv_pool = kv_cells * 1 + 2 * cfg.n_layers * slots * lanes * cfg.n_kv_heads * 4
+        notes.append("kv pool: int8 codes + per-(lane, kv-head) fp32 scales")
+    else:
+        kv_pool = kv_cells * compute_b
+    kv_pool /= kv_shard
+    if prefix_cache_tokens > 0:
+        # Shared-prefix entries are extra KV lanes outside the slot pool,
+        # bounded by the token budget (eviction enforces it).
+        per_tok = 2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim
+        per_tok = per_tok * 1 + 2 * cfg.n_layers * cfg.n_kv_heads * 4 if kv_quant \
+            else per_tok * compute_b
+        kv_pool += prefix_cache_tokens * per_tok / kv_shard
+
+    # Decode/prefill workspace: one prefill chunk's layer activations for
+    # the widest dispatch plus every slot's fp32 logits row.
+    chunk = max(int(prefill_chunk), 1)
+    working = chunk * (4 * cfg.d_model + 2 * cfg.d_ff) * compute_b / tp
+    logits = slots * cfg.vocab_size * 4 / tp
+
+    total = params_dev + kv_pool + working + logits
+    return HBMEstimate(
+        model_name=model_name,
+        gang_devices=tp,
+        params_gib=round(params_dev / _GIB, 4),
+        grads_gib=0.0,
+        opt_gib=0.0,
+        working_gib=round(working / _GIB, 4),
+        activations_gib=0.0,
+        logits_gib=round(logits / _GIB, 4),
+        device_total_gib=round(total / _GIB, 4),
+        host_gib=0.0,
+        kv_pool_gib=round(kv_pool / _GIB, 4),
         notes=notes,
     )

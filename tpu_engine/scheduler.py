@@ -102,16 +102,27 @@ class Submission:
         submitter: str,
         seq: int,
         job_kwargs: Optional[dict[str, Any]] = None,
+        workload: str = "training",
+        estimate_fn: Optional[Callable[..., Optional[HBMEstimate]]] = None,
+        job_factory: Optional[Callable[["Submission"], Any]] = None,
     ):
         ts = datetime.now(timezone.utc).strftime("%Y%m%d_%H%M%S")
         self.submission_id = f"sub_{ts}_{uuid.uuid4().hex[:6]}"
         # Attempts reuse this id so the registry's newest entry wins.
-        self.job_id = f"tpu_{config.model_name}_{ts}_{uuid.uuid4().hex[:6]}"
+        prefix = "srv" if workload == "serving" else "tpu"
+        self.job_id = f"{prefix}_{config.model_name}_{ts}_{uuid.uuid4().hex[:6]}"
         self.config = config
         self.priority = priority
         self.submitter = submitter
         self.seq = seq  # FIFO tiebreak within a priority class; kept on requeue
         self.job_kwargs = job_kwargs or {}
+        # Workload class: "training" (the default) or "serving" (a decode
+        # replica — same queue/quota/ledger, but its own footprint estimator
+        # and job factory, carried per-submission so one scheduler admits
+        # both side by side).
+        self.workload = workload
+        self.estimate_fn = estimate_fn
+        self.job_factory = job_factory
 
         self.state = SubmissionState.QUEUED
         self.job: Optional[TrainingJob] = None
@@ -128,17 +139,25 @@ class Submission:
         # compares the healthy fleet against admitted_gang.
         self.shrunk_mesh: Optional[dict[str, int]] = None
         self.admitted_gang: Optional[int] = None
+        # Last shrink/grow resize of this submission — the grow-back
+        # hysteresis clock (a flapping chip must not thrash a job through
+        # shrink/grow cycles faster than the cooldown).
+        self.last_resize_at: Optional[float] = None
+        self.last_admitted_at: Optional[float] = None
 
     @property
     def preemptible(self) -> bool:
-        """Preemption is only safe when the emergency-save path exists: a
-        watcher to fire and a checkpoint dir for the synchronous save the
-        requeued attempt resumes from."""
-        return (
-            self.job is not None
-            and self.job.watcher is not None
-            and bool(self.config.checkpoint_dir)
-        )
+        """Preemption is only safe when the job can be rebuilt from durable
+        state. Training needs the full emergency-save path — a watcher to
+        fire and a checkpoint dir the requeued attempt resumes from. A
+        serving replica is stateless above its snapshot (in-flight requests
+        are re-dispatched by the fleet router), so the watcher alone
+        suffices: checkpoint-free teardown."""
+        if self.job is None or self.job.watcher is None:
+            return False
+        if self.workload == "serving":
+            return True
+        return bool(self.config.checkpoint_dir)
 
     @property
     def wait_s(self) -> Optional[float]:
@@ -153,6 +172,7 @@ class Submission:
             "state": self.state.value,
             "priority": self.priority.name.lower(),
             "submitter": self.submitter,
+            "workload": self.workload,
             "model_name": self.config.model_name,
             "attempts": self.attempts,
             "preemptions": self.preemptions,
@@ -205,8 +225,15 @@ class FleetScheduler:
         checkpoint_root: Optional[str] = None,
         poll_interval_s: float = 0.1,
         grow_back: bool = True,
+        grow_back_cooldown_s: float = 30.0,
     ):
         self.grow_back = grow_back
+        # Hysteresis window: a shrunk job is not grown back until this long
+        # after its last shrink/grow resize — a chip flapping between
+        # healthy and unhealthy faster than the cooldown costs the job ONE
+        # shrink, not a preempt-requeue storm (each cycle pays an emergency
+        # save + recompile).
+        self.grow_back_cooldown_s = grow_back_cooldown_s
         self.max_concurrent_jobs = max_concurrent_jobs
         self.fleet_fn = fleet_fn
         self.job_factory = job_factory
@@ -235,6 +262,12 @@ class FleetScheduler:
         self.grow_backs_total = 0
         self.self_heal_requeues_total = 0
         self._wait_samples: list[float] = []  # bounded; admitted-wait seconds
+        # Per-submitter planes (the fairness follow-on needs a measured
+        # baseline): admitted-wait samples and accumulated busy seconds
+        # (admission → reap, summed across attempts — the goodput proxy).
+        self._tenant_waits: dict[str, list[float]] = {}
+        self._tenant_busy_s: dict[str, float] = {}
+        self._tenant_completed: dict[str, int] = {}
 
         self._shutdown = threading.Event()
         self._wake = threading.Event()
@@ -248,9 +281,17 @@ class FleetScheduler:
         priority: JobPriority = JobPriority.NORMAL,
         submitter: str = "anonymous",
         job_kwargs: Optional[dict[str, Any]] = None,
+        workload: str = "training",
+        estimate_fn: Optional[Callable[..., Optional[HBMEstimate]]] = None,
+        job_factory: Optional[Callable[[Submission], Any]] = None,
     ) -> Submission:
         """Enqueue; raises :class:`QuotaExceeded` when the submitter already
-        holds their quota of active (queued/running) submissions."""
+        holds their quota of active (queued/running) submissions.
+
+        ``workload="serving"`` enters the SAME queue/quota/ledger as
+        training, carrying its own ``estimate_fn`` (the KV-pool plane) and
+        ``job_factory`` (a decode replica, not a train loop) — see
+        ``tpu_engine/serving_fleet.py``."""
         with self._lock:
             quota = self.quotas.get(submitter, self.default_quota)
             if quota is not None:
@@ -261,9 +302,14 @@ class FleetScheduler:
                 )
                 if active >= quota:
                     raise QuotaExceeded(submitter, quota)
-            if not config.checkpoint_dir and self.checkpoint_root:
+            if (
+                workload == "training"
+                and not config.checkpoint_dir
+                and self.checkpoint_root
+            ):
                 # Preemptibility needs somewhere to emergency-save; give the
                 # submission a stable dir its requeued attempts resume from.
+                # (Serving replicas tear down checkpoint-free — no dir.)
                 config = config.model_copy(
                     update={
                         "checkpoint_dir": (
@@ -272,7 +318,11 @@ class FleetScheduler:
                     }
                 )
             self._seq += 1
-            sub = Submission(config, priority, submitter, self._seq, job_kwargs)
+            sub = Submission(
+                config, priority, submitter, self._seq, job_kwargs,
+                workload=workload, estimate_fn=estimate_fn,
+                job_factory=job_factory,
+            )
             self._subs[sub.submission_id] = sub
             self.submitted_total += 1
         self._ensure_thread()
@@ -388,11 +438,22 @@ class FleetScheduler:
                 self._reserved[idx] = left
         sub.placement = []
 
+    def _credit_busy(self, sub: Submission) -> None:
+        """Accumulate this attempt's admission→reap seconds to the
+        submitter's goodput lane (summed across attempts)."""
+        if sub.last_admitted_at is None:
+            return
+        self._tenant_busy_s[sub.submitter] = self._tenant_busy_s.get(
+            sub.submitter, 0.0
+        ) + max(time.time() - sub.last_admitted_at, 0.0)
+        sub.last_admitted_at = None
+
     def _reap(self) -> None:
         for sub in self._active():
             job = sub.job
             if job is None or job.is_alive:
                 continue
+            self._credit_busy(sub)
             if job.status == JobStatus.PREEMPTED and sub.state != SubmissionState.CANCELLING:
                 # Emergency save completed (the train loop's final
                 # force+wait save runs before the thread exits) — requeue
@@ -424,6 +485,9 @@ class FleetScheduler:
                 elif job.status == JobStatus.COMPLETED:
                     sub.state = SubmissionState.COMPLETED
                     self.completed_total += 1
+                    self._tenant_completed[sub.submitter] = (
+                        self._tenant_completed.get(sub.submitter, 0) + 1
+                    )
                 elif job.status == JobStatus.STOPPED:
                     sub.state = SubmissionState.CANCELLED
                     self.cancelled_total += 1
@@ -483,8 +547,9 @@ class FleetScheduler:
         n_avail = len(eligible) if eligible is not None else jax.device_count()
 
         gang = gang_size(sub.config, n_avail)
+        estimate_fn = sub.estimate_fn or self.estimate_fn
         try:
-            est = self.estimate_fn(sub.config, n_avail)
+            est = estimate_fn(sub.config, n_avail)
         except Exception:  # estimator must never block admission
             est = None
         sub.estimate = est
@@ -497,7 +562,7 @@ class FleetScheduler:
                 # bounds is admitted at the largest mesh its bounds allow on
                 # the healthy remainder instead of being skipped — the
                 # paper's keep-training-on-a-degraded-fleet behavior.
-                shrink = elastic_shrink_plan(sub.config, len(eligible), self.estimate_fn)
+                shrink = elastic_shrink_plan(sub.config, len(eligible), estimate_fn)
                 if shrink is None:
                     sub.last_skip_reason = (
                         f"gang of {gang} device(s) > {len(eligible)} healthy chip(s)"
@@ -551,7 +616,7 @@ class FleetScheduler:
             sub.job_kwargs["devices"] = devs
 
         try:
-            job = self.job_factory(sub)
+            job = (sub.job_factory or self.job_factory)(sub)
         except Exception as e:  # noqa: BLE001 — constructor boundary
             sub.state = SubmissionState.FAILED
             sub.finished_at = time.time()
@@ -566,7 +631,9 @@ class FleetScheduler:
         sub.placement = placement
         sub.admitted_gang = gang
         sub.shrunk_mesh = shrunk_mesh.model_dump() if shrunk_mesh is not None else None
+        sub.last_admitted_at = time.time()
         if shrunk_mesh is not None:
+            sub.last_resize_at = sub.last_admitted_at
             self.elastic_shrinks_total += 1
             log.warning(
                 "scheduler: elastic-shrink admission of %s — configured gang "
@@ -582,6 +649,9 @@ class FleetScheduler:
             sub.first_admitted_at = time.time()
             self._wait_samples.append(sub.wait_s or 0.0)
             del self._wait_samples[:-1000]
+            waits = self._tenant_waits.setdefault(sub.submitter, [])
+            waits.append(sub.wait_s or 0.0)
+            del waits[:-200]
         self.admitted_total += 1
         job.start()
         log.info(
@@ -630,6 +700,7 @@ class FleetScheduler:
         healthy = sum(
             1 for d in fleet.devices if d.health_status != TPUHealthStatus.CRITICAL
         )
+        now = time.time()
         for sub in self._subs.values():
             if (
                 sub.state != SubmissionState.RUNNING
@@ -637,6 +708,17 @@ class FleetScheduler:
                 or sub.admitted_gang is None
                 or not sub.preemptible
             ):
+                continue
+            if (
+                self.grow_back_cooldown_s > 0
+                and sub.last_resize_at is not None
+                and now - sub.last_resize_at < self.grow_back_cooldown_s
+            ):
+                # Hysteresis: the chip that freed up may be the same one
+                # that flapped this job into its shrink moments ago — hold
+                # the grow until the fleet has stayed healthy a full
+                # cooldown, or a flap cadence under the window turns into a
+                # preempt/save/recompile storm.
                 continue
             full = gang_size(sub.config, healthy)
             if full <= healthy and full > sub.admitted_gang:
@@ -648,6 +730,7 @@ class FleetScheduler:
                 target = plan[1]
             self.grow_backs_total += 1
             sub.state = SubmissionState.PREEMPTING
+            sub.last_resize_at = now
             self.preemptions_total += 1
             log.info(
                 "scheduler: growing %s back — %d healthy chip(s) now admit "
@@ -727,6 +810,27 @@ class FleetScheduler:
         for s in queued:
             by_priority[s.priority.name.lower()] += 1
         waits = self._wait_samples
+        tenants = sorted(
+            {s.submitter for s in self._subs.values()}
+            | set(self._tenant_waits) | set(self._tenant_busy_s)
+        )
+        per_submitter = {}
+        for t in tenants:
+            t_waits = self._tenant_waits.get(t, [])
+            t_subs = [s for s in self._subs.values() if s.submitter == t]
+            per_submitter[t] = {
+                "queued": sum(
+                    1 for s in t_subs if s.state == SubmissionState.QUEUED
+                ),
+                "running": sum(
+                    1 for s in t_subs if s.state == SubmissionState.RUNNING
+                ),
+                "mean_wait_s": (
+                    round(sum(t_waits) / len(t_waits), 4) if t_waits else 0.0
+                ),
+                "completed_total": self._tenant_completed.get(t, 0),
+                "goodput_busy_s": round(self._tenant_busy_s.get(t, 0.0), 3),
+            }
         return {
             "queue_depth": len(queued),
             "queue_depth_by_priority": by_priority,
@@ -752,7 +856,13 @@ class FleetScheduler:
                 for s in self._subs.values()
                 if s.state == SubmissionState.RUNNING and s.shrunk_mesh is not None
             ),
+            "running_serving": sum(
+                1
+                for s in self._subs.values()
+                if s.state == SubmissionState.RUNNING and s.workload == "serving"
+            ),
             "reserved_hbm_gib": round(sum(self._reserved.values()), 3),
+            "per_submitter": per_submitter,
             "draining": self._draining,
         }
 

@@ -508,14 +508,24 @@ class _PrefixCache:
         self.tokens -= old.max_len
 
     def insert(self, prefix: tuple, entry: KVCache) -> None:
-        L = len(prefix)
         if not self.wants(prefix):
             return
-        while self.tokens + L > self.budget and self._entries:
+        # Charge the entry's DEVICE footprint (its lane count), the same
+        # unit _drop credits back — charging the key length instead lets an
+        # entry whose lanes exceed its key corrupt the token ledger (tokens
+        # goes negative on its eviction, and the budget never evicts
+        # again). An entry that alone exceeds the whole budget is rejected
+        # outright: evicting every resident prefix to fit one oversized
+        # slice trades the fleet's shared working set for an entry whose
+        # excess lanes can never be hit.
+        size = int(entry.max_len)
+        if size > self.budget:
+            return
+        while self.tokens + size > self.budget and self._entries:
             self._drop(next(iter(self._entries)))
         self._entries[prefix] = entry
         self._keys[prefix] = np.asarray(prefix, dtype=np.int64)
-        self.tokens += L
+        self.tokens += size
 
     def stats(self) -> dict[str, int]:
         return {

@@ -1,0 +1,809 @@
+"""Serving fleet: scheduler-managed decode replicas over the training fleet.
+
+``tpu_engine/serving.py`` is one in-process :class:`ContinuousBatcher`; this
+module is the subsystem that makes inference a first-class
+:class:`~tpu_engine.scheduler.FleetScheduler` workload — the "serves heavy
+traffic from millions of users" path:
+
+- :class:`ServingReplicaSpec` — one replica's shape: model, slot pool,
+  max sequence length, tensor parallelism, weight/KV quantization, prefix
+  cache budget. Its HBM footprint goes through the KV-pool plane
+  (:func:`tpu_engine.hbm_estimate.estimate_serving_hbm`) so admission is
+  gated on params + ``max_slots × lanes`` of KV at the replica's dtype,
+  against the same per-device reservation ledger training jobs use
+  (placement-semantics stance: ONE cost model for every placement
+  decision, arXiv:2601.02311).
+
+- :class:`ServingReplicaJob` — the scheduler-driven lifecycle around one
+  decode engine. Submitted with ``workload="serving"`` it rides the same
+  priority queue, quotas, drain/cancel and preempt machinery as training;
+  a CRITICAL training job evicts it through the ordinary watcher seam, but
+  the teardown is **checkpoint-free** — a replica is stateless above its
+  snapshot, so eviction drops the engine and the scheduler requeues the
+  submission for re-admission when the training job drains.
+
+- :class:`FleetRouter` — smooth weighted round-robin dispatch, weighted by
+  each replica's measured decode throughput × free-slot fraction (Poplar's
+  serve-the-degraded-host-less stance, arXiv:2408.12596), with
+  shared-prefix affinity: requests opening with a system prompt already
+  resident in some replica's prefix cache land on that replica.
+
+- :class:`ReplicaAutoscaler` — replica count between min/max against a
+  sliding window of queue depth and a p99-latency SLO, scale-down behind a
+  hysteresis cooldown so a traffic dip does not thrash replicas the next
+  burst needs. Pure function of (now, observation) — virtual-clock
+  drivable, which is how ``benchmarks/serving_fleet_sim.py`` proves it.
+
+- :class:`ServingFleet` — the orchestrator tying them together: submits
+  replicas, routes requests, ticks the autoscaler, reports stats (the
+  ``tpu_engine_serving_fleet_*`` Prometheus families render them).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from pydantic import BaseModel, ConfigDict, Field
+
+from tpu_engine.hbm_estimate import HBMEstimate, estimate_serving_hbm
+from tpu_engine.mesh_runtime import MeshConfig
+from tpu_engine.scheduler import (
+    TERMINAL_STATES,
+    FleetScheduler,
+    JobPriority,
+    Submission,
+    SubmissionState,
+)
+from tpu_engine.sharding import Precision, TPUTrainConfig
+from tpu_engine.supervisor import JobStatus
+
+log = logging.getLogger(__name__)
+
+
+class ServingReplicaSpec(BaseModel):
+    """Shape of one decode replica — every replica of a fleet is identical
+    (heterogeneity is handled by the router's measured weights, not by
+    per-replica shapes)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    model_name: str
+    # Weight source: an int8 serving snapshot directory written by
+    # ``TrainingJob.export_quantized_snapshot`` (quantize once, serve N
+    # replicas), or None → fresh deterministic init (test/demo use).
+    snapshot_dir: Optional[str] = None
+    max_slots: int = Field(default=8, ge=1, le=256)
+    max_len: int = Field(default=1024, ge=8)
+    tensor_parallel: int = Field(default=1, ge=1)
+    compute_dtype: Precision = Precision.BF16
+    # "int8" → weight-only quantization (snapshot weights arrive already
+    # quantized; a fresh init is quantized at build).
+    weight_quant: Optional[str] = Field(default=None, pattern="^int8$")
+    kv_quant: bool = False
+    prefill_chunk: int = Field(default=256, ge=16)
+    prefix_cache_tokens: int = Field(default=0, ge=0)
+    decode_chunk_steps: int = Field(default=8, ge=1)
+    eos_id: Optional[int] = Field(default=None, ge=0)
+    seed: int = 0
+
+    def placement_config(self) -> TPUTrainConfig:
+        """The config the scheduler queues for one replica: its mesh IS the
+        replica's gang (tensor_parallel devices), and everything
+        weight-shaped about footprint comes from the serving estimator, not
+        from this stub's training fields."""
+        return TPUTrainConfig(
+            model_name=self.model_name,
+            mesh=MeshConfig(data=1, model=self.tensor_parallel),
+            micro_batch_size=1,
+            seq_len=32,
+            precision=self.compute_dtype,
+            checkpoint_dir=None,  # checkpoint-free teardown
+        )
+
+    def estimate(self, *_args: Any, **_kw: Any) -> Optional[HBMEstimate]:
+        """KV-pool HBM plane for this replica (scheduler ``estimate_fn``
+        signature: extra args are the config/n_avail it passes — the spec
+        already knows its own shape)."""
+        return estimate_serving_hbm(
+            self.model_name,
+            self.max_slots,
+            self.max_len,
+            tensor_parallel=self.tensor_parallel,
+            compute_dtype=self.compute_dtype,
+            kv_quant=self.kv_quant,
+            weight_quant=(
+                "int8" if self.snapshot_dir is not None else self.weight_quant
+            ),
+            prefill_chunk=self.prefill_chunk,
+            prefix_cache_tokens=self.prefix_cache_tokens,
+        )
+
+
+def build_replica_engine(spec: ServingReplicaSpec) -> Any:
+    """Default engine factory: a real :class:`ContinuousBatcher` from the
+    spec's weight source (int8 snapshot or fresh init), mesh-sharded when
+    ``tensor_parallel > 1``. Heavy imports stay inside — fleets under test
+    or simulation inject their own factory and never touch JAX."""
+    import jax
+
+    from tpu_engine.models import transformer as tfm
+    from tpu_engine.serving import ContinuousBatcher
+
+    mesh = None
+    if spec.snapshot_dir is not None:
+        from tpu_engine.quant import load_quantized, load_quantized_config
+
+        cfg = load_quantized_config(spec.snapshot_dir)
+        if cfg is None:
+            raise ValueError(
+                f"snapshot at '{spec.snapshot_dir}' has no recorded model_config"
+            )
+        qsh = None
+        if spec.tensor_parallel > 1:
+            from tpu_engine.mesh_runtime import build_mesh
+            from tpu_engine.models.transformer import init_params, logical_axes
+            from tpu_engine.quant import quantize_params, quantize_pspecs
+            from tpu_engine.sharding import (
+                ShardingStage,
+                named_shardings,
+                param_pspecs,
+            )
+
+            mesh = build_mesh(MeshConfig(model=spec.tensor_parallel))
+            abs_q = jax.eval_shape(quantize_params, jax.eval_shape(
+                lambda k: init_params(k, cfg), jax.random.PRNGKey(0)
+            ))
+            qsh = named_shardings(mesh, quantize_pspecs(
+                param_pspecs(logical_axes(cfg), ShardingStage.FULL_PARTITIONING),
+                abs_q,
+            ))
+        params = load_quantized(spec.snapshot_dir, shardings=qsh)
+    else:
+        cfg = tfm.MODEL_CONFIGS.get(spec.model_name)
+        if cfg is None:
+            raise ValueError(f"unknown model '{spec.model_name}'")
+        params = tfm.init_params(jax.random.PRNGKey(spec.seed), cfg)
+        if spec.weight_quant == "int8":
+            from tpu_engine.quant import quantize_params
+
+            params = quantize_params(params)
+        if spec.tensor_parallel > 1:
+            from tpu_engine.mesh_runtime import build_mesh
+            from tpu_engine.models.transformer import logical_axes
+            from tpu_engine.sharding import (
+                ShardingStage,
+                named_shardings,
+                param_pspecs,
+            )
+
+            mesh = build_mesh(MeshConfig(model=spec.tensor_parallel))
+            specs = param_pspecs(logical_axes(cfg), ShardingStage.FULL_PARTITIONING)
+            if spec.weight_quant == "int8":
+                from tpu_engine.quant import quantize_pspecs
+
+                specs = quantize_pspecs(specs, params)
+            params = jax.device_put(params, named_shardings(mesh, specs))
+
+    return ContinuousBatcher(
+        params, cfg, max_slots=spec.max_slots, max_len=spec.max_len,
+        eos_id=spec.eos_id, seed=spec.seed,
+        chunk_steps=spec.decode_chunk_steps,
+        prefill_chunk=spec.prefill_chunk, mesh=mesh,
+        kv_quant=spec.kv_quant,
+        prefix_cache_tokens=spec.prefix_cache_tokens,
+    )
+
+
+class _ReplicaWatcher:
+    """The scheduler's preempt verb for a replica: no GCE poll, no
+    emergency save — fire the event, the job loop tears the engine down."""
+
+    def __init__(self) -> None:
+        self.fired = threading.Event()
+
+    def simulate_interruption(self) -> None:
+        self.fired.set()
+
+
+class ServingReplicaJob:
+    """One decode replica under scheduler lifecycle.
+
+    Presents the job surface :class:`FleetScheduler` drives (``start`` /
+    ``join`` / ``is_alive`` / ``status`` / ``watcher`` / ``_stop``) around
+    an injected engine. The run thread builds the engine (weight load —
+    potentially slow — happens off the scheduler's admit pass), then pumps
+    ``engine.step()`` until stopped or preempted. Preemption is
+    checkpoint-free: drop the engine, report ``PREEMPTED`` — the scheduler
+    requeues the submission and a later admission rebuilds from the
+    snapshot. In-flight requests die with the engine; the fleet router
+    re-dispatches them (stateless-above-the-snapshot is the contract that
+    makes replicas safely evictable by CRITICAL training jobs).
+    """
+
+    def __init__(
+        self,
+        sub: Submission,
+        spec: ServingReplicaSpec,
+        engine_factory: Callable[[ServingReplicaSpec], Any] = build_replica_engine,
+        idle_sleep_s: float = 0.005,
+    ):
+        self.job_id = sub.job_id
+        self.config = sub.config
+        self.spec = spec
+        self.status = JobStatus.PENDING
+        self.error: Optional[str] = None
+        self.current_step = 0  # tokens generated — the replica's "progress"
+        self.watcher = _ReplicaWatcher()
+        self.engine: Any = None
+        self.engine_ready = threading.Event()
+        self._engine_factory = engine_factory
+        self._idle_sleep_s = idle_sleep_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"serving-replica-{self.job_id}"
+        )
+
+    @property
+    def is_alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self._thread.join(timeout)
+
+    def describe(self) -> dict[str, Any]:
+        return {
+            "job_id": self.job_id,
+            "status": self.status.value,
+            "workload": "serving",
+            "model_name": self.spec.model_name,
+            "tokens_generated": self.current_step,
+            "engine_ready": self.engine_ready.is_set(),
+            "error": self.error,
+        }
+
+    def _run(self) -> None:
+        try:
+            engine = self._engine_factory(self.spec)
+        except Exception as e:  # noqa: BLE001 — weight load / build boundary
+            self.status = JobStatus.FAILED
+            self.error = f"{type(e).__name__}: {e}"
+            log.exception("serving replica %s: engine build failed", self.job_id)
+            return
+        self.engine = engine
+        self.engine_ready.set()
+        self.status = JobStatus.RUNNING
+        try:
+            while True:
+                if self.watcher.fired.is_set():
+                    self.status = JobStatus.PREEMPTED
+                    return
+                if self._stop.is_set():
+                    self.status = JobStatus.STOPPED
+                    return
+                produced = int(engine.step() or 0)
+                self.current_step += produced
+                if produced == 0:
+                    self._stop.wait(self._idle_sleep_s)
+        except Exception as e:  # noqa: BLE001 — decode loop boundary
+            self.status = JobStatus.FAILED
+            self.error = f"{type(e).__name__}: {e}"
+            log.exception("serving replica %s: decode loop failed", self.job_id)
+        finally:
+            # Checkpoint-free teardown: the engine (params + KV pool) is
+            # this thread's only strong reference — dropping it frees the
+            # replica's HBM for whoever preempted us.
+            self.engine = None
+            self.engine_ready.clear()
+
+
+class FleetRouter:
+    """Throughput-weighted dispatch with shared-prefix affinity.
+
+    Smooth weighted round-robin (the nginx algorithm) over
+    ``weight = (ε + tokens/sec) × (ε + free-slot fraction)``: a degraded
+    replica — slow host, busy slots — serves proportionally less traffic
+    instead of gating the fleet, and a cold replica (no throughput yet)
+    still receives work through the ε floor. Requests whose leading
+    ``affinity_tokens`` match a previously routed prompt stick to that
+    replica while it has a free slot, so a shared system prompt keeps
+    hitting the replica whose prefix cache already holds it.
+    """
+
+    def __init__(self, affinity_tokens: int = 32, affinity_max: int = 512):
+        self.affinity_tokens = int(affinity_tokens)
+        self.affinity_max = int(affinity_max)
+        self._weights: dict[str, float] = {}
+        self._current: dict[str, float] = {}
+        self._free: dict[str, int] = {}
+        self._affinity: "collections.OrderedDict[tuple, str]" = (
+            collections.OrderedDict()
+        )
+        self.affinity_hits = 0
+        self.routed_total = 0
+
+    def update(self, replica_stats: dict[str, dict[str, Any]]) -> None:
+        """Refresh weights from live engine stats: ``{replica_id:
+        {"tokens_per_sec", "free_slots", "slots"}}``. Replicas absent from
+        the snapshot (preempted / torn down) are forgotten."""
+        alive = set(replica_stats)
+        for rid in list(self._weights):
+            if rid not in alive:
+                self._weights.pop(rid, None)
+                self._current.pop(rid, None)
+                self._free.pop(rid, None)
+        for rid, st in replica_stats.items():
+            slots = max(int(st.get("slots", 1)), 1)
+            free = max(int(st.get("free_slots", 0)), 0)
+            tps = max(float(st.get("tokens_per_sec", 0.0)), 0.0)
+            self._weights[rid] = (0.05 + tps) * (0.05 + free / slots)
+            self._current.setdefault(rid, 0.0)
+            self._free[rid] = free
+        for key in [k for k, rid in self._affinity.items() if rid not in alive]:
+            self._affinity.pop(key, None)
+
+    def route(self, prompt: Any = None) -> Optional[str]:
+        """Pick a replica id for this prompt; None when the fleet has no
+        routable replica (caller queues fleet-side)."""
+        if not self._weights:
+            return None
+        self.routed_total += 1
+        key = None
+        if prompt is not None and self.affinity_tokens > 0:
+            key = tuple(prompt[: self.affinity_tokens])
+            rid = self._affinity.get(key)
+            if rid is not None and self._free.get(rid, 0) > 0:
+                self._affinity.move_to_end(key)
+                self.affinity_hits += 1
+                self._free[rid] -= 1
+                return rid
+        # Smooth WRR: current += weight; pick the max; charge it the total.
+        total = sum(self._weights.values())
+        for rid, w in self._weights.items():
+            self._current[rid] = self._current.get(rid, 0.0) + w
+        pick = max(self._current, key=lambda r: self._current[r])
+        self._current[pick] -= total
+        self._free[pick] = max(self._free.get(pick, 0) - 1, 0)
+        if key is not None:
+            self._affinity[key] = pick
+            self._affinity.move_to_end(key)
+            while len(self._affinity) > self.affinity_max:
+                self._affinity.popitem(last=False)
+        return pick
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "weights": {r: round(w, 4) for r, w in self._weights.items()},
+            "affinity_entries": len(self._affinity),
+            "affinity_hits": self.affinity_hits,
+            "routed_total": self.routed_total,
+        }
+
+
+class AutoscalerConfig(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    min_replicas: int = Field(default=1, ge=0)
+    max_replicas: int = Field(default=4, ge=1)
+    # Scale up when the windowed mean queue depth per replica crosses this
+    # (or p99 breaches the SLO); scale down when it falls below the low
+    #-water mark AND p99 has headroom.
+    target_queue_per_replica: float = Field(default=4.0, gt=0)
+    low_water_queue_per_replica: float = Field(default=0.5, ge=0)
+    p99_slo_ms: float = Field(default=2000.0, gt=0)
+    window_s: float = Field(default=30.0, gt=0)
+    scale_up_cooldown_s: float = Field(default=5.0, ge=0)
+    # Hysteresis: scaling down waits this long after ANY scale event, so a
+    # dip between bursts does not shed the replicas the next burst needs
+    # (and a flapping signal cannot thrash submit/cancel cycles through
+    # the scheduler).
+    scale_down_cooldown_s: float = Field(default=60.0, ge=0)
+
+
+class ReplicaAutoscaler:
+    """Queue-depth + p99-SLO autoscaler, one step per ``observe`` call.
+
+    Deliberately clockless: every decision is a function of the ``now``
+    the caller passes, so the virtual-clock benchmark drives the SAME
+    object the live fleet ticks."""
+
+    def __init__(self, cfg: Optional[AutoscalerConfig] = None):
+        self.cfg = cfg or AutoscalerConfig()
+        self._samples: collections.deque[tuple[float, float]] = collections.deque()
+        self._last_up: Optional[float] = None
+        self._last_down: Optional[float] = None
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.last_reason = "init"
+
+    def observe(
+        self,
+        now: float,
+        queue_depth: float,
+        p99_ms: Optional[float],
+        n_replicas: int,
+    ) -> int:
+        """Record one observation, return the desired replica count."""
+        c = self.cfg
+        self._samples.append((now, float(queue_depth)))
+        while self._samples and now - self._samples[0][0] > c.window_s:
+            self._samples.popleft()
+        mean_q = sum(q for _, q in self._samples) / len(self._samples)
+        per_rep = mean_q / max(n_replicas, 1)
+
+        if n_replicas < c.min_replicas:
+            self.last_reason = f"below min_replicas ({c.min_replicas})"
+            return c.min_replicas
+
+        last_event = max(
+            (t for t in (self._last_up, self._last_down) if t is not None),
+            default=None,
+        )
+        slo_breach = p99_ms is not None and p99_ms > c.p99_slo_ms
+        if (
+            (per_rep > c.target_queue_per_replica or slo_breach)
+            and n_replicas < c.max_replicas
+            and (self._last_up is None or now - self._last_up >= c.scale_up_cooldown_s)
+        ):
+            self._last_up = now
+            self.scale_ups += 1
+            self.last_reason = (
+                f"scale up: p99 {p99_ms:.0f}ms > SLO {c.p99_slo_ms:.0f}ms"
+                if slo_breach
+                else f"scale up: queue/replica {per_rep:.2f} > "
+                     f"{c.target_queue_per_replica}"
+            )
+            return n_replicas + 1
+
+        window_full = (
+            self._samples and now - self._samples[0][0] >= 0.8 * c.window_s
+        )
+        if (
+            n_replicas > c.min_replicas
+            and window_full
+            and per_rep < c.low_water_queue_per_replica
+            and not slo_breach
+            and (last_event is None or now - last_event >= c.scale_down_cooldown_s)
+        ):
+            self._last_down = now
+            self.scale_downs += 1
+            self.last_reason = (
+                f"scale down: queue/replica {per_rep:.2f} < "
+                f"{c.low_water_queue_per_replica} for the window"
+            )
+            return n_replicas - 1
+
+        self.last_reason = "hold"
+        return n_replicas
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+            "last_reason": self.last_reason,
+            "window_samples": len(self._samples),
+        }
+
+
+class ServingFleet:
+    """N decode replicas as first-class scheduler submissions.
+
+    Each replica is one ``workload="serving"`` submission through the
+    SHARED :class:`FleetScheduler` — same priority queue, quota, drain/
+    cancel, per-device HBM ledger (via the spec's KV-pool estimator) and
+    preempt machinery as every training job. The fleet object routes
+    requests across whatever subset is currently RUNNING, so a replica
+    preempted by a CRITICAL training job just drops out of rotation until
+    the scheduler re-admits it.
+    """
+
+    def __init__(
+        self,
+        scheduler: FleetScheduler,
+        spec: ServingReplicaSpec,
+        autoscaler: Optional[ReplicaAutoscaler] = None,
+        router: Optional[FleetRouter] = None,
+        priority: JobPriority = JobPriority.NORMAL,
+        submitter: str = "serving-fleet",
+        engine_factory: Callable[[ServingReplicaSpec], Any] = build_replica_engine,
+        latency_window: int = 512,
+    ):
+        self.scheduler = scheduler
+        self.spec = spec
+        self.autoscaler = autoscaler or ReplicaAutoscaler()
+        self.router = router or FleetRouter()
+        self.priority = priority
+        self.submitter = submitter
+        self.engine_factory = engine_factory
+
+        self._lock = threading.RLock()
+        self._replicas: dict[str, Submission] = {}  # submission_id → sub
+        self.desired_replicas = 0
+        self._pending: collections.deque[tuple[str, dict[str, Any]]] = (
+            collections.deque()
+        )
+        self._requests: dict[str, dict[str, Any]] = {}
+        self._req_seq = 0
+        self._latencies: collections.deque[tuple[float, float]] = (
+            collections.deque(maxlen=latency_window)
+        )
+        self.requests_total = 0
+        self.completed_total = 0
+        self.tokens_total = 0
+        self.scale_ups_total = 0
+        self.scale_downs_total = 0
+
+    # -- replica lifecycle ---------------------------------------------------
+
+    def start(self) -> None:
+        self.scale_to(max(self.autoscaler.cfg.min_replicas, 1))
+
+    def stop(self) -> None:
+        with self._lock:
+            for sid in list(self._replicas):
+                self.scheduler.cancel(sid)
+            self.desired_replicas = 0
+
+    def _submit_replica(self) -> Submission:
+        spec = self.spec
+        sub = self.scheduler.submit(
+            spec.placement_config(),
+            priority=self.priority,
+            submitter=self.submitter,
+            workload="serving",
+            estimate_fn=spec.estimate,
+            job_factory=lambda s: ServingReplicaJob(
+                s, spec, engine_factory=self.engine_factory
+            ),
+        )
+        self._replicas[sub.submission_id] = sub
+        return sub
+
+    def scale_to(self, n: int) -> int:
+        """Submit or cancel replicas toward ``n`` (clamped to the
+        autoscaler's [min, max]); returns the resulting desired count."""
+        c = self.autoscaler.cfg
+        n = max(min(int(n), c.max_replicas), c.min_replicas)
+        with self._lock:
+            live = [
+                s for s in self._replicas.values() if s.state not in TERMINAL_STATES
+            ]
+            while len(live) < n:
+                live.append(self._submit_replica())
+            if len(live) > n:
+                # Shed queued replicas first (they serve nobody), then the
+                # emptiest running engines — never a busy one over an idle
+                # one.
+                def load(s: Submission) -> tuple[int, int]:
+                    job = s.job
+                    eng = getattr(job, "engine", None) if job is not None else None
+                    if s.state == SubmissionState.QUEUED or eng is None:
+                        return (0, 0)
+                    st = eng.stats()
+                    return (1, int(st.get("active_slots", 0)) + int(st.get("queued", 0)))
+
+                for victim in sorted(live, key=load)[: len(live) - n]:
+                    self.scheduler.cancel(victim.submission_id)
+            self.desired_replicas = n
+        return n
+
+    def running_replicas(self) -> dict[str, Any]:
+        """Submission id → live engine, for every replica that is admitted
+        AND has finished building its engine."""
+        out = {}
+        with self._lock:
+            for sid, sub in self._replicas.items():
+                job = sub.job
+                if (
+                    sub.state == SubmissionState.RUNNING
+                    and job is not None
+                    and getattr(job, "engine_ready", None) is not None
+                    and job.engine_ready.is_set()
+                    and job.engine is not None
+                ):
+                    out[sid] = job.engine
+        return out
+
+    # -- request plane -------------------------------------------------------
+
+    def submit_request(
+        self,
+        prompt: list[int],
+        max_new_tokens: int = 64,
+        temperature: float = 0.0,
+    ) -> str:
+        """Route a request to a replica (or hold it fleet-side until one is
+        admitted). Returns a fleet-scoped request id."""
+        with self._lock:
+            self._req_seq += 1
+            fid = f"req_{self._req_seq}"
+            self.requests_total += 1
+            self._requests[fid] = {
+                "submitted_at": time.time(),
+                "prompt": list(prompt),
+                "max_new_tokens": int(max_new_tokens),
+                "temperature": float(temperature),
+                "replica": None,
+                "engine_rid": None,
+                "done": False,
+            }
+            self._pending.append((fid, self._requests[fid]))
+            self._flush_pending()
+            return fid
+
+    def _flush_pending(self) -> None:
+        engines = self.running_replicas()
+        if not engines:
+            return
+        self.router.update({
+            sid: self._engine_router_stats(e) for sid, e in engines.items()
+        })
+        still: collections.deque = collections.deque()
+        while self._pending:
+            fid, req = self._pending.popleft()
+            sid = self.router.route(req["prompt"])
+            if sid is None or sid not in engines:
+                still.append((fid, req))
+                continue
+            try:
+                rid = engines[sid].submit(
+                    req["prompt"],
+                    max_new_tokens=req["max_new_tokens"],
+                    temperature=req["temperature"],
+                )
+            except Exception:  # engine died under us — requeue fleet-side
+                still.append((fid, req))
+                continue
+            req["replica"], req["engine_rid"] = sid, rid
+        self._pending.extend(still)
+
+    @staticmethod
+    def _engine_router_stats(engine: Any) -> dict[str, Any]:
+        st = engine.stats()
+        slots = int(st.get("slots", 1))
+        busy = int(st.get("active_slots", 0)) + int(st.get("prefilling", 0))
+        return {
+            "tokens_per_sec": float(st.get("tokens_per_sec_recent", 0.0)),
+            "free_slots": max(slots - busy, 0),
+            "slots": slots,
+        }
+
+    def result(self, fid: str) -> dict[str, Any]:
+        """Fleet-side view of one request; re-dispatches it when its
+        replica was preempted mid-flight (stateless replicas make retry the
+        correct recovery)."""
+        with self._lock:
+            req = self._requests.get(fid)
+            if req is None:
+                raise KeyError(fid)
+            if req["replica"] is None:
+                self._flush_pending()
+                if req["replica"] is None:
+                    return {"id": fid, "status": "pending", "replica": None}
+            engines = self.running_replicas()
+            eng = engines.get(req["replica"])
+            if eng is None:
+                # Replica torn down (preempt/cancel) before completion:
+                # requeue the request for the next flush.
+                if not req["done"]:
+                    req["replica"] = req["engine_rid"] = None
+                    self._pending.append((fid, req))
+                    return {"id": fid, "status": "pending", "replica": None}
+                return {"id": fid, "status": "done", "replica": req["replica"]}
+            try:
+                out = eng.result(req["engine_rid"])
+            except KeyError:
+                req["replica"] = req["engine_rid"] = None
+                self._pending.append((fid, req))
+                return {"id": fid, "status": "pending", "replica": None}
+            out = dict(out)
+            out["id"] = fid
+            out["replica"] = req["replica"]
+            if out.get("status") in ("done", "failed") and not req["done"]:
+                req["done"] = True
+                self.completed_total += 1
+                n_new = len(out.get("tokens", []) or [])
+                self.tokens_total += n_new
+                self._latencies.append(
+                    (time.time(), (time.time() - req["submitted_at"]) * 1000.0)
+                )
+            return out
+
+    # -- control loop --------------------------------------------------------
+
+    def p99_latency_ms(self) -> Optional[float]:
+        with self._lock:
+            if not self._latencies:
+                return None
+            vals = sorted(ms for _, ms in self._latencies)
+            return vals[min(int(0.99 * (len(vals) - 1)), len(vals) - 1)]
+
+    def queue_depth(self) -> int:
+        engines = self.running_replicas()
+        with self._lock:
+            depth = len(self._pending)
+        for eng in engines.values():
+            try:
+                depth += int(eng.stats().get("queued", 0))
+            except Exception:  # noqa: BLE001 — engine mid-teardown
+                continue
+        return depth
+
+    def tick(self, now: Optional[float] = None) -> dict[str, Any]:
+        """One control-loop pass: flush held requests, refresh router
+        weights, drive the autoscaler. The HTTP plane calls this on status
+        reads; a live deployment would pin it to a timer."""
+        now = time.time() if now is None else now
+        with self._lock:
+            self._flush_pending()
+            engines = self.running_replicas()
+            self.router.update({
+                sid: self._engine_router_stats(e) for sid, e in engines.items()
+            })
+            n_running = len(engines)
+            desired = self.autoscaler.observe(
+                now, self.queue_depth(), self.p99_latency_ms(), n_running
+            )
+            # Only act on autoscaler output once the fleet has converged to
+            # the previous desired count — scheduler admission latency must
+            # not read as "need another replica".
+            if desired > self.desired_replicas:
+                self.scale_ups_total += 1
+                self.scale_to(desired)
+            elif desired < self.desired_replicas and n_running >= self.desired_replicas:
+                self.scale_downs_total += 1
+                self.scale_to(desired)
+        return self.status()
+
+    def status(self) -> dict[str, Any]:
+        with self._lock:
+            # Refresh router weights so a status/metrics read reports the
+            # dispatch plane as it would route NOW (no autoscaler side
+            # effects — only tick() scales).
+            self.router.update({
+                sid: self._engine_router_stats(e)
+                for sid, e in self.running_replicas().items()
+            })
+            replicas = {}
+            for sid, sub in self._replicas.items():
+                job = sub.job
+                entry = {
+                    "state": sub.state.value,
+                    "job_id": sub.job_id,
+                    "attempts": sub.attempts,
+                    "preemptions": sub.preemptions,
+                    "engine_ready": bool(
+                        job is not None
+                        and getattr(job, "engine_ready", None) is not None
+                        and job.engine_ready.is_set()
+                    ),
+                }
+                if entry["engine_ready"]:
+                    try:
+                        entry["engine"] = job.engine.stats()
+                    except Exception:  # noqa: BLE001 — engine mid-teardown
+                        entry["engine_ready"] = False
+                replicas[sid] = entry
+            return {
+                "model": self.spec.model_name,
+                "desired_replicas": self.desired_replicas,
+                "running_replicas": sum(
+                    1 for r in replicas.values() if r["engine_ready"]
+                ),
+                "replicas": replicas,
+                "pending_requests": len(self._pending),
+                "requests_total": self.requests_total,
+                "completed_total": self.completed_total,
+                "tokens_total": self.tokens_total,
+                "p99_latency_ms": self.p99_latency_ms(),
+                "scale_ups_total": self.scale_ups_total,
+                "scale_downs_total": self.scale_downs_total,
+                "router": self.router.stats(),
+                "autoscaler": self.autoscaler.stats(),
+            }
