@@ -19,18 +19,39 @@ from backend import state
 from backend.http import ApiError, json_response, parse_body
 from backend.openapi import body
 from backend.routers.training import TrainingLaunchRequest, _to_config
-from tpu_engine.hbm_estimate import estimate_job_hbm
+from tpu_engine.hbm_estimate import estimate_job_hbm, gang_size
 from tpu_engine.scheduler import JobPriority, QuotaExceeded
 
 
 class SchedulerSubmitRequest(TrainingLaunchRequest):
     """A training launch plus queue semantics. ``dry_run`` here means
     "estimate only": validate, project the HBM footprint, and return the
-    admission picture without enqueueing."""
+    admission picture without enqueueing.
+
+    ``placement="auto"`` hands layout choice to the placement planner
+    (``tpu_engine/placement.py``): the submitted mesh supplies the gang
+    size (``data=-1`` = best available) and batch geometry; every
+    admission pass admits the predicted-fastest feasible layout. 422 with
+    ``no_estimate:<model>`` for models the HBM estimator cannot cost."""
 
     priority: Literal["low", "normal", "high", "critical"] = "normal"
     submitter: str = Field(default="anonymous", min_length=1, max_length=128)
     dry_run: bool = False  # submissions default to real (launch defaults dry)
+    placement: Literal["explicit", "auto"] = "explicit"
+
+
+class SchedulerPlanRequest(TrainingLaunchRequest):
+    """The ranked-plan table for a job WITHOUT enqueueing it: what layouts
+    are feasible on the live fleet (HBM headroom minus reservations) and
+    how the cost model orders them."""
+
+    gang: int | None = Field(
+        default=None, ge=1,
+        description="pin the search to this gang size "
+        "(default: the submitted mesh's gang on the eligible fleet)",
+    )
+    top_k: int = Field(default=10, ge=1, le=50)
+    include_pruned: bool = False
 
 
 @body(SchedulerSubmitRequest)
@@ -59,9 +80,12 @@ async def submit(request: web.Request) -> web.Response:
             priority=priority,
             submitter=req.submitter,
             job_kwargs=job_kwargs,
+            mesh=req.placement if req.placement == "auto" else None,
         )
     except QuotaExceeded as e:
         raise ApiError(429, str(e))
+    except ValueError as e:  # auto-placement refusal (no_estimate:<model>)
+        raise ApiError(422, str(e))
     state.scheduler.poll()
     return json_response(
         {
@@ -70,6 +94,50 @@ async def submit(request: web.Request) -> web.Response:
         },
         status=202,
     )
+
+
+@body(SchedulerPlanRequest)
+async def plan(request: web.Request) -> web.Response:
+    """Ranked placement-plan table (no enqueue): enumerate → prune →
+    HBM-filter → rank the job's layouts against the live fleet and the
+    scheduler's reservation ledger. 422 with ``no_estimate:<model>`` when
+    the cost model cannot bound the job."""
+    req = await parse_body(request, SchedulerPlanRequest)
+    config = _to_config(req)
+    sched = state.scheduler
+    planner = sched.planner
+    fleet = sched._fleet()
+    devices = (
+        [d for d in fleet.devices if d.is_available]
+        if fleet is not None and fleet.devices
+        else None
+    )
+    try:
+        gang = req.gang or gang_size(
+            config, len(devices) if devices else None
+        )
+        result = planner.plan(
+            config, devices=devices, reserved=sched._reserved, gang=gang
+        )
+    except ValueError as e:
+        raise ApiError(422, str(e))
+    if result.skip_reason:
+        raise ApiError(422, result.skip_reason)
+    payload = {
+        "gang": gang,
+        "evaluated": result.evaluated,
+        "feasible": len(result.plans),
+        "infeasible": [
+            {"layout": p.label, "reason": p.skip_reason}
+            for p in result.infeasible[: req.top_k]
+        ],
+        "pruned_count": len(result.pruned),
+        "ranked_plans": result.table(top_k=req.top_k),
+        "planner_stats": planner.stats(),
+    }
+    if req.include_pruned:
+        payload["pruned"] = result.pruned[:100]
+    return json_response(payload)
 
 
 async def queue(request: web.Request) -> web.Response:
@@ -119,6 +187,7 @@ async def resume(request: web.Request) -> web.Response:
 
 def setup(app: web.Application, prefix: str = "/api/v1/scheduler") -> None:
     app.router.add_post(f"{prefix}/submit", submit)
+    app.router.add_post(f"{prefix}/plan", plan)
     app.router.add_get(f"{prefix}/queue", queue)
     app.router.add_get(f"{prefix}/submissions/{{submission_id}}", get_submission)
     app.router.add_post(

@@ -183,6 +183,9 @@ def main() -> None:
     serving_line = _serving_fleet_metric()
     if serving_line is not None:
         print(json.dumps(serving_line))
+    placement_line = _placement_metric()
+    if placement_line is not None:
+        print(json.dumps(placement_line))
 
 
 def _comm_compress_metric(n_dev: int) -> dict | None:
@@ -433,6 +436,33 @@ def _serving_fleet_metric() -> dict | None:
             "router_weights": auto["router"]["weights"],
             "prefix_hit_rate": auto["prefix_hit_rate"],
             "static_p99_ms": trace["static_1_replica"]["p99_ms"],
+        }
+    except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
+        return None
+
+
+def _placement_metric() -> dict | None:
+    """Eighth JSON line: the placement planner's predicted-vs-measured
+    rank correlation over the fast (gpt-tiny) layout sweep — the same
+    global batch run through ≥6 mesh/schedule layouts on the 8-virtual-
+    device CPU mesh, ranked against ``PlacementPlanner.predict``. The
+    fuller compute-dominated table (gpt-mid) lives in
+    ``benchmarks/placement_plan.py --sweep`` / RESULTS.md §PR 7. Never
+    fails the bench: any error degrades to None."""
+    try:
+        from benchmarks.placement_plan import run_sweep
+
+        sweep = run_sweep(size="tiny", iters=5)
+        return {
+            "metric": "placement_rank_correlation",
+            "value": sweep["value"],
+            "unit": sweep["unit"],
+            "model": sweep["model"],
+            "layouts": sweep["layouts"],
+            "top_pick": sweep["top_pick"],
+            "top_pick_within_5pct": sweep["top_pick_within_5pct"],
+            "top_pick_measured_ms": sweep["top_pick_measured_ms"],
+            "fastest_measured_ms": sweep["fastest_measured_ms"],
         }
     except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
         return None

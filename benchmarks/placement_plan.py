@@ -1,0 +1,280 @@
+"""Placement-planner cost model A/B: predicted ranking vs reality.
+
+Two measurement planes (numbers in RESULTS.md §PR 7):
+
+- ``--sweep``: measured CPU-mesh steps across a fixed 7-layout sweep
+  (same global batch — 16 samples × seq 512 per step — so every layout
+  does the same useful work) on the 8-virtual-device mesh, vs the
+  planner's predicted step time for the SAME configs
+  (``PlacementPlanner.predict``). Reports Spearman rank correlation and
+  whether the planner's top pick is the measured-fastest layout (or
+  within 5% of it). Default model is the 8-layer/256-dim ``gpt-mid``
+  shape (same as ``pipeline_schedule.py --wall``): gpt-tiny measures
+  only per-tick overhead on CPU, which buries the bubble/comm terms the
+  model ranks by. ``--size tiny`` is the fast variant ``bench.py`` uses.
+  The prediction's absolute seconds assume a TPU roofline and are
+  meaningless on CPU; the claim under test is the ORDER. Known honest
+  negative: the CPU SPMD partitioner hits "involuntary full
+  rematerialization" on stage-3 gather layouts, inflating them ~7x in a
+  way no TPU exhibits — both stage-3 rows land slowest on CPU while the
+  model (correctly, for ICI) prices them mid-pack. The correlation is
+  reported over the full sweep anyway.
+- ``--aot``: the planner ranks llama-7b layouts against a described
+  v5e:4x4 fleet (16 chips × 16 GiB, the HBM gate live), then the top-3
+  feasible plans are AOT-lowered via ``benchmarks/aot.py`` — proof the
+  search never emits a layout the real builder rejects at scale, with
+  ``memory_analysis()`` alongside each plan's ``estimate_job_hbm``
+  projection.
+
+Run: ``python benchmarks/placement_plan.py --sweep|--aot``
+``bench.py`` imports :func:`run_sweep` for its placement JSON line.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+import json
+import time
+
+# Each entry: (name, mesh axes, sharding stage, micro, accum, schedule)
+# with the (micro, accum) split keeping the global batch at 16 samples:
+# data·fsdp·micro·accum = 16. seq 512 keeps the roofline compute term
+# comparable to the collective terms (at toy seq everything is
+# comm-bound and the predicted margins collapse into ties); the
+# (micro, accum) splits are varied so no two layouts are priced
+# identically under the overlap model.
+SWEEP_LAYOUTS = (
+    ("fsdp8_s2", dict(fsdp=8), 2, 2, 1, None),
+    ("fsdp8_s3", dict(fsdp=8), 3, 1, 2, None),
+    ("data8", dict(data=8), 3, 2, 1, None),
+    ("data4_fsdp2", dict(data=4, fsdp=2), 3, 1, 2, None),
+    ("data2_model4", dict(data=2, model=4), 3, 2, 4, None),
+    ("data2_pipe4_gpipe", dict(data=2, pipe=4), 3, 1, 8, "gpipe"),
+    ("data2_pipe4_zb", dict(data=2, pipe=4), 3, 1, 8, "zb"),
+)
+SEQ = 512
+GANG = 8
+
+
+def _sweep_model(size: str):
+    from tpu_engine.models import transformer as tfm
+
+    if size == "tiny":
+        # 4 layers so the pipe=4 sweep rows can stage it (gpt-tiny's 2
+        # cannot); still small enough for bench.py's budget.
+        return tfm.MODEL_CONFIGS["gpt-tiny"].with_(
+            name="gpt-tiny-bench", n_layers=4
+        )
+    # The pipeline_schedule.py --wall shape: 2 layers/stage at pipe=4,
+    # big enough that stage matmuls dominate per-tick schedule overhead.
+    return tfm.MODEL_CONFIGS["gpt-tiny"].with_(
+        name="gpt-mid-bench", d_model=256, n_heads=8, n_kv_heads=8,
+        d_ff=1024, n_layers=8, vocab_size=2048,
+    )
+
+
+def _sweep_config(mesh_axes, stage, micro, accum, schedule):
+    from tpu_engine.mesh_runtime import MeshConfig
+    from tpu_engine.sharding import ShardingStage, TPUTrainConfig
+
+    return TPUTrainConfig(
+        model_name="gpt-tiny",  # shape comes from the model_cfg override
+        sharding_stage=ShardingStage(stage),
+        mesh=MeshConfig(**mesh_axes),
+        micro_batch_size=micro,
+        gradient_accumulation_steps=accum,
+        seq_len=SEQ,
+        attention_impl="xla",
+        pipeline_schedule=schedule or "auto",
+    )
+
+
+def _spearman(xs: list[float], ys: list[float]) -> float:
+    """Spearman rank correlation (no ties expected in wall-clock data)."""
+    n = len(xs)
+
+    def ranks(vals):
+        order = sorted(range(n), key=lambda i: vals[i])
+        r = [0] * n
+        for rank, i in enumerate(order):
+            r[i] = rank
+        return r
+
+    rx, ry = ranks(xs), ranks(ys)
+    d2 = sum((a - b) ** 2 for a, b in zip(rx, ry))
+    return 1.0 - 6.0 * d2 / (n * (n * n - 1))
+
+
+def run_sweep(size: str = "mid", iters: int = 3, warmup: int = 2) -> dict:
+    """Measured-vs-predicted layout sweep; returns the summary dict."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    if jax.default_backend() != "tpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from tpu_engine.mesh_runtime import MeshRuntime
+    from tpu_engine.placement import PlacementPlanner
+    from tpu_engine.train import build_train_program
+
+    model_cfg = _sweep_model(size)
+    planner = PlacementPlanner()
+    rows = []
+    for name, mesh_axes, stage, micro, accum, schedule in SWEEP_LAYOUTS:
+        cfg = _sweep_config(mesh_axes, stage, micro, accum, schedule)
+        predicted = planner.predict(
+            cfg, gang=GANG, model_cfg=model_cfg
+        ).predicted_step_time_s
+        prog = build_train_program(
+            cfg, model_cfg=model_cfg,
+            runtime=MeshRuntime(cfg.mesh, devices=jax.devices()[:GANG]),
+        )
+        state = prog.init(jax.random.PRNGKey(0))
+        batch = prog.synthetic_batch(seed=0)
+        for _ in range(warmup):
+            state, m = prog.step(state, batch)
+        float(m["loss"])
+        # min-of-iters: wall noise on the CPU backend is one-sided (GC,
+        # scheduler jitter), so the minimum is the honest per-step cost.
+        best = float("inf")
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            state, m = prog.step(state, batch)
+            float(m["loss"])
+            best = min(best, time.perf_counter() - t0)
+        rows.append({
+            "layout": name,
+            "predicted_s": predicted,
+            "measured_ms": round(best * 1e3, 2),
+        })
+        print(json.dumps(rows[-1]))
+
+    predicted = [r["predicted_s"] for r in rows]
+    measured = [r["measured_ms"] for r in rows]
+    rho = _spearman(predicted, measured)
+    top = min(rows, key=lambda r: r["predicted_s"])
+    fastest = min(measured)
+    summary = {
+        "metric": "placement_rank_correlation",
+        "value": round(rho, 3),
+        "unit": "Spearman rho (predicted vs measured step time)",
+        "model": model_cfg.name,
+        "layouts": len(rows),
+        "top_pick": top["layout"],
+        "top_pick_measured_ms": top["measured_ms"],
+        "fastest_measured_ms": round(fastest, 2),
+        "top_pick_within_5pct": top["measured_ms"] <= fastest * 1.05,
+        "rows": rows,
+    }
+    print(json.dumps(summary))
+    return summary
+
+
+def run_aot(top_k: int = 3) -> None:
+    """Plan llama-7b on a described v5e:4x4 fleet, AOT-lower the top-k."""
+    from types import SimpleNamespace
+
+    from benchmarks.aot import TopologyUnavailable, aot_lowered
+
+    from tpu_engine.placement import PlacementPlanner
+    from tpu_engine.sharding import ShardingStage, TPUTrainConfig
+
+    # 16 chips of v5e with the full 16 GiB free: the HBM gate is live, so
+    # full-replica layouts that cannot fit a 7b are filtered out BEFORE
+    # lowering rather than discovered as compile OOMs.
+    fleet = [
+        SimpleNamespace(index=i, hbm_free_gb=16.0, hbm_total_gb=16.0)
+        for i in range(16)
+    ]
+    cfg = TPUTrainConfig(
+        model_name="llama-7b",
+        sharding_stage=ShardingStage.FULL_PARTITIONING,
+        micro_batch_size=1,
+        gradient_accumulation_steps=8,
+        # seq 512: XLA attention (below) materializes S×S score
+        # temporaries that grow with pipe depth (measured +4G at pipe=2
+        # up to +9G at pipe=16 over the estimate at seq 1024); at 512
+        # they shrink 4x, so every plan the widened gate admits stays
+        # under the 15.75 GiB ceiling even at the worst overshoot.
+        seq_len=512,
+        activation_checkpointing=True,
+        # This container's jax/libtpu Mosaic rejects the flash kernel
+        # under stage-3 gathers ("Unsupported implicit dim change") — a
+        # toolchain bug, not a layout property. XLA attention lowers the
+        # identical mesh/collective structure, which is what this plane
+        # validates.
+        attention_impl="xla",
+    )
+    # 75% margin here (product default is 35%): the xla-attention
+    # fallback above materializes S×S score tensors that the flash-path
+    # estimator never charges, and the measured compile footprints run
+    # 1.3-2.0x the projection (e.g. fsdp2xpipe8·s2 est 10.19 GiB ->
+    # 17.43 GiB real). The wider gate keeps this plane's top picks out
+    # of that band; on the flash path the 35% default is the right gate.
+    planner = PlacementPlanner(hbm_margin_frac=0.75)
+    result = planner.plan(cfg, devices=fleet, gang=16)
+    print(json.dumps({
+        "model": "llama-7b", "gang": 16,
+        "evaluated": result.evaluated,
+        "feasible": len(result.plans),
+        "hbm_rejected": len(result.infeasible),
+    }))
+    for rank, p in enumerate(result.plans[:top_k], 1):
+        mesh_axes = {k: v for k, v in p.mesh.items() if v > 1}
+        t0 = time.time()
+        try:
+            comp = aot_lowered(
+                "llama-7b", "v5e:4x4", mesh_axes or {"data": 1},
+                micro=p.micro_batch_size,
+                accum=p.gradient_accumulation_steps, seq=512,
+                overrides={
+                    "sharding_stage": p.sharding_stage,
+                    "pipeline_schedule": p.pipeline_schedule,
+                    "activation_checkpointing": True,
+                    "attention_impl": "xla",
+                },
+            ).compile()
+            ma = comp.memory_analysis()
+            print(json.dumps({
+                "rank": rank, "layout": p.label,
+                "predicted_step_s": round(p.predicted_step_time_s, 4),
+                "planner_hbm_gib": round(
+                    p.hbm_estimate.device_total_gib, 2
+                ) if p.hbm_estimate else None,
+                "aot_args_gib": round(ma.argument_size_in_bytes / 2**30, 2),
+                "aot_temp_gib": round(ma.temp_size_in_bytes / 2**30, 2),
+                "compile_s": round(time.time() - t0, 1),
+            }))
+        except TopologyUnavailable as e:
+            print(json.dumps({
+                "rank": rank, "layout": p.label,
+                "skipped": f"topology unavailable: {str(e)[:120]}",
+            }))
+        except Exception as e:  # a lowering failure IS a planner bug
+            print(json.dumps({
+                "rank": rank, "layout": p.label, "error": str(e)[:200],
+            }))
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sweep", action="store_true")
+    ap.add_argument("--aot", action="store_true")
+    ap.add_argument("--size", choices=("mid", "tiny"), default="mid")
+    ap.add_argument("--iters", type=int, default=3)
+    args = ap.parse_args()
+    if not (args.sweep or args.aot):
+        ap.error("pass --sweep and/or --aot")
+    if args.sweep:
+        run_sweep(size=args.size, iters=args.iters)
+    if args.aot:
+        run_aot()

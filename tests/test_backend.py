@@ -1105,3 +1105,64 @@ def test_recovery_endpoint_and_fault_metrics(client):
         assert "tpu_engine_recovery_running_shrunk_jobs" in text
     finally:
         faults_mod.clear_active()
+
+
+def test_scheduler_plan_endpoint(client):
+    """POST /api/v1/scheduler/plan: the ranked layout table without
+    enqueueing — enumerate → prune → HBM-filter → rank over the live
+    fleet, plus the planner's counter plane on /metrics."""
+    r = client.post("/api/v1/scheduler/plan", json={
+        "model_name": "gpt-tiny", "mesh": {"data": 2, "fsdp": 4},
+        "micro_batch_size": 2, "gradient_accumulation_steps": 2,
+        "seq_len": 64, "top_k": 5,
+    })
+    assert r.status_code == 200, r.text
+    body = r.json()
+    assert body["gang"] == 8 and body["feasible"] > 0
+    rows = body["ranked_plans"]
+    assert rows and rows[0]["rank"] == 1
+    # Ranked ascending by predicted step time; every row is a full layout.
+    times = [row["predicted_step_time_s"] for row in rows]
+    assert times == sorted(times)
+    assert {"mesh", "sharding_stage", "pipeline_schedule"} <= rows[0].keys()
+    assert body["pruned_count"] > 0 and "planner_stats" in body
+    # Unknown model → structured 422, same reason the scheduler uses.
+    r = client.post("/api/v1/scheduler/plan", json={"model_name": "nope-9b"})
+    assert r.status_code == 422
+    assert "no_estimate:nope-9b" in r.json()["detail"]
+    # The planner counter plane is scrapeable.
+    text = client.get("/metrics").text
+    assert "tpu_engine_placement_plans_evaluated_total" in text
+    assert "tpu_engine_placement_no_estimate_refusals_total" in text
+
+
+def test_scheduler_submit_auto_placement(client):
+    """placement="auto" hands the mesh to the planner; unknown models are
+    refused with the structured no_estimate reason."""
+    r = client.post("/api/v1/scheduler/submit", json={
+        "model_name": "nope-9b", "placement": "auto",
+    })
+    assert r.status_code == 422
+    assert "no_estimate:nope-9b" in r.json()["detail"]
+    r = client.post("/api/v1/scheduler/submit", json={
+        "model_name": "gpt-tiny", "mesh": {"data": -1, "fsdp": 2},
+        "micro_batch_size": 1, "seq_len": 32, "precision": "fp32",
+        "total_steps": 2, "max_steps": 2, "warmup_steps": 1,
+        "placement": "auto",
+    })
+    assert r.status_code == 202, r.text
+    body = r.json()
+    assert body["auto_place"] is True
+    sub_id = body["submission_id"]
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        body = client.get(f"/api/v1/scheduler/submissions/{sub_id}").json()
+        if body["state"] in ("completed", "failed"):
+            break
+        time.sleep(1)
+    assert body["state"] == "completed", body
+    plan = body["placement_plan"]
+    assert plan and plan["label"] and plan["feasible"] > 0
+    assert body["predicted_step_time_s"] > 0
+    text = client.get("/metrics").text
+    assert "tpu_engine_placement_auto_admissions_total 1" in text

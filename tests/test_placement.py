@@ -1,0 +1,310 @@
+"""Placement planner: layout enumeration against the config interaction
+matrix, the HBM feasibility gate, cost-model ranking, and grow-back
+targets — all analytic (no JAX compute), so everything here is tier-1.
+"""
+
+from types import SimpleNamespace
+
+import pytest
+
+from tpu_engine.hbm_estimate import HBMEstimate, estimate_job_hbm
+from tpu_engine.mesh_runtime import MeshConfig
+from tpu_engine.placement import (
+    PlacementPlanner,
+    _mirror_build_checks,
+)
+from tpu_engine.models import transformer as tfm
+from tpu_engine.sharding import (
+    ShardingStage,
+    TPUTrainConfig,
+    resolve_pipeline_schedule,
+)
+
+
+def cfg(**kw):
+    base = dict(
+        model_name="gpt-tiny",
+        mesh=MeshConfig(data=2, fsdp=4),
+        micro_batch_size=2,
+        gradient_accumulation_steps=2,
+        seq_len=64,
+    )
+    base.update(kw)
+    return TPUTrainConfig(**base)
+
+
+def chips(n, free=12.0, total=16.0, **kw):
+    return [
+        SimpleNamespace(index=i, hbm_free_gb=free, hbm_total_gb=total, **kw)
+        for i in range(n)
+    ]
+
+
+def fixed_estimate(total_gib):
+    def est(c, n=None):
+        return HBMEstimate(
+            model_name=c.model_name, gang_devices=8,
+            params_gib=total_gib, grads_gib=0.0, opt_gib=0.0,
+            working_gib=0.0, activations_gib=0.0, logits_gib=0.0,
+            device_total_gib=total_gib, host_gib=0.0,
+        )
+
+    return est
+
+
+# ---------------------------------------------------------------------------
+# enumerate: the interaction matrix, mirrored
+# ---------------------------------------------------------------------------
+
+
+def test_every_emitted_plan_revalidates():
+    """Property: any layout the planner emits survives a FRESH config
+    construction (the full validator interaction matrix) plus the
+    mirrored build-time checks — the planner can never hand the
+    scheduler a config ``build_train_program`` would reject."""
+    planner = PlacementPlanner()
+    plans, _ = planner.enumerate(
+        cfg(), 8, consider_quant=True, consider_comm_compress=True
+    )
+    assert len(plans) >= 40  # the full cross product is a real search
+    model_cfg = tfm.MODEL_CONFIGS["gpt-tiny"]
+    for p in plans:
+        rebuilt = TPUTrainConfig(**p.config.model_dump())  # must not raise
+        _mirror_build_checks(rebuilt, model_cfg)  # must not raise
+        assert resolve_pipeline_schedule(rebuilt) == p.pipeline_schedule
+
+
+def test_known_invalid_combos_are_pruned_not_emitted():
+    planner = PlacementPlanner()
+    plans, pruned = planner.enumerate(
+        cfg(), 8, consider_quant=True, consider_comm_compress=True
+    )
+    for p in plans:
+        # 1f1b/zb × quant_training is a validator reject; comm compression
+        # is stage-3 (data, fsdp)-only — neither may survive into plans.
+        if p.pipeline_schedule in ("1f1b", "zb"):
+            assert p.quant_training == "none"
+        if p.comm_compress:
+            assert p.mesh["pipe"] == 1 and p.mesh["model"] == 1
+    reasons = " ".join(r["reason"] for r in pruned).lower()
+    assert "quant" in reasons
+    assert len(pruned) > len(plans)  # the cross product mostly dies
+
+
+def test_pipe_must_divide_layers():
+    """gpt-tiny has 2 layers: pipe ∈ {4, 8} cannot stage it and must be
+    pruned (build_train_program's n_layers % pipe check, mirrored)."""
+    planner = PlacementPlanner()
+    plans, pruned = planner.enumerate(cfg(), 8)
+    assert plans and all(p.mesh["pipe"] in (1, 2) for p in plans)
+    assert any(
+        "layers" in r["reason"] or "pipe" in r["reason"] for r in pruned
+    )
+
+
+def test_enumeration_keeps_global_batch_constant():
+    base = cfg()  # 2 micro × 2 accum × (2 data × 4 fsdp) = 32 samples
+    planner = PlacementPlanner()
+    plans, _ = planner.enumerate(base, 8)
+    for p in plans:
+        samples = (
+            p.mesh["data"] * p.mesh["fsdp"]
+            * p.micro_batch_size * p.gradient_accumulation_steps
+        )
+        assert samples == 32, p.label
+
+
+def test_enumerate_unknown_model_raises_structured():
+    with pytest.raises(ValueError, match="no_estimate:nope-9b"):
+        PlacementPlanner().enumerate(cfg(model_name="nope-9b"), 8)
+
+
+# ---------------------------------------------------------------------------
+# predict / ranking
+# ---------------------------------------------------------------------------
+
+
+def test_predict_costs_an_explicit_layout():
+    planner = PlacementPlanner()
+    plan = planner.predict(cfg(), gang=8)
+    assert plan.predicted_step_time_s > 0
+    # step = max(compute, streamed collectives) + exposed collectives —
+    # the fsdp/data plane overlaps with compute, the rest cannot.
+    streamed = plan.predicted_comm_s - plan.predicted_exposed_comm_s
+    assert plan.predicted_step_time_s == pytest.approx(
+        max(plan.predicted_compute_s, streamed)
+        + plan.predicted_exposed_comm_s
+    )
+    with pytest.raises(ValueError, match="no_estimate"):
+        planner.predict(cfg(model_name="nope-9b"), gang=8)
+
+
+def test_ranking_prefers_less_comm_and_less_bubble():
+    """Cost-model sanity pinned to the in-tree analytics: stage-2 beats
+    stage-3 at equal mesh (no per-microbatch weight gathers), and a
+    pipelined layout is charged its schedule_account bubble."""
+    planner = PlacementPlanner()
+    s2 = planner.predict(
+        cfg(mesh=MeshConfig(data=1, fsdp=8),
+            sharding_stage=ShardingStage.GRADIENT_PARTITIONING), gang=8)
+    s3 = planner.predict(
+        cfg(mesh=MeshConfig(data=1, fsdp=8),
+            sharding_stage=ShardingStage.FULL_PARTITIONING), gang=8)
+    assert s2.predicted_comm_s < s3.predicted_comm_s
+    # Same global batch (16 samples), with and without a pipeline bubble:
+    # the piped layout's compute is divided by its busy fraction.
+    flat = planner.predict(
+        cfg(mesh=MeshConfig(data=8), micro_batch_size=1,
+            gradient_accumulation_steps=2), gang=8)
+    piped = planner.predict(
+        cfg(mesh=MeshConfig(data=4, pipe=2), micro_batch_size=1,
+            gradient_accumulation_steps=4, pipeline_schedule="gpipe"),
+        gang=8)
+    assert piped.predicted_bubble_fraction > 0
+    assert flat.predicted_bubble_fraction == 0
+    assert piped.predicted_compute_s > flat.predicted_compute_s
+
+
+def test_plan_ranks_feasible_by_predicted_time():
+    planner = PlacementPlanner()
+    result = planner.plan(cfg(), devices=chips(8), gang=8)
+    assert result.plans and result.best is result.plans[0]
+    times = [p.predicted_step_time_s for p in result.plans]
+    assert times == sorted(times)
+    rows = result.table(top_k=3)
+    assert len(rows) == 3 and rows[0]["rank"] == 1
+    assert planner.stats()["plans_evaluated_total"] == result.evaluated
+
+
+# ---------------------------------------------------------------------------
+# HBM feasibility gate
+# ---------------------------------------------------------------------------
+
+
+def test_hbm_filter_rejects_on_headroom_and_reservations():
+    planner = PlacementPlanner(estimate_fn=fixed_estimate(10.0))
+    # 10 GiB estimate + the 35% compile-temporary margin = 13.5 needed.
+    fits = planner.plan(cfg(), devices=chips(8, free=14.0), gang=8)
+    assert fits.plans and not fits.infeasible
+    # Live headroom below the projection: every layout lands infeasible
+    # with a structured reason, none silently dropped.
+    starved = planner.plan(cfg(), devices=chips(8, free=4.0), gang=8)
+    assert not starved.plans and starved.infeasible
+    assert all("headroom" in p.skip_reason for p in starved.infeasible)
+    # A reservation ledger eats the headroom the free gauge still shows.
+    reserved = planner.plan(
+        cfg(), devices=chips(8, free=14.0),
+        reserved={i: 5.0 for i in range(8)}, gang=8,
+    )
+    assert not reserved.plans
+
+
+def test_hbm_filter_degrades_without_telemetry():
+    planner = PlacementPlanner()
+    # No fleet view at all → capacity-only (feasible).
+    assert planner.plan(cfg(), gang=8).plans
+    # Fleet present but no HBM telemetry (CPU chips report 0 total).
+    cpu = planner.plan(cfg(), devices=chips(8, free=0.0, total=0.0), gang=8)
+    assert cpu.plans
+    # Fewer chips than the gang is still a hard reject.
+    small = planner.plan(cfg(), devices=chips(4), gang=8)
+    assert not small.plans
+    assert all("eligible" in p.skip_reason for p in small.infeasible)
+
+
+def test_plan_unknown_model_refuses_with_structured_reason():
+    planner = PlacementPlanner()
+    result = planner.plan(cfg(model_name="nope-9b"), gang=8)
+    assert result.skip_reason == "no_estimate:nope-9b"
+    assert not result.plans and result.evaluated == 0
+    assert planner.stats()["no_estimate_refusals_total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# best-available gang search
+# ---------------------------------------------------------------------------
+
+
+def test_plan_best_available_prefers_largest_feasible_gang():
+    planner = PlacementPlanner()
+    elastic = cfg(mesh=MeshConfig(data=-1, fsdp=2), elastic_min_devices=2)
+    result = planner.plan(elastic, devices=chips(8), n_avail=8)
+    assert result.best.gang == 8
+    # On a 6-chip remainder the same submission lands on 6.
+    degraded = planner.plan(elastic, devices=chips(6), n_avail=6)
+    assert degraded.best.gang == 6
+
+
+# ---------------------------------------------------------------------------
+# grow-back targets
+# ---------------------------------------------------------------------------
+
+
+def _elastic():
+    return cfg(
+        mesh=MeshConfig(data=4, fsdp=2), elastic_min_devices=2,
+        micro_batch_size=1, gradient_accumulation_steps=1,
+    )
+
+
+def test_grow_target_full_gang_when_it_fits():
+    planner = PlacementPlanner()
+    assert planner.grow_target(
+        _elastic(), chips(8), {}, current_gang=6,
+        estimate_fn=estimate_job_hbm,
+    ) == 8
+
+
+def test_grow_target_intermediate_mesh_when_full_does_not_fit():
+    """7 healthy chips: the full data=4×fsdp=2 gang cannot be placed, but
+    the elastic family's data=3×fsdp=2 on 6 can — the partial grow the
+    old full-gang-only logic never found."""
+    planner = PlacementPlanner()
+    assert planner.grow_target(
+        _elastic(), chips(7), {}, current_gang=4,
+        estimate_fn=estimate_job_hbm,
+    ) == 6
+
+
+def test_grow_target_none_when_no_larger_mesh_fits():
+    planner = PlacementPlanner()
+    assert planner.grow_target(
+        _elastic(), chips(7), {}, current_gang=6,
+        estimate_fn=estimate_job_hbm,
+    ) is None
+
+
+def test_grow_target_is_hbm_gated():
+    """Chips exist but their headroom (minus other jobs' reservations)
+    cannot hold the projection — growing would only preempt into a
+    re-shrink flap, so the target must be None."""
+    planner = PlacementPlanner()
+
+    big_est = fixed_estimate(10.0)
+    assert planner.grow_target(
+        _elastic(), chips(8, free=14.0), {}, current_gang=6,
+        estimate_fn=big_est,
+    ) == 8
+    assert planner.grow_target(
+        _elastic(), chips(8, free=14.0), {i: 5.0 for i in range(8)},
+        current_gang=6, estimate_fn=big_est,
+    ) is None
+
+
+# ---------------------------------------------------------------------------
+# counters
+# ---------------------------------------------------------------------------
+
+
+def test_stats_and_observation_plane():
+    planner = PlacementPlanner()
+    result = planner.plan(cfg(), devices=chips(8), gang=8)
+    planner.note_chosen(result.best)
+    planner.record_observation(predicted_s=2.0, observed_s=1.0)
+    st = planner.stats()
+    assert st["plans_chosen_total"] == 1
+    assert st["last_feasible"] == len(result.plans)
+    assert st["last_chosen_predicted_s"] == result.best.predicted_step_time_s
+    assert st["observations_total"] == 1
+    assert st["step_time_abs_rel_error"] == pytest.approx(1.0)
+    assert st["prune_reasons"]  # top prune reasons surface for operators

@@ -679,3 +679,150 @@ def test_per_submitter_wait_and_goodput_stats(sched_factory):
     assert per["bob"]["goodput_busy_s"] > 0
     # Bob queued behind alice's run; alice was admitted immediately.
     assert per["bob"]["mean_wait_s"] >= per["alice"]["mean_wait_s"]
+
+
+# ---------------------------------------------------------------------------
+# placement planner wiring: mesh="auto", structured no_estimate, partial grow
+# ---------------------------------------------------------------------------
+
+
+def test_auto_placement_admits_predicted_fastest(sched_factory):
+    s = sched_factory(max_concurrent_jobs=1, fleet_fn=_healthy_fleet)
+    sub = s.submit(cfg(mesh=MeshConfig(data=-1, fsdp=2)), mesh="auto")
+    assert sub.auto_place
+    assert wait_until(lambda: sub.state == SubmissionState.RUNNING)
+    # data=-1 means best available: the planner lands on the full fleet.
+    assert sub.admitted_gang == 8
+    plan = sub.placement_plan
+    assert plan and plan["feasible"] > 0 and plan["label"]
+    assert plan["chosen"]["mesh"]["data"] * plan["chosen"]["mesh"]["fsdp"] * \
+        plan["chosen"]["mesh"]["pipe"] * plan["chosen"]["mesh"]["model"] == 8
+    assert sub.predicted_step_time_s > 0
+    st = s.stats()
+    assert st["auto_admissions_total"] == 1
+    assert st["placement"]["plans_chosen_total"] == 1
+    # The queue surface carries the chosen plan for operators.
+    running = s.queue_state()["running"]
+    assert running[0]["placement_plan"]["label"] == plan["label"]
+
+
+def test_auto_placement_resizes_on_degraded_fleet(sched_factory):
+    s = sched_factory(max_concurrent_jobs=1, fleet_fn=_degraded_fleet)
+    sub = s.submit(cfg(mesh=MeshConfig(data=-1, fsdp=1)), mesh="auto")
+    assert wait_until(lambda: sub.state == SubmissionState.RUNNING)
+    # 7 healthy chips: the plan is sized to the healthy remainder and the
+    # CRITICAL chip is never in the placement.
+    assert sub.admitted_gang == 7
+    assert 0 not in sub.placement and len(sub.placement) == 7
+
+
+def test_auto_placement_refuses_unknown_model(sched_factory):
+    s = sched_factory(max_concurrent_jobs=1)
+    with pytest.raises(ValueError, match="no_estimate:nope-9b"):
+        s.submit(cfg(model_name="nope-9b"), mesh="auto")
+    assert s.stats()["placement"]["no_estimate_refusals_total"] == 1
+    # The refusal never entered the queue.
+    assert s.stats()["submitted_total"] == 0
+
+
+def test_auto_placement_rejects_bad_mesh_arg(sched_factory):
+    s = sched_factory(max_concurrent_jobs=1)
+    with pytest.raises(ValueError, match="mesh must be"):
+        s.submit(cfg(), mesh="magic")
+
+
+def test_unknown_model_explicit_mesh_gets_structured_reason(sched_factory):
+    """estimate_job_hbm → None for an unknown model: admission still
+    proceeds capacity-only (missing telemetry must not brick the queue)
+    but the queue surface names WHY there is no HBM estimate."""
+    s = sched_factory(max_concurrent_jobs=1, fleet_fn=_healthy_fleet)
+    sub = s.submit(cfg(model_name="nope-9b", mesh=MeshConfig(data=1, fsdp=2)))
+    assert wait_until(lambda: sub.state == SubmissionState.RUNNING)
+    assert sub.last_skip_reason == "no_estimate:nope-9b"
+    running = s.queue_state()["running"]
+    assert running[0]["last_skip_reason"] == "no_estimate:nope-9b"
+    assert s.stats()["no_estimate_skips_total"] == 1
+
+
+def _three_down_fleet():
+    """8 chips, chips 0-2 thermally CRITICAL → 5 healthy."""
+    mgr = TPUManager()
+    return mgr.get_fleet_status(
+        metrics=[_chip(i, temperature_c=91.0) for i in range(3)]
+        + [_chip(i) for i in range(3, 8)]
+    )
+
+
+def test_partial_grow_back_with_chip_still_unhealthy(sched_factory):
+    """Regression (ROADMAP carry-over): when SOME of the sick chips heal,
+    the shrunk job grows to the largest feasible INTERMEDIATE mesh — the
+    full-gang-only logic waited for a perfectly healthy fleet."""
+    fleet_holder = {"fleet": _three_down_fleet()}
+    s = sched_factory(
+        max_concurrent_jobs=1, fleet_fn=lambda: fleet_holder["fleet"],
+    )
+    sub = s.submit(elastic_cfg())
+    assert wait_until(lambda: sub.state == SubmissionState.RUNNING)
+    assert sub.admitted_gang == 4  # data=2 × fsdp=2 on the 5 healthy
+    # Chips 1-2 heal; chip 0 stays CRITICAL → 7 healthy. Full gang (8)
+    # still cannot be placed, but data=3 × fsdp=2 on 6 can.
+    fleet_holder["fleet"] = _degraded_fleet()
+    assert wait_until(
+        lambda: sub.state == SubmissionState.RUNNING
+        and sub.admitted_gang == 6,
+        timeout=10.0,
+    )
+    assert sub.shrunk_mesh["data"] == 3 and sub.shrunk_mesh["fsdp"] == 2
+    assert 0 not in sub.placement
+    assert s.stats()["grow_backs_total"] == 1
+    # The last chip heals → the second grow reaches the full gang.
+    fleet_holder["fleet"] = _healthy_fleet()
+    assert wait_until(
+        lambda: sub.state == SubmissionState.RUNNING
+        and sub.admitted_gang == 8,
+        timeout=10.0,
+    )
+    assert sub.shrunk_mesh is None
+    assert s.stats()["grow_backs_total"] == 2
+
+
+def test_grow_back_is_hbm_gated(sched_factory):
+    """Healed chips whose HBM headroom cannot hold the job's projection
+    must not trigger a grow-back — preempting into an admission that
+    re-shrinks is a flap, not a grow."""
+
+    def big_est(c, available=None):
+        # 8 GiB/device: with the planner's 35% compile margin the grow
+        # needs 10.8 GiB headroom — the 12 GiB-free healthy chips clear
+        # it, the nearly-full healed chip below cannot.
+        return HBMEstimate(
+            model_name=c.model_name, gang_devices=8,
+            params_gib=8.0, grads_gib=0.0, opt_gib=0.0, working_gib=0.0,
+            activations_gib=0.0, logits_gib=0.0,
+            device_total_gib=8.0, host_gib=0.0,
+        )
+
+    fleet_holder = {"fleet": _degraded_fleet()}
+    s = sched_factory(
+        max_concurrent_jobs=1, fleet_fn=lambda: fleet_holder["fleet"],
+    )
+    sub = s.submit(elastic_cfg(), estimate_fn=big_est)
+    assert wait_until(lambda: sub.state == SubmissionState.RUNNING)
+    assert sub.admitted_gang == 6
+    # Chip 0 heals but comes back nearly full: 1 GiB free < the job's
+    # margined 10.8 GiB/device projection — the full gang cannot be placed.
+    mgr = TPUManager()
+    fleet_holder["fleet"] = mgr.get_fleet_status(
+        metrics=[_chip(0, hbm_used_gb=15.0)] + [_chip(i) for i in range(1, 8)]
+    )
+    time.sleep(0.3)
+    assert sub.admitted_gang == 6 and sub.attempts == 1
+    assert s.stats()["grow_backs_total"] == 0
+    # Once the chip's HBM actually drains, the grow-back proceeds.
+    fleet_holder["fleet"] = _healthy_fleet()
+    assert wait_until(
+        lambda: sub.state == SubmissionState.RUNNING
+        and sub.admitted_gang == 8,
+        timeout=10.0,
+    )
+    assert s.stats()["grow_backs_total"] == 1

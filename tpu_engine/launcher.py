@@ -30,6 +30,7 @@ from pydantic import BaseModel, Field
 
 from tpu_engine import comm, faults, quant_train
 from tpu_engine import scheduler as scheduler_mod
+from tpu_engine.hbm_estimate import gang_size
 from tpu_engine.mesh_runtime import MESH_AXES
 from tpu_engine.parallel import pipeline_zb
 from tpu_engine.scheduler import FleetScheduler, JobPriority, QuotaExceeded
@@ -265,8 +266,51 @@ class TPULauncher:
                 "grow_back_when_chips_recover": True,
                 "fault_injection_armed": faults.get_active() is not None,
             },
+            # Placement planner (tpu_engine/placement.py): the ranked
+            # alternative-layout table for this job at the same gang —
+            # what `mesh="auto"` would have picked, and how the submitted
+            # layout compares. Advisory on the dry-run/plan surface;
+            # binding only at auto admission.
+            "placement": self._placement_section(config, n_avail),
         }
         return plan
+
+    def _placement_section(
+        self, config: TPUTrainConfig, n_avail: int
+    ) -> dict[str, Any]:
+        planner = self.scheduler.planner
+        if config.model_name not in tfm.MODEL_CONFIGS:
+            return {
+                "available": False,
+                "reason": f"no_estimate:{config.model_name}",
+            }
+        try:
+            fleet = self.scheduler._fleet()
+            devices = (
+                [d for d in fleet.devices if d.is_available]
+                if fleet is not None and fleet.devices
+                else None
+            )
+            gang = gang_size(config, len(devices) if devices else n_avail)
+            result = planner.plan(
+                config, devices=devices, reserved=self.scheduler._reserved,
+                gang=gang,
+            )
+        except Exception as e:  # advisory plane — never sink the plan
+            return {"available": False, "reason": f"{type(e).__name__}: {e}"}
+        return {
+            "available": True,
+            "gang": gang,
+            "evaluated": result.evaluated,
+            "feasible": len(result.plans),
+            "pruned": len(result.pruned),
+            "ranked_plans": result.table(top_k=5),
+            "note": (
+                "predicted step times are a nominal-roofline RANKING model "
+                "(see tpu_engine/placement.py); submit with mesh='auto' to "
+                "admit the top feasible plan"
+            ),
+        }
 
     # -- launch --------------------------------------------------------------
 

@@ -1,0 +1,808 @@
+"""Placement planner: auto-layout search at admission, one shared cost model.
+
+Users hand-pick ``(data, fsdp, model, pipe, schedule, quant, comm)`` per
+submission even though every cost-model ingredient already exists in-tree:
+the per-layout memory plane (:func:`tpu_engine.hbm_estimate.estimate_job_hbm`),
+the analytic pipeline lane account
+(:func:`tpu_engine.parallel.pipeline_zb.schedule_account`) and the
+ZeRO++-style per-leaf byte model
+(:func:`tpu_engine.comm_compress.expected_volume_factors`). The planner
+composes them into one search (the Placement-Semantics recipe,
+arXiv:2601.02311; the comm-volume accounting follows ZeRO++,
+arXiv:2306.10209):
+
+1. **enumerate** — every factorization of the gang across the
+   ``data × fsdp × pipe × model`` mesh axes, crossed with sharding stage,
+   pipeline schedule and (opt-in) quant / comm-compression toggles;
+2. **prune** — each candidate is constructed as a real
+   :class:`~tpu_engine.sharding.TPUTrainConfig` (so the config interaction
+   matrix fires) and then pushed through a mirror of
+   ``build_train_program``'s build-time checks — the planner can never
+   emit a layout the builder would reject;
+3. **filter** — per-device HBM via ``estimate_job_hbm`` against live
+   fleet headroom minus the scheduler's per-device reservation ledger;
+4. **rank** — predicted step time = max(roofline compute ÷
+   ``schedule_account`` busy fraction, streamed fsdp/data collectives)
+   + the exposed interconnect term (tensor-parallel all-reduces, pipe
+   boundary permutes, DCN hops) from the comm byte model over
+   intra-slice (ICI) vs cross-slice (DCN) bandwidth.
+
+The prediction is a *ranking* model: absolute seconds assume a nominal
+TPU roofline and are meaningless on the CPU test backend, but every term
+that differs between layouts (bubble fraction, gather/reduce bytes,
+per-shard batch) is modelled, so the order survives — validated by
+``benchmarks/placement_plan.py`` (measured CPU-mesh sweep + llama-7b AOT).
+
+Wiring: ``FleetScheduler.submit(..., mesh="auto")`` admits the
+predicted-fastest feasible plan, ``TPULauncher`` dry runs and
+``POST /api/v1/scheduler/plan`` return the ranked table, and
+``tpu_engine_placement_*`` Prometheus families expose the counters.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Iterable, Optional
+
+import jax.numpy as jnp
+from pydantic import BaseModel, ConfigDict, Field
+
+from tpu_engine.hbm_estimate import HBMEstimate, estimate_job_hbm, gang_size
+from tpu_engine.models import transformer as tfm
+from tpu_engine.parallel.pipeline_zb import schedule_account
+from tpu_engine.sharding import (
+    OffloadDevice,
+    Precision,
+    ShardingStage,
+    TPUTrainConfig,
+    dtype_of,
+    resolve_pipeline_schedule,
+)
+
+log = logging.getLogger(__name__)
+
+# Nominal per-chip roofline / link constants. Absolute values only scale
+# the prediction; RANKING depends on the ratios, which hold across TPU
+# generations (ICI is ~1 order of magnitude faster than DCN). The compute
+# fallback is the v5e bf16 peak so predictions are well-defined on the
+# CPU test backend, where profiler.peak_flops_per_chip returns None.
+NOMINAL_PEAK_FLOPS = 197e12  # v5e bf16 MXU peak (profiler.PEAK_FLOPS_BF16)
+NOMINAL_ICI_BYTES_S = 4.5e10  # per-chip one-way intra-slice bandwidth
+NOMINAL_DCN_BYTES_S = 6.25e9  # per-host cross-slice (data-center) bandwidth
+ASSUMED_MFU = 0.45  # roofline derate; cancels in ranking
+
+
+class PlacementPlan(BaseModel):
+    """One validated candidate layout with its cost-model verdict."""
+
+    model_config = ConfigDict(arbitrary_types_allowed=True)
+
+    mesh: dict[str, int]
+    gang: int
+    sharding_stage: int
+    pipeline_schedule: str  # resolved concrete schedule ("gpipe"/"1f1b"/"zb")
+    micro_batch_size: int
+    gradient_accumulation_steps: int
+    quant_training: str = "none"
+    comm_compress: bool = False
+    predicted_compute_s: float
+    predicted_bubble_fraction: float
+    predicted_comm_s: float  # total collective seconds (streamed + exposed)
+    predicted_exposed_comm_s: float = 0.0  # critical-path share of the above
+    predicted_step_time_s: float
+    hbm_estimate: Optional[HBMEstimate] = None
+    feasible: bool = True
+    skip_reason: Optional[str] = None
+    # The fully-validated config this plan runs as — excluded from dumps
+    # (the API table stays compact); the scheduler admits exactly this.
+    config: Optional[TPUTrainConfig] = Field(default=None, exclude=True, repr=False)
+
+    @property
+    def label(self) -> str:
+        axes = "x".join(
+            f"{k}{v}" for k, v in self.mesh.items()
+            if v > 1 and k != "dcn_data"
+        ) or "data1"
+        tags = [self.pipeline_schedule] if self.mesh.get("pipe", 1) > 1 else []
+        if self.quant_training != "none":
+            tags.append(self.quant_training)
+        if self.comm_compress:
+            tags.append("commq")
+        return "·".join([axes, f"s{self.sharding_stage}", *tags])
+
+
+class PlannerResult(BaseModel):
+    """Ranked outcome of one planning pass."""
+
+    plans: list[PlacementPlan]  # feasible, predicted-fastest first
+    infeasible: list[PlacementPlan]  # HBM/headroom rejected (with reasons)
+    pruned: list[dict[str, str]]  # invalid layouts: {"layout", "reason"}
+    evaluated: int
+    skip_reason: Optional[str] = None  # e.g. "no_estimate:<model>"
+
+    @property
+    def best(self) -> Optional[PlacementPlan]:
+        return self.plans[0] if self.plans else None
+
+    def table(self, top_k: int = 10) -> list[dict[str, Any]]:
+        """Compact ranked rows for the API / launcher plan."""
+        rows = []
+        for rank, p in enumerate(self.plans[:top_k], start=1):
+            rows.append({
+                "rank": rank,
+                "layout": p.label,
+                "mesh": p.mesh,
+                "gang": p.gang,
+                "sharding_stage": p.sharding_stage,
+                "pipeline_schedule": p.pipeline_schedule,
+                "micro_batch_size": p.micro_batch_size,
+                "gradient_accumulation_steps": p.gradient_accumulation_steps,
+                "predicted_step_time_s": round(p.predicted_step_time_s, 6),
+                "predicted_bubble_fraction": round(p.predicted_bubble_fraction, 4),
+                "predicted_comm_s": round(p.predicted_comm_s, 6),
+                "hbm_gib_per_device": (
+                    round(p.hbm_estimate.device_total_gib, 3)
+                    if p.hbm_estimate else None
+                ),
+            })
+        return rows
+
+
+def _divisors(n: int) -> list[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def _mirror_build_checks(cfg: TPUTrainConfig, model_cfg: tfm.ModelConfig) -> None:
+    """Re-raise (as ValueError) every ``build_train_program`` build-time
+    interaction the config validators do not already cover, so an
+    enumerated plan can never fail at job construction. Mirrors
+    ``tpu_engine/train.py`` — the checks there are the source of truth;
+    this copy exists so the planner prunes instead of admitting a dud."""
+    m = cfg.mesh
+    pipe, model_ax, seq_ax = m.pipe, m.model, m.sequence
+    schedule = resolve_pipeline_schedule(cfg)
+    if pipe > 1 and model_cfg.n_layers % pipe != 0:
+        raise ValueError(
+            f"n_layers={model_cfg.n_layers} not divisible by pipe={pipe}"
+        )
+    moe_impl = cfg.moe_impl or model_cfg.moe_impl
+    if cfg.moe_impl is not None and not model_cfg.is_moe:
+        raise ValueError(f"moe_impl={cfg.moe_impl!r} on dense model")
+    if model_cfg.is_moe and moe_impl == "ragged" and model_ax > 1:
+        raise ValueError("moe_impl='ragged' cannot shard the expert dim")
+    if (
+        cfg.quant_training == "int8"
+        and model_cfg.is_moe
+        and moe_impl == "ragged"
+        and "moe" in cfg.quant_train_targets
+    ):
+        raise ValueError("quant int8 cannot quantize ragged MoE")
+    window = (
+        cfg.sliding_window
+        if cfg.sliding_window is not None
+        else model_cfg.sliding_window
+    )
+    if window and cfg.attention_impl in ("ring", "ulysses"):
+        raise ValueError("sliding_window with context-parallel attention")
+    if cfg.attention_impl == "ulysses":
+        local_heads = model_cfg.n_heads // model_ax
+        if local_heads % seq_ax != 0:
+            raise ValueError(
+                f"ulysses: {local_heads} local heads not divisible by "
+                f"sequence axis {seq_ax}"
+            )
+    if model_ax > 1 and (
+        model_cfg.n_heads % model_ax
+        or model_cfg.n_kv_heads % model_ax
+        or model_cfg.d_ff % model_ax
+        or model_cfg.vocab_size % model_ax
+    ):
+        raise ValueError(
+            f"model axis {model_ax} does not divide heads/kv/ffn/vocab"
+        )
+    if cfg.loss_chunk_size:
+        if cfg.seq_len % cfg.loss_chunk_size:
+            raise ValueError("loss_chunk_size must divide seq_len")
+        if schedule in ("1f1b", "zb") and pipe > 1:
+            raise ValueError(f"loss_chunk_size with schedule {schedule!r}")
+    use_lora = cfg.lora_rank is not None
+    if use_lora and pipe > 1:
+        raise ValueError("LoRA with pipeline parallelism")
+    offload_params = cfg.param_offload == OffloadDevice.HOST
+    if offload_params and (use_lora or pipe > 1):
+        raise ValueError("param_offload=host with LoRA/pipeline")
+    if cfg.optimizer_offload == OffloadDevice.DISK and pipe > 1:
+        raise ValueError("optimizer_offload='disk' with pipeline")
+    reduced_comm = (
+        cfg.grad_allreduce_dtype is not None
+        and cfg.grad_allreduce_dtype != Precision.FP32
+    )
+    if reduced_comm and pipe > 1 and schedule in ("1f1b", "zb"):
+        raise ValueError(f"grad_allreduce_dtype with schedule {schedule!r}")
+    if reduced_comm and offload_params:
+        raise ValueError("grad_allreduce_dtype with param_offload=host")
+
+
+class PlacementPlanner:
+    """Enumerate → prune → HBM-filter → rank layouts for one submission.
+
+    Thread-safe counters only; the search itself is pure. One instance
+    lives on the :class:`~tpu_engine.scheduler.FleetScheduler` so admission,
+    grow-back, the launcher plan and the HTTP endpoint share a single
+    counter plane (``tpu_engine_placement_*``).
+    """
+
+    def __init__(
+        self,
+        estimate_fn: Callable[..., Optional[HBMEstimate]] = estimate_job_hbm,
+        peak_flops: Optional[float] = None,
+        ici_bytes_s: float = NOMINAL_ICI_BYTES_S,
+        dcn_bytes_s: float = NOMINAL_DCN_BYTES_S,
+        consider_quant: bool = False,
+        consider_comm_compress: bool = False,
+        stages: tuple[ShardingStage, ...] = (
+            ShardingStage.FULL_PARTITIONING,
+            ShardingStage.GRADIENT_PARTITIONING,
+        ),
+        max_gang_enumeration: int = 16,
+        hbm_margin_frac: float = 0.35,
+    ):
+        if peak_flops is None:
+            try:
+                from tpu_engine.profiler import peak_flops_per_chip
+
+                peak_flops = peak_flops_per_chip()
+            except Exception:
+                peak_flops = None
+        self.peak_flops = peak_flops or NOMINAL_PEAK_FLOPS
+        self.estimate_fn = estimate_fn
+        self.ici_bytes_s = ici_bytes_s
+        self.dcn_bytes_s = dcn_bytes_s
+        # Quant / comm-compression variants are opt-in: both are measured
+        # wins only on real MXU / real DCN (benchmarks/RESULTS.md — int8
+        # matmul is 0.71x on CPU), so enumerating them by default would
+        # mispredict every CPU-backend ranking.
+        self.consider_quant = consider_quant
+        self.consider_comm_compress = consider_comm_compress
+        self.stages = stages
+        self.max_gang_enumeration = max_gang_enumeration
+        # estimate_job_hbm is analytic: it cannot see XLA's scheduling
+        # temporaries, so a plan near the top of free HBM still OOMs at
+        # compile. Measured on llama-7b via placement_plan.py --aot: flat
+        # layouts land ~8% over the estimate (15.18 est -> 16.38 real),
+        # pipelined ones 30-40% over (13.79 -> 17.82; 13.70 -> 18.99) —
+        # the in-flight microbatch stash is the hardest term to project.
+        # 35% covers the measured band; the AOT plane is the backstop for
+        # anything beyond it. The gate charges every estimate this
+        # fraction on top before comparing to headroom.
+        self.hbm_margin_frac = hbm_margin_frac
+
+        self._lock = threading.Lock()
+        self.plans_evaluated_total = 0
+        self.plans_pruned_total = 0
+        self.plans_hbm_rejected_total = 0
+        self.plans_chosen_total = 0
+        self.no_estimate_refusals_total = 0
+        self.prune_reasons: dict[str, int] = {}
+        self.last_feasible = 0
+        self.last_chosen_predicted_s: Optional[float] = None
+        self._observations: list[tuple[float, float]] = []  # (predicted, observed)
+
+    # -- enumeration ---------------------------------------------------------
+
+    def enumerate(
+        self,
+        config: TPUTrainConfig,
+        gang: int,
+        *,
+        consider_quant: Optional[bool] = None,
+        consider_comm_compress: Optional[bool] = None,
+        stages: Optional[Iterable[ShardingStage]] = None,
+    ) -> tuple[list[PlacementPlan], list[dict[str, str]]]:
+        """All valid layouts of ``config`` on exactly ``gang`` devices.
+
+        Returns ``(plans, pruned)``: every plan carries a fully-validated
+        ``TPUTrainConfig`` (the interaction matrix and the mirrored build
+        checks both passed); ``pruned`` records the rejected layouts with
+        their reason — known-invalid combos (1f1b × quant_training,
+        comm-compression × pipe, ...) land there, never in ``plans``.
+
+        The search keeps tokens/step constant: the submitted global batch
+        (``micro × accum × data × fsdp`` at the configured mesh) is
+        re-split per layout — per-shard batch must divide evenly, micro
+        shrinks to the largest divisor ≤ the requested micro, and the
+        remainder becomes gradient accumulation (the pipeline stream).
+        """
+        model_cfg = tfm.MODEL_CONFIGS.get(config.model_name)
+        if model_cfg is None:
+            raise ValueError(f"no_estimate:{config.model_name}")
+        cq = self.consider_quant if consider_quant is None else consider_quant
+        cc = (
+            self.consider_comm_compress
+            if consider_comm_compress is None
+            else consider_comm_compress
+        )
+        stage_opts = tuple(stages) if stages is not None else self.stages
+
+        base = config.model_dump()
+        base_mesh = config.mesh
+        # Requested global batch at the configured mesh (data=-1 resolved
+        # against the same gang).
+        base_data, base_fsdp, _, _, _ = base_mesh.resolved_shape(
+            gang_size(config, gang)
+        )
+        global_batch = (
+            config.micro_batch_size
+            * config.gradient_accumulation_steps
+            * base_data
+            * base_fsdp
+        )
+        seq_ax = base_mesh.sequence  # held fixed: same factor in every plan
+        dcn = base_mesh.dcn_data
+
+        plans: list[PlacementPlan] = []
+        pruned: list[dict[str, str]] = []
+
+        def _prune(layout: str, reason: str) -> None:
+            pruned.append({"layout": layout, "reason": reason})
+
+        if gang % seq_ax:
+            _prune(f"gang{gang}", f"gang not divisible by sequence axis {seq_ax}")
+            self._account(evaluated=1, pruned_n=1, reasons=[r["reason"] for r in pruned])
+            return plans, pruned
+
+        spatial = gang // seq_ax
+        n_evaluated = 0
+        for model_ax in _divisors(spatial):
+            for pipe in _divisors(spatial // model_ax):
+                for fsdp in _divisors(spatial // (model_ax * pipe)):
+                    data = spatial // (model_ax * pipe * fsdp)
+                    name = f"d{data}·f{fsdp}·p{pipe}·t{model_ax}"
+                    if data % dcn:
+                        n_evaluated += 1
+                        _prune(name, f"data axis {data} not divisible by dcn_data {dcn}")
+                        continue
+                    dp = data * fsdp
+                    if global_batch % dp:
+                        n_evaluated += 1
+                        _prune(name, f"global batch {global_batch} not divisible by dp {dp}")
+                        continue
+                    per_shard = global_batch // dp
+                    micro = max(
+                        d for d in _divisors(per_shard)
+                        if d <= config.micro_batch_size
+                    )
+                    accum = per_shard // micro
+                    schedules = (
+                        ("gpipe", "1f1b", "zb") if pipe > 1 else ("auto",)
+                    )
+                    stage_list = (
+                        stage_opts if fsdp > 1
+                        else (ShardingStage.FULL_PARTITIONING,)
+                    )
+                    quant_opts = ("none", "int8") if cq else ("none",)
+                    comm_opts = (False, True) if cc else (False,)
+                    for stage in stage_list:
+                        for schedule in schedules:
+                            for quant in quant_opts:
+                                for comm in comm_opts:
+                                    n_evaluated += 1
+                                    tag = name + f"·s{int(stage)}·{schedule}" + (
+                                        f"·{quant}" if quant != "none" else ""
+                                    ) + ("·commq" if comm else "")
+                                    cand = dict(base)
+                                    cand["mesh"] = {
+                                        "data": data, "fsdp": fsdp,
+                                        "pipe": pipe, "sequence": seq_ax,
+                                        "model": model_ax, "dcn_data": dcn,
+                                    }
+                                    cand["sharding_stage"] = stage
+                                    cand["pipeline_schedule"] = schedule
+                                    cand["micro_batch_size"] = micro
+                                    cand["gradient_accumulation_steps"] = accum
+                                    cand["quant_training"] = quant
+                                    if comm:
+                                        cand["comm_quant_weights"] = True
+                                        cand["comm_quant_grads"] = True
+                                    try:
+                                        # A fresh construction — never
+                                        # model_copy, which skips the
+                                        # validator interaction matrix.
+                                        cfg = TPUTrainConfig(**cand)
+                                        _mirror_build_checks(cfg, model_cfg)
+                                    except ValueError as e:
+                                        msg = str(e)
+                                        errors = getattr(e, "errors", None)
+                                        if callable(errors):
+                                            try:  # pydantic: the real message
+                                                msg = errors()[0].get("msg", msg)
+                                            except Exception:
+                                                pass
+                                        _prune(tag, msg.splitlines()[0][:160])
+                                        continue
+                                    plans.append(self._predict(cfg, model_cfg, gang))
+        self._account(
+            evaluated=n_evaluated,
+            pruned_n=len(pruned),
+            reasons=[r["reason"] for r in pruned],
+        )
+        return plans, pruned
+
+    # -- cost model ----------------------------------------------------------
+
+    def _predict(
+        self, cfg: TPUTrainConfig, model_cfg: tfm.ModelConfig, gang: int
+    ) -> PlacementPlan:
+        """Predicted step time for one validated candidate.
+
+        compute: roofline seconds for the step's global tokens, divided by
+        the schedule's busy fraction (bubble lanes burn chip time);
+        comm: analytic bytes per device per step over ICI/DCN —
+        stage-3 weight all-gathers per microbatch (÷ the qwZ wire factor
+        when compressed), gradient reduce-scatter/all-reduce over
+        fsdp/data (the data plane rides DCN when dcn_data > 1, ÷ the qgZ
+        factor when compressed), per-layer tensor-parallel activation
+        all-reduces, and pipeline boundary permutes.
+
+        The fsdp/data collectives are *streamed*: XLA's latency-hiding
+        scheduler overlaps weight gathers and gradient reduces with the
+        per-layer matmuls (that is what makes FSDP work at all), so they
+        are charged as ``max(compute, streamed_comm)`` rather than added.
+        Tensor-parallel activation all-reduces sit between sequential
+        matmuls, pipeline boundary permutes between stages, and DCN hops
+        behind a long latency — those stay on the critical path. Charging
+        everything serially over-ranks deep-pipe layouts (their comm is
+        boundary-only) against fsdp layouts whose gathers are actually
+        free; the ``--aot`` plane caught exactly that inversion.
+        """
+        m = cfg.mesh
+        # Resolve elastic axes (data=-1) against the gang — the raw mesh
+        # would give a negative token count.
+        data, fsdp, pipe, seq_axis, model_ax = m.resolved_shape(gang)
+        seq = cfg.seq_len
+        micro = cfg.micro_batch_size
+        accum = cfg.gradient_accumulation_steps
+        schedule = resolve_pipeline_schedule(cfg)
+
+        tokens = data * fsdp * micro * accum * seq
+        flops = tfm.train_flops_per_token(model_cfg, seq) * tokens
+        compute_s = flops / (gang * self.peak_flops * ASSUMED_MFU)
+        acct = schedule_account(schedule, pipe, accum)
+        busy = acct["busy_fraction"] or 1.0
+        compute_s /= busy
+
+        compute_b = jnp.dtype(cfg.compute_dtype()).itemsize
+        grad_b = (
+            jnp.dtype(dtype_of(cfg.grad_allreduce_dtype)).itemsize
+            if cfg.grad_allreduce_dtype is not None else 4
+        )
+        n_params = tfm.param_count(model_cfg)
+        # Params owned by this device's fsdp group (model/pipe shard first).
+        p_group = n_params / (model_ax * pipe)
+        ici_stream_bytes = 0.0  # overlaps with compute (fsdp/data plane)
+        ici_exposed_bytes = 0.0  # critical path (tp all-reduce, pipe p2p)
+        dcn_bytes = 0.0
+
+        if fsdp > 1 and cfg.sharding_stage >= ShardingStage.FULL_PARTITIONING:
+            # ZeRO-3 weight all-gather, forward + backward re-gather, once
+            # per accumulation microbatch.
+            gather = p_group * compute_b * (fsdp - 1) / fsdp * 2 * accum
+            if cfg.comm_quant_weights:
+                from tpu_engine.comm_compress import expected_volume_factors
+
+                gather /= expected_volume_factors(
+                    cfg.comm_quant_block_size
+                )["weight_gather"]
+            ici_stream_bytes += gather
+
+        g_bytes = p_group * grad_b
+        if fsdp > 1:
+            if cfg.sharding_stage >= ShardingStage.GRADIENT_PARTITIONING:
+                ici_stream_bytes += g_bytes * (fsdp - 1) / fsdp  # reduce-scatter
+                g_bytes /= fsdp  # the data-plane reduce moves the shard
+            else:
+                ici_stream_bytes += 2 * g_bytes * (fsdp - 1) / fsdp  # all-reduce
+        if data > 1:
+            reduce = 2 * g_bytes * (data - 1) / data
+            if m.dcn_data > 1:
+                if cfg.comm_quant_grads:
+                    from tpu_engine.comm_compress import expected_volume_factors
+
+                    reduce /= expected_volume_factors(
+                        cfg.comm_quant_block_size
+                    )["grad_cross_slice"]
+                dcn_bytes += reduce
+            else:
+                ici_stream_bytes += reduce
+        if model_ax > 1:
+            # Two activation all-reduces per layer per direction (attention
+            # out + MLP out), sized [micro, seq, d_model].
+            act = micro * seq * model_cfg.d_model * compute_b
+            ici_exposed_bytes += (
+                8.0 * act * (model_ax - 1) / model_ax
+                * (model_cfg.n_layers / pipe) * accum
+            )
+        if pipe > 1:
+            act = micro * seq * model_cfg.d_model * compute_b
+            ici_exposed_bytes += 2.0 * act * accum  # boundary ppermute fwd+bwd
+
+        stream_s = ici_stream_bytes / self.ici_bytes_s
+        exposed_s = (
+            ici_exposed_bytes / self.ici_bytes_s
+            + dcn_bytes / self.dcn_bytes_s
+        )
+        comm_s = stream_s + exposed_s
+        return PlacementPlan(
+            mesh={
+                "data": data, "fsdp": fsdp, "pipe": pipe,
+                "sequence": seq_axis, "model": model_ax,
+                "dcn_data": m.dcn_data,
+            },
+            gang=gang,
+            sharding_stage=int(cfg.sharding_stage),
+            pipeline_schedule=schedule,
+            micro_batch_size=micro,
+            gradient_accumulation_steps=accum,
+            quant_training=cfg.quant_training,
+            comm_compress=bool(cfg.comm_quant_weights or cfg.comm_quant_grads),
+            predicted_compute_s=compute_s,
+            predicted_bubble_fraction=acct["bubble_fraction"],
+            predicted_comm_s=comm_s,
+            predicted_exposed_comm_s=exposed_s,
+            predicted_step_time_s=max(compute_s, stream_s) + exposed_s,
+            config=cfg,
+        )
+
+    def predict(
+        self,
+        config: TPUTrainConfig,
+        gang: Optional[int] = None,
+        model_cfg: Optional[tfm.ModelConfig] = None,
+    ) -> PlacementPlan:
+        """Cost one explicit layout without enumerating alternatives.
+
+        The benchmark/A-B entry point: same prediction the search ranks
+        by, for a config the caller already fixed. ``model_cfg`` overrides
+        the zoo lookup (mirrors ``build_train_program``'s escape hatch);
+        without it, raises ``ValueError`` with ``no_estimate:<model>``
+        for models outside the zoo.
+        """
+        if model_cfg is None:
+            if config.model_name not in tfm.MODEL_CONFIGS:
+                with self._lock:
+                    self.no_estimate_refusals_total += 1
+                raise ValueError(f"no_estimate:{config.model_name}")
+            model_cfg = tfm.MODEL_CONFIGS[config.model_name]
+        g = gang if gang is not None else gang_size(config, None)
+        return self._predict(config, model_cfg, g)
+
+    # -- planning (enumerate + HBM filter + rank) ----------------------------
+
+    def plan(
+        self,
+        config: TPUTrainConfig,
+        *,
+        devices: Optional[list[Any]] = None,
+        reserved: Optional[dict[int, float]] = None,
+        gang: Optional[int] = None,
+        n_avail: Optional[int] = None,
+        **enum_kw: Any,
+    ) -> PlannerResult:
+        """Ranked feasible plans for ``config`` against the live fleet.
+
+        ``devices``: eligible fleet devices (``TPUDevice``-shaped: index /
+        hbm_free_gb / hbm_total_gb); None degrades the HBM gate to
+        capacity-only — missing telemetry must not brick planning.
+        ``reserved``: the scheduler's device-index → GiB ledger.
+        ``gang``: pin the search to one gang size; default searches every
+        admissible size up to the available device count ("best
+        available") — predicted-fastest wins, which naturally prefers the
+        largest gang unless its layouts are HBM-infeasible.
+        """
+        if config.model_name not in tfm.MODEL_CONFIGS:
+            with self._lock:
+                self.no_estimate_refusals_total += 1
+            return PlannerResult(
+                plans=[], infeasible=[], pruned=[], evaluated=0,
+                skip_reason=f"no_estimate:{config.model_name}",
+            )
+        if n_avail is None:
+            n_avail = len(devices) if devices is not None else None
+        if n_avail is None:
+            import jax
+
+            n_avail = jax.device_count()
+        gangs = [gang] if gang else self._candidate_gangs(n_avail)
+
+        reserved = reserved or {}
+        feasible: list[PlacementPlan] = []
+        infeasible: list[PlacementPlan] = []
+        pruned: list[dict[str, str]] = []
+        evaluated = 0
+        for g in gangs:
+            plans, dropped = self.enumerate(config, g, **enum_kw)
+            pruned.extend(dropped)
+            evaluated += len(plans) + len(dropped)
+            for p in plans:
+                est = None
+                try:
+                    est = self.estimate_fn(p.config, g)
+                except Exception:  # estimator must never block planning
+                    est = None
+                p.hbm_estimate = est
+                ok, reason = self._hbm_feasible(est, g, devices, reserved)
+                p.feasible = ok
+                p.skip_reason = reason
+                (feasible if ok else infeasible).append(p)
+        # Normalize by samples/step: within one gang every plan carries the
+        # same global batch (so this is exactly predicted step time), but
+        # across gangs an elastic data=-1 job scales its batch with the
+        # devices — raw step time would crown a 1-chip gang that simply
+        # does less work. Per-sample time is the throughput-fair order.
+        def _per_sample(p: PlacementPlan) -> float:
+            samples = (
+                p.mesh["data"] * p.mesh["fsdp"]
+                * p.micro_batch_size * p.gradient_accumulation_steps
+            )
+            return p.predicted_step_time_s / samples
+
+        # Tiebreak equal predicted throughput by projected HBM: when two
+        # layouts cost the same (fully-overlapped comm makes e.g. fsdp16
+        # and data2xfsdp8 identical), the one with more headroom is
+        # strictly safer to admit.
+        feasible.sort(key=lambda p: (
+            _per_sample(p),
+            p.hbm_estimate.device_total_gib if p.hbm_estimate else float("inf"),
+            -p.gang,
+        ))
+        with self._lock:
+            self.plans_hbm_rejected_total += len(infeasible)
+            self.last_feasible = len(feasible)
+        return PlannerResult(
+            plans=feasible, infeasible=infeasible, pruned=pruned,
+            evaluated=evaluated,
+        )
+
+    def _candidate_gangs(self, n_avail: int) -> list[int]:
+        """Gang sizes worth searching, largest first. Exhaustive up to
+        ``max_gang_enumeration`` devices; beyond that, the full fleet plus
+        powers of two (the shapes real slices come in)."""
+        if n_avail <= 0:
+            return []
+        if n_avail <= self.max_gang_enumeration:
+            return list(range(n_avail, 0, -1))
+        sizes = {n_avail}
+        p = 1
+        while p <= n_avail:
+            sizes.add(p)
+            p *= 2
+        return sorted(sizes, reverse=True)
+
+    def _hbm_feasible(
+        self,
+        est: Optional[HBMEstimate],
+        gang: int,
+        devices: Optional[list[Any]],
+        reserved: dict[int, float],
+    ) -> tuple[bool, Optional[str]]:
+        """Mirror of the scheduler's admission HBM gate: enough devices
+        with ``free - reserved >= need``, where ``need`` carries the
+        ``hbm_margin_frac`` surcharge for XLA temporaries the analytic
+        estimate cannot see. Capacity-only (always feasible) when there is
+        no fleet view or no HBM telemetry."""
+        if devices is None or not devices:
+            return True, None
+        if len(devices) < gang:
+            return False, f"gang {gang} > {len(devices)} eligible chip(s)"
+        if est is None or not all(
+            getattr(d, "hbm_total_gb", 0) > 0 for d in devices
+        ):
+            return True, None
+        need = est.device_total_gib * (1.0 + self.hbm_margin_frac)
+        fits = sum(
+            1 for d in devices
+            if d.hbm_free_gb - reserved.get(d.index, 0.0) >= need
+        )
+        if fits < gang:
+            return False, (
+                f"needs {need:.2f} GiB/device (est + "
+                f"{self.hbm_margin_frac:.0%} margin) on {gang} chip(s); "
+                f"only {fits} have that headroom"
+            )
+        return True, None
+
+    # -- grow-back support ---------------------------------------------------
+
+    def grow_target(
+        self,
+        config: TPUTrainConfig,
+        devices: list[Any],
+        reserved: dict[int, float],
+        current_gang: int,
+        estimate_fn: Optional[Callable[..., Optional[HBMEstimate]]] = None,
+    ) -> Optional[int]:
+        """Largest gang (> ``current_gang``) a shrunk job could grow to on
+        ``devices`` — the full configured gang when it fits, else the
+        largest *intermediate* mesh from the elastic family, HBM-gated
+        against per-device headroom minus ``reserved`` (the caller drops
+        the job's own reservation first). None → stay at the current size.
+        """
+        from tpu_engine.hbm_estimate import elastic_shrink_plan
+
+        est_fn = estimate_fn or self.estimate_fn
+        n = len(devices)
+        full = gang_size(config, n)
+        if current_gang < full <= n:
+            try:
+                est = est_fn(config, full)
+            except Exception:
+                est = None
+            if self._hbm_feasible(est, full, devices, reserved)[0]:
+                return full
+        probe = n
+        while probe > current_gang:
+            try:
+                shrink = elastic_shrink_plan(config, probe, est_fn)
+            except Exception:
+                return None
+            if shrink is None:
+                return None
+            _, n_use, est = shrink
+            if n_use <= current_gang:
+                return None
+            if self._hbm_feasible(est, n_use, devices, reserved)[0]:
+                return n_use
+            probe = n_use - 1
+        return None
+
+    # -- telemetry -----------------------------------------------------------
+
+    def _account(
+        self, evaluated: int, pruned_n: int, reasons: list[str]
+    ) -> None:
+        with self._lock:
+            self.plans_evaluated_total += evaluated
+            self.plans_pruned_total += pruned_n
+            for r in reasons:
+                key = r.split("(")[0].split(":")[0].strip()[:60]
+                self.prune_reasons[key] = self.prune_reasons.get(key, 0) + 1
+
+    def note_chosen(self, plan: PlacementPlan) -> None:
+        with self._lock:
+            self.plans_chosen_total += 1
+            self.last_chosen_predicted_s = plan.predicted_step_time_s
+
+    def record_observation(self, predicted_s: float, observed_s: float) -> None:
+        """Predicted-vs-observed step time for an admitted auto plan
+        (the scheduler calls this at reap with wall seconds / steps)."""
+        if predicted_s <= 0 or observed_s <= 0:
+            return
+        with self._lock:
+            self._observations.append((predicted_s, observed_s))
+            del self._observations[:-200]
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            obs = list(self._observations)
+            top_reasons = dict(
+                sorted(self.prune_reasons.items(), key=lambda kv: -kv[1])[:8]
+            )
+            out = {
+                "plans_evaluated_total": self.plans_evaluated_total,
+                "plans_pruned_total": self.plans_pruned_total,
+                "plans_hbm_rejected_total": self.plans_hbm_rejected_total,
+                "plans_chosen_total": self.plans_chosen_total,
+                "no_estimate_refusals_total": self.no_estimate_refusals_total,
+                "last_feasible": self.last_feasible,
+                "last_chosen_predicted_s": self.last_chosen_predicted_s,
+                "prune_reasons": top_reasons,
+                "observations_total": len(obs),
+            }
+        if obs:
+            errs = [abs(p - o) / o for p, o in obs]
+            out["step_time_abs_rel_error"] = sum(errs) / len(errs)
+            out["last_predicted_s"], out["last_observed_s"] = obs[-1]
+        else:
+            out["step_time_abs_rel_error"] = None
+        return out

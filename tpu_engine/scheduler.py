@@ -47,6 +47,7 @@ from tpu_engine.hbm_estimate import (
     estimate_job_hbm,
     gang_size,
 )
+from tpu_engine.placement import PlacementPlanner
 from tpu_engine.sharding import TPUTrainConfig
 from tpu_engine.supervisor import JobStatus, TrainingJob
 from tpu_engine.tpu_manager import TPUFleetStatus
@@ -144,6 +145,12 @@ class Submission:
         # shrink/grow cycles faster than the cooldown).
         self.last_resize_at: Optional[float] = None
         self.last_admitted_at: Optional[float] = None
+        # Auto placement (mesh="auto"): the planner replaces the submitted
+        # mesh/schedule at every admission with the predicted-fastest
+        # feasible plan against the then-current fleet.
+        self.auto_place = False
+        self.placement_plan: Optional[dict[str, Any]] = None
+        self.predicted_step_time_s: Optional[float] = None
 
     @property
     def preemptible(self) -> bool:
@@ -185,6 +192,9 @@ class Submission:
             "placement": self.placement,
             "shrunk_mesh": self.shrunk_mesh,
             "admitted_gang": self.admitted_gang,
+            "auto_place": self.auto_place,
+            "placement_plan": self.placement_plan,
+            "predicted_step_time_s": self.predicted_step_time_s,
             "job": self.job.describe() if self.job is not None else None,
         }
 
@@ -226,6 +236,7 @@ class FleetScheduler:
         poll_interval_s: float = 0.1,
         grow_back: bool = True,
         grow_back_cooldown_s: float = 30.0,
+        planner: Optional[PlacementPlanner] = None,
     ):
         self.grow_back = grow_back
         # Hysteresis window: a shrunk job is not grown back until this long
@@ -243,6 +254,9 @@ class FleetScheduler:
         self.quotas = dict(quotas or {})
         self.checkpoint_root = checkpoint_root
         self.poll_interval_s = poll_interval_s
+        # One planner per scheduler: auto admission, grow-back, the
+        # launcher plan and the /plan endpoint share its counter plane.
+        self.planner = planner or PlacementPlanner(estimate_fn=estimate_fn)
 
         self._lock = threading.RLock()
         self._subs: dict[str, Submission] = {}
@@ -261,6 +275,8 @@ class FleetScheduler:
         self.elastic_shrinks_total = 0
         self.grow_backs_total = 0
         self.self_heal_requeues_total = 0
+        self.auto_admissions_total = 0
+        self.no_estimate_skips_total = 0
         self._wait_samples: list[float] = []  # bounded; admitted-wait seconds
         # Per-submitter planes (the fairness follow-on needs a measured
         # baseline): admitted-wait samples and accumulated busy seconds
@@ -284,14 +300,38 @@ class FleetScheduler:
         workload: str = "training",
         estimate_fn: Optional[Callable[..., Optional[HBMEstimate]]] = None,
         job_factory: Optional[Callable[[Submission], Any]] = None,
+        mesh: Optional[str] = None,
     ) -> Submission:
         """Enqueue; raises :class:`QuotaExceeded` when the submitter already
         holds their quota of active (queued/running) submissions.
+
+        ``mesh="auto"`` hands layout choice to the placement planner: every
+        admission pass replaces the submitted mesh/schedule with the
+        predicted-fastest feasible plan (``tpu_engine/placement.py``)
+        against the then-current fleet and reservation ledger. Refused
+        outright (ValueError, reason ``no_estimate:<model>``) for models
+        the HBM estimator does not know — the planner cannot bound a
+        layout it cannot cost.
 
         ``workload="serving"`` enters the SAME queue/quota/ledger as
         training, carrying its own ``estimate_fn`` (the KV-pool plane) and
         ``job_factory`` (a decode replica, not a train loop) — see
         ``tpu_engine/serving_fleet.py``."""
+        if mesh not in (None, "explicit", "auto"):
+            raise ValueError(f"mesh must be 'auto' or 'explicit', got {mesh!r}")
+        auto_place = mesh == "auto"
+        if auto_place:
+            if workload != "training":
+                raise ValueError("mesh='auto' is only supported for training")
+            from tpu_engine.models.transformer import MODEL_CONFIGS
+
+            if config.model_name not in MODEL_CONFIGS:
+                self.planner.no_estimate_refusals_total += 1
+                raise ValueError(
+                    f"mesh='auto' refused: no_estimate:{config.model_name} "
+                    "(the planner cannot cost an unknown model; submit an "
+                    "explicit mesh instead)"
+                )
         with self._lock:
             quota = self.quotas.get(submitter, self.default_quota)
             if quota is not None:
@@ -323,6 +363,7 @@ class FleetScheduler:
                 workload=workload, estimate_fn=estimate_fn,
                 job_factory=job_factory,
             )
+            sub.auto_place = auto_place
             self._subs[sub.submission_id] = sub
             self.submitted_total += 1
         self._ensure_thread()
@@ -453,6 +494,16 @@ class FleetScheduler:
             job = sub.job
             if job is None or job.is_alive:
                 continue
+            # Predicted-vs-observed step time for auto-placed attempts:
+            # wall seconds held ÷ steps run feeds the planner's error gauge
+            # (tpu_engine_placement_step_time_abs_rel_error).
+            if sub.predicted_step_time_s and sub.last_admitted_at is not None:
+                steps = getattr(job, "current_step", None)
+                if steps:
+                    self.planner.record_observation(
+                        sub.predicted_step_time_s,
+                        max(time.time() - sub.last_admitted_at, 1e-9) / steps,
+                    )
             self._credit_busy(sub)
             if job.status == JobStatus.PREEMPTED and sub.state != SubmissionState.CANCELLING:
                 # Emergency save completed (the train loop's final
@@ -538,7 +589,73 @@ class FleetScheduler:
         if fleet is None or not fleet.devices:
             return True
         eligible = [d for d in fleet.devices if d.is_available]
+        if sub.auto_place:
+            # The planner re-sizes to whatever is healthy — placeable as
+            # long as anything is (HBM may still refuse, like any job).
+            return bool(eligible)
         return gang_size(sub.config, len(eligible)) <= len(eligible)
+
+    def _plan_auto(self, sub: Submission, eligible, n_avail: int):
+        """Pick the predicted-fastest feasible plan for an auto-placed
+        submission. Returns the chosen :class:`PlacementPlan` (its config
+        becomes this attempt's config) or None with a structured skip
+        reason — including the next-best fallback trail when faster plans
+        were unplaceable against live headroom."""
+        # Honor the submitted gang (data=-1 resolves to "best available" =
+        # everything eligible): the planner searches layouts AT that size
+        # and only falls back to smaller gangs when nothing at the
+        # requested size is feasible (HBM) or the fleet is degraded.
+        requested = gang_size(sub.config, n_avail)
+        if requested <= n_avail:
+            result = self.planner.plan(
+                sub.config, devices=eligible, reserved=self._reserved,
+                gang=requested,
+            )
+            if not result.plans and not result.skip_reason:
+                result = self.planner.plan(
+                    sub.config, devices=eligible, reserved=self._reserved,
+                    n_avail=requested,
+                )
+        else:
+            result = self.planner.plan(
+                sub.config, devices=eligible, reserved=self._reserved,
+                n_avail=n_avail,
+            )
+        if result.skip_reason:  # no_estimate:<model>
+            sub.last_skip_reason = result.skip_reason
+            return None
+        head = result.best
+        if head is None:
+            reasons = sorted(
+                {p.skip_reason for p in result.infeasible if p.skip_reason}
+            )
+            sub.last_skip_reason = "auto-placement: no feasible layout" + (
+                f" — {reasons[0]}" if reasons else ""
+            )
+            return None
+        # Plans that predicted faster than the choice but were unplaceable
+        # (HBM headroom) — the structured record of the next-best fallback.
+        passed_over = sorted(
+            (
+                p for p in result.infeasible
+                if p.predicted_step_time_s < head.predicted_step_time_s
+            ),
+            key=lambda p: p.predicted_step_time_s,
+        )
+        sub.placement_plan = {
+            "chosen": head.model_dump(exclude={"config", "hbm_estimate"}),
+            "label": head.label,
+            "evaluated": result.evaluated,
+            "feasible": len(result.plans),
+            "pruned": len(result.pruned),
+            "fallback_from": [
+                {"layout": p.label, "reason": p.skip_reason}
+                for p in passed_over[:3]
+            ],
+        }
+        sub.predicted_step_time_s = head.predicted_step_time_s
+        sub.config = head.config
+        return head
 
     def _try_admit(self, sub: Submission, fleet: Optional[TPUFleetStatus]) -> bool:
         eligible = None
@@ -546,13 +663,35 @@ class FleetScheduler:
             eligible = [d for d in fleet.devices if d.is_available]
         n_avail = len(eligible) if eligible is not None else jax.device_count()
 
-        gang = gang_size(sub.config, n_avail)
         estimate_fn = sub.estimate_fn or self.estimate_fn
-        try:
-            est = estimate_fn(sub.config, n_avail)
-        except Exception:  # estimator must never block admission
-            est = None
-        sub.estimate = est
+        no_est_reason = None
+        head = None
+        if sub.auto_place:
+            head = self._plan_auto(sub, eligible, n_avail)
+            if head is None:
+                return False
+            gang, est = head.gang, head.hbm_estimate
+            sub.estimate = est
+        else:
+            gang = gang_size(sub.config, n_avail)
+            try:
+                est = estimate_fn(sub.config, n_avail)
+            except Exception:  # estimator must never block admission
+                est = None
+            sub.estimate = est
+            if est is None and sub.workload == "training":
+                from tpu_engine.models.transformer import MODEL_CONFIGS
+
+                if sub.config.model_name not in MODEL_CONFIGS:
+                    # Structured skip annotation: admission still proceeds
+                    # capacity-only (missing telemetry must not brick the
+                    # queue), but the queue surface names WHY there is no
+                    # HBM estimate — and stays on the submission if the
+                    # job construction fails downstream.
+                    no_est_reason = f"no_estimate:{sub.config.model_name}"
+                    if sub.last_skip_reason != no_est_reason:
+                        self.no_estimate_skips_total += 1
+                    sub.last_skip_reason = no_est_reason
 
         placement: list[int] = []
         shrunk_mesh = None
@@ -604,11 +743,18 @@ class FleetScheduler:
         # health view admission uses (explicit caller wiring wins).
         if self.fleet_fn is not None:
             sub.job_kwargs.setdefault("fleet_fn", self.fleet_fn)
-        if shrunk_mesh is not None and placement:
+        pin_needed = shrunk_mesh is not None or (
+            # An auto plan sized below the full fleet must not span the
+            # unhealthy remainder — pin it exactly like a shrunk admission.
+            sub.auto_place
+            and fleet is not None
+            and gang < len(fleet.devices)
+        )
+        if pin_needed and placement:
             devs = self._runtime_devices_for(placement)
             if devs is None:
                 sub.last_skip_reason = (
-                    f"elastic shrink to {gang} device(s) admissible, but the "
+                    f"admission at {gang} device(s) admissible, but the "
                     f"fleet indices {placement} do not map onto this "
                     "process's runtime devices"
                 )
@@ -620,14 +766,20 @@ class FleetScheduler:
         except Exception as e:  # noqa: BLE001 — constructor boundary
             sub.state = SubmissionState.FAILED
             sub.finished_at = time.time()
-            sub.last_skip_reason = f"job construction failed: {type(e).__name__}: {e}"
+            reason = f"job construction failed: {type(e).__name__}: {e}"
+            if no_est_reason:
+                reason = f"{no_est_reason}; {reason}"
+            sub.last_skip_reason = reason
             self.failed_total += 1
             return False
 
         sub.job = job
         sub.attempts += 1
         sub.state = SubmissionState.RUNNING
-        sub.last_skip_reason = None
+        # A capacity-only admission keeps its structured annotation (the
+        # queue surface should say WHY the HBM gate was skipped); every
+        # other stale skip reason clears on success.
+        sub.last_skip_reason = no_est_reason
         sub.placement = placement
         sub.admitted_gang = gang
         sub.shrunk_mesh = shrunk_mesh.model_dump() if shrunk_mesh is not None else None
@@ -645,6 +797,10 @@ class FleetScheduler:
                 self._reserved[idx] = (
                     self._reserved.get(idx, 0.0) + est.device_total_gib
                 )
+        if sub.auto_place:
+            self.auto_admissions_total += 1
+            if head is not None:
+                self.planner.note_chosen(head)
         if sub.first_admitted_at is None:
             sub.first_admitted_at = time.time()
             self._wait_samples.append(sub.wait_s or 0.0)
@@ -697,9 +853,10 @@ class FleetScheduler:
         # it could occupy after the requeue round-trip.
         from tpu_engine.tpu_manager import TPUHealthStatus
 
-        healthy = sum(
-            1 for d in fleet.devices if d.health_status != TPUHealthStatus.CRITICAL
-        )
+        healthy_devs = [
+            d for d in fleet.devices if d.health_status != TPUHealthStatus.CRITICAL
+        ]
+        healthy = len(healthy_devs)
         now = time.time()
         for sub in self._subs.values():
             if (
@@ -720,14 +877,25 @@ class FleetScheduler:
                 # cooldown, or a flap cadence under the window turns into a
                 # preempt/save/recompile storm.
                 continue
-            full = gang_size(sub.config, healthy)
-            if full <= healthy and full > sub.admitted_gang:
-                target = full
-            else:
-                plan = elastic_shrink_plan(sub.config, healthy, self.estimate_fn)
-                if plan is None or plan[1] <= sub.admitted_gang:
-                    continue
-                target = plan[1]
+            # Planner-driven target: the full configured gang when it fits,
+            # else the largest feasible INTERMEDIATE mesh of the elastic
+            # family — both HBM-gated against per-device headroom minus
+            # every OTHER job's reservation (this job's own chips free up
+            # on the requeue round-trip, so its reservation is dropped).
+            own = sub.estimate.device_total_gib if sub.estimate else 0.0
+            others_reserved = dict(self._reserved)
+            for idx in sub.placement:
+                left = others_reserved.get(idx, 0.0) - own
+                if left <= 1e-9:
+                    others_reserved.pop(idx, None)
+                else:
+                    others_reserved[idx] = left
+            target = self.planner.grow_target(
+                sub.config, healthy_devs, others_reserved, sub.admitted_gang,
+                estimate_fn=sub.estimate_fn or self.estimate_fn,
+            )
+            if target is None:
+                continue
             self.grow_backs_total += 1
             sub.state = SubmissionState.PREEMPTING
             sub.last_resize_at = now
@@ -851,6 +1019,9 @@ class FleetScheduler:
             "elastic_shrinks_total": self.elastic_shrinks_total,
             "grow_backs_total": self.grow_backs_total,
             "self_heal_requeues_total": self.self_heal_requeues_total,
+            "auto_admissions_total": self.auto_admissions_total,
+            "no_estimate_skips_total": self.no_estimate_skips_total,
+            "placement": self.planner.stats(),
             "running_shrunk": sum(
                 1
                 for s in self._subs.values()
