@@ -28,6 +28,7 @@ from backend.routers import (
     serving,
     topology,
     tpu,
+    tracing,
     training,
 )
 
@@ -73,6 +74,10 @@ async def root(request: web.Request) -> web.Response:
                 "real ICI topology introspection",
                 "jax.profiler trace capture, per-step wall-clock breakdown, "
                 "and structured JSONL metrics logs",
+                "fleet flight recorder: causally-linked lifecycle traces "
+                "(submit -> place -> admit -> compile -> step -> preempt -> "
+                "shrink -> resume -> grow-back) with step-time anomaly "
+                "attribution and Chrome-trace/Perfetto export",
                 "Prometheus /metrics exporting both telemetry planes",
                 "continuous-batching serving with SSE token streaming, "
                 "prompt-prefix KV reuse, int8 weights/KV, and speculative "
@@ -89,6 +94,7 @@ async def root(request: web.Request) -> web.Response:
                 "monitoring": "/api/v1/monitoring",
                 "topology": "/api/v1/topology",
                 "profile": "/api/v1/profile",
+                "trace": "/api/v1/trace",
                 "metrics": "/metrics",
                 "openapi": "/openapi.json",
                 "docs": "/docs",
@@ -125,6 +131,7 @@ def create_app() -> web.Application:
     monitoring.setup(app)
     topology.setup(app)
     profiling.setup(app)
+    tracing.setup(app)
     serving.setup(app)
     metrics.setup(app)
     app.router.add_get("/", root)

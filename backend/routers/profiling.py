@@ -22,7 +22,7 @@ from pydantic import BaseModel, Field
 from backend import state
 from backend.openapi import body
 from backend.http import ApiError, json_response, parse_body
-from tpu_engine.profiler import TraceSession
+from tpu_engine.profiler import TraceActiveError, TraceSession
 
 trace_session = TraceSession()
 
@@ -42,6 +42,12 @@ async def trace_start(request: web.Request) -> web.Response:
     log_dir = req.log_dir or tempfile.mkdtemp(prefix="tpu_trace_")
     try:
         info = trace_session.start(log_dir, duration_s=req.duration_s)
+    except TraceActiveError as e:
+        # Structured 409: the caller learns *which* capture holds the
+        # singleton (dir + age) instead of parsing an error string.
+        return web.json_response(
+            {"detail": str(e), "active": e.describe()}, status=409
+        )
     except RuntimeError as e:
         raise ApiError(409, str(e))
     return json_response(info)

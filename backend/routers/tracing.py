@@ -1,0 +1,63 @@
+"""Flight-recorder routes — the query/export surface for
+``tpu_engine/tracing.py`` (the reference has no tracing at all; its
+observability is JSON endpoints polled by hand — SURVEY.md §5):
+
+- ``GET /api/v1/trace``                  — recorder health + per-trace
+  summaries + spans/events, filterable by ``trace_id`` / ``kind`` /
+  ``limit``;
+- ``GET /api/v1/trace/{trace_id}.json``  — one trace as Chrome-trace /
+  Perfetto JSON (load in ``ui.perfetto.dev`` or ``chrome://tracing``).
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from backend.http import ApiError, json_response
+from tpu_engine import tracing
+
+
+def _int_query(request: web.Request, name: str, default: int) -> int:
+    raw = request.query.get(name)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise ApiError(400, f"query param '{name}' must be an integer")
+
+
+async def trace_query(request: web.Request) -> web.Response:
+    rec = tracing.get_recorder()
+    trace_id = request.query.get("trace_id")
+    kind = request.query.get("kind")
+    limit = _int_query(request, "limit", 200)
+    return json_response(
+        {
+            "stats": rec.stats(),
+            "traces": rec.traces(limit=_int_query(request, "traces_limit", 50)),
+            "spans": rec.spans(trace_id=trace_id, kind=kind, limit=limit),
+            "events": rec.events(trace_id=trace_id, kind=kind, limit=limit),
+        }
+    )
+
+
+async def trace_export(request: web.Request) -> web.Response:
+    rec = tracing.get_recorder()
+    trace_id = request.match_info["trace_id"]
+    if rec.trace_root(trace_id) is None and not rec.events(
+        trace_id=trace_id, limit=1
+    ):
+        raise ApiError(404, f"no recorded trace '{trace_id}'")
+    doc = rec.export_chrome_trace(trace_id=trace_id)
+    resp = json_response(doc)
+    # hint browsers to save rather than render the (potentially large) doc
+    resp.headers["Content-Disposition"] = (
+        f'attachment; filename="trace_{trace_id}.json"'
+    )
+    return resp
+
+
+def setup(app: web.Application, prefix: str = "/api/v1") -> None:
+    app.router.add_get(f"{prefix}/trace", trace_query)
+    app.router.add_get(f"{prefix}/trace/{{trace_id}}.json", trace_export)

@@ -19,7 +19,14 @@ what training does meanwhile. Reports per-fault MTTR (time from fault to
 the next useful step) and goodput (useful full-mesh step-seconds per
 wall-second); ``bench.py`` reuses :func:`run_trace` for its chaos line.
 
-Run: ``JAX_PLATFORMS=cpu python -m benchmarks.chaos [--seed N]``.
+With ``--trace-out PATH`` the self-heal run also records its lifecycle in
+a ``FlightRecorder`` on the virtual clock — each fault's
+detect → emergency-save → requeue → shrink-admit → resume (→ grow-back)
+chain as causally-linked spans under one job trace — and writes it as
+Chrome-trace/Perfetto JSON (load in ``ui.perfetto.dev``).
+
+Run: ``JAX_PLATFORMS=cpu python -m benchmarks.chaos [--seed N]
+[--trace-out /tmp/chaos_trace.json]``.
 """
 
 from __future__ import annotations
@@ -28,10 +35,12 @@ import argparse
 import json
 import os
 import sys
+from typing import Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tpu_engine.faults import FaultKind, FaultPlan  # noqa: E402
+from tpu_engine.tracing import FlightRecorder  # noqa: E402
 
 # Model: 8-chip gang, fsdp=2 inner axis — a shrunk mesh must keep the
 # model axis intact, so usable chips come in multiples of 2.
@@ -78,7 +87,11 @@ def _usable(healthy: int) -> int:
     return max(MIN_CHIPS, (healthy // MODEL_AXIS) * MODEL_AXIS)
 
 
-def simulate_self_heal(events: list[dict]) -> dict:
+def simulate_self_heal(
+    events: list[dict],
+    recorder: Optional[FlightRecorder] = None,
+    trace_id: Optional[str] = None,
+) -> dict:
     clock = 0.0
     healthy = N_CHIPS
     pending: list[float] = []  # clocks at which a failed chip becomes healthy
@@ -86,6 +99,17 @@ def simulate_self_heal(events: list[dict]) -> dict:
     grow_backs = 0
     degraded_s = 0.0
     i = 0
+    # Flight-recorder lane (virtual-clock timestamps — the recorder takes
+    # explicit t0/t1 everywhere for exactly this). Each fault's recovery
+    # chain links causally: detect -> emergency_save -> requeue ->
+    # shrink_admit -> resume; a later grow_back chains off the resume.
+    root = chain_tail = None
+    if recorder is not None:
+        trace_id = trace_id or recorder.new_trace_id()
+        root = recorder.start_span(
+            "job:chaos-self-heal", kind="job", trace_id=trace_id, t0=0.0,
+            attrs={"n_chips": N_CHIPS, "total_steps": TOTAL_STEPS},
+        )
     for step in range(1, TOTAL_STEPS + 1):
         # Grow back as soon as a chip has recovered: preempt-save-resume at
         # the larger mesh (the scheduler's _maybe_grow pass).
@@ -93,6 +117,13 @@ def simulate_self_heal(events: list[dict]) -> dict:
             pending.pop(0)
             healthy += 1
             if _usable(healthy) > _usable(healthy - 1):
+                if recorder is not None:
+                    recorder.record_span(
+                        "grow_back", kind="admission", trace_id=trace_id,
+                        parent=chain_tail or root, t0=clock,
+                        t1=clock + CKPT_SAVE_S + RESUME_OVERHEAD_S,
+                        attrs={"step": step, "mesh": _usable(healthy)},
+                    )
                 clock += CKPT_SAVE_S + RESUME_OVERHEAD_S
                 grow_backs += 1
         use = _usable(healthy)
@@ -101,6 +132,12 @@ def simulate_self_heal(events: list[dict]) -> dict:
         if use < N_CHIPS:
             degraded_s += step_t
         if step % CKPT_INTERVAL_STEPS == 0:
+            if recorder is not None:
+                recorder.record_span(
+                    "checkpoint_save", kind="checkpoint_save",
+                    trace_id=trace_id, parent=root, t0=clock,
+                    t1=clock + CKPT_SAVE_S, attrs={"step": step},
+                )
             clock += CKPT_SAVE_S
         if i < len(events) and step >= events[i]["step"]:
             ev = events[i]
@@ -109,11 +146,39 @@ def simulate_self_heal(events: list[dict]) -> dict:
             # Detection is the in-band health check on this very step;
             # emergency save persists `step`, shrink-resume follows.
             down = CKPT_SAVE_S + RESUME_OVERHEAD_S
+            if recorder is not None:
+                detect = recorder.record_span(
+                    "detect", kind="fault", trace_id=trace_id, parent=root,
+                    t0=clock, t1=clock,
+                    attrs={"step": step, "device": ev["device"]},
+                )
+                save = recorder.record_span(
+                    "emergency_save", kind="emergency_save",
+                    trace_id=trace_id, parent=detect, t0=clock,
+                    t1=clock + CKPT_SAVE_S, attrs={"step": step},
+                )
+                requeue = recorder.record_span(
+                    "requeue", kind="scheduler", trace_id=trace_id,
+                    parent=save, t0=clock + CKPT_SAVE_S,
+                    t1=clock + CKPT_SAVE_S, attrs={"step": step},
+                )
+                admit = recorder.record_span(
+                    "shrink_admit", kind="admission", trace_id=trace_id,
+                    parent=requeue, t0=clock + CKPT_SAVE_S, t1=clock + down,
+                    attrs={"step": step, "mesh": _usable(healthy)},
+                )
+                chain_tail = recorder.record_span(
+                    "resume", kind="supervisor", trace_id=trace_id,
+                    parent=admit, t0=clock + down, t1=clock + down,
+                    attrs={"from_step": step},
+                )
             clock += down
             mttrs.append(step_t + down)
             pending.append(clock + ev["recovery_s"])
             pending.sort()
     wall = clock
+    if root is not None:
+        root.end(t1=wall, faults=len(mttrs), grow_backs=grow_backs)
     return {
         "policy": "self-heal",
         "wall_s": round(wall, 1),
@@ -169,9 +234,13 @@ def simulate_die_and_restart(events: list[dict]) -> dict:
     }
 
 
-def run_trace(seed: int = 0, n_faults: int = 12) -> dict:
+def run_trace(
+    seed: int = 0,
+    n_faults: int = 12,
+    recorder: Optional[FlightRecorder] = None,
+) -> dict:
     events = chip_fault_trace(seed, n_faults=n_faults)
-    heal = simulate_self_heal(events)
+    heal = simulate_self_heal(events, recorder=recorder)
     die = simulate_die_and_restart(events)
     return {
         "seed": seed,
@@ -197,8 +266,21 @@ def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--faults", type=int, default=12)
+    parser.add_argument(
+        "--trace-out", default=None, metavar="PATH",
+        help="write the self-heal run as Chrome-trace/Perfetto JSON",
+    )
     args = parser.parse_args()
-    trace = run_trace(args.seed, n_faults=args.faults)
+    recorder = FlightRecorder() if args.trace_out else None
+    trace = run_trace(args.seed, n_faults=args.faults, recorder=recorder)
+    if recorder is not None:
+        doc = recorder.export_chrome_trace()
+        with open(args.trace_out, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        trace["trace_out"] = {
+            "path": args.trace_out,
+            "trace_events": len(doc["traceEvents"]),
+        }
     print(json.dumps(trace, indent=2))
     ok = (
         trace["self_heal"]["lost_steps"] == 0

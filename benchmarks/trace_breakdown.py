@@ -11,8 +11,15 @@ backend, present in the image), and prints:
   measured by the profiler itself;
 - device-busy vs host gap (device self-time vs wall step time).
 
-Run: ``python benchmarks/trace_breakdown.py``  (real TPU required)
+Run: ``python benchmarks/trace_breakdown.py``  (real TPU required for the
+xprof HLO split; ``--no-hlo --model gpt-tiny`` runs anywhere)
 Prints one JSON line per category plus a summary; paste into RESULTS.md.
+
+The capture itself is also recorded in the flight recorder
+(``tpu_engine/tracing.py``): build/compile, warmup and the profiled window
+become spans, and ``--perfetto-out PATH`` writes them as
+Chrome-trace/Perfetto JSON — a CPU-viable export that needs neither a TPU
+nor the xprof converter.
 """
 
 from __future__ import annotations
@@ -22,45 +29,73 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import argparse
 import glob
 import json
 import shutil
 import time
 from collections import defaultdict
+from typing import Optional
 
 import jax
 
+from tpu_engine.tracing import FlightRecorder
 
-def capture(logdir: str = "/tmp/tpu_engine_trace", steps: int = 3):
-    """Build the headline config, warm up, trace ``steps`` steps.
 
+def capture(
+    logdir: str = "/tmp/tpu_engine_trace",
+    steps: int = 3,
+    model: str = "llama-1b",
+    micro: int = 6,
+    seq: int = 2048,
+    mesh_axes: Optional[dict] = None,
+    recorder: Optional[FlightRecorder] = None,
+):
+    """Build ``model``, warm up, trace ``steps`` steps.
+
+    Defaults are the exact bench.py headline config (keep in lockstep).
+    ``mesh_axes`` defaults to the single-device ``{"data": 1}`` headline
+    layout; pass e.g. ``{"data": 8}`` on the 8-virtual-device CPU harness.
     Returns (wall seconds per step, xplane path).
     """
     from benchmarks.aot import build_program
     from tpu_engine.sharding import ShardingStage
 
-    # The exact bench.py headline config (keep in lockstep).
-    program = build_program(
-        "llama-1b", {"data": 1}, micro=6, seq=2048,
-        overrides={
-            "moment_dtype": "bf16", "activation_checkpointing": True,
-            "sharding_stage": ShardingStage.DISABLED,
-            "attention_impl": "auto", "precision": "bf16",
-        },
+    rec = recorder or FlightRecorder()
+    trace_id = rec.new_trace_id()
+    root = rec.start_span(
+        f"trace_breakdown:{model}", kind="job", trace_id=trace_id,
+        attrs={"model": model, "micro": micro, "seq": seq, "steps": steps},
     )
-    state = program.init(jax.random.PRNGKey(0))
+    with rec.start_span("compile", kind="compile", trace_id=trace_id,
+                        parent=root):
+        program = build_program(
+            model, mesh_axes or {"data": 1}, micro=micro, seq=seq,
+            overrides={
+                "moment_dtype": "bf16", "activation_checkpointing": True,
+                "sharding_stage": ShardingStage.DISABLED,
+                "attention_impl": "auto", "precision": "bf16",
+            },
+        )
+        state = program.init(jax.random.PRNGKey(0))
     batch = program.synthetic_batch(seed=0)
-    for _ in range(3):
-        state, m = program.step(state, batch)
-    float(m["loss"])  # sync
+    with rec.start_span("warmup", kind="step", trace_id=trace_id,
+                        parent=root):
+        for _ in range(3):
+            state, m = program.step(state, batch)
+        float(m["loss"])  # sync
 
     shutil.rmtree(logdir, ignore_errors=True)
+    cap_span = rec.start_span("profile_capture", kind="profile",
+                              trace_id=trace_id, parent=root)
     t0 = time.perf_counter()
     with jax.profiler.trace(logdir):
         for _ in range(steps):
             state, m = program.step(state, batch)
         float(m["loss"])
     wall = (time.perf_counter() - t0) / steps
+    cap_span.end(wall_s_per_step=round(wall, 4))
+    root.end()
     (xplane,) = glob.glob(os.path.join(logdir, "plugins/profile/*/*.xplane.pb"))
     return wall, xplane
 
@@ -100,19 +135,54 @@ def hlo_category_split(xplane: str) -> tuple[list[dict], float]:
 
 
 def main() -> None:
-    steps = 3
-    wall, xplane = capture(steps=steps)
-    rows, device_s = hlo_category_split(xplane)
-    device_per_step = device_s / steps
-    for r in rows:
-        if r["self_time_pct"] >= 0.3:
-            print(json.dumps(r))
-    print(json.dumps({
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--model", default="llama-1b")
+    parser.add_argument("--micro", type=int, default=6)
+    parser.add_argument("--seq", type=int, default=2048)
+    parser.add_argument("--steps", type=int, default=3)
+    parser.add_argument(
+        "--data", type=int, default=1,
+        help="data-axis mesh size (must equal the visible device count)",
+    )
+    parser.add_argument("--logdir", default="/tmp/tpu_engine_trace")
+    parser.add_argument(
+        "--no-hlo", action="store_true",
+        help="skip the xprof HLO-category split (CPU / no-xprof runs)",
+    )
+    parser.add_argument(
+        "--perfetto-out", default=None, metavar="PATH",
+        help="write the capture's flight-recorder spans as "
+        "Chrome-trace/Perfetto JSON",
+    )
+    args = parser.parse_args()
+    recorder = FlightRecorder()
+    wall, xplane = capture(
+        logdir=args.logdir, steps=args.steps, model=args.model,
+        micro=args.micro, seq=args.seq, mesh_axes={"data": args.data},
+        recorder=recorder,
+    )
+    summary = {
         "summary": True,
+        "model": args.model,
         "wall_ms_per_step": round(wall * 1e3, 1),
-        "device_ms_per_step": round(device_per_step * 1e3, 1),
-        "device_busy_pct": round(100 * device_per_step / wall, 1),
-    }))
+    }
+    if not args.no_hlo:
+        rows, device_s = hlo_category_split(xplane)
+        device_per_step = device_s / args.steps
+        for r in rows:
+            if r["self_time_pct"] >= 0.3:
+                print(json.dumps(r))
+        summary["device_ms_per_step"] = round(device_per_step * 1e3, 1)
+        summary["device_busy_pct"] = round(100 * device_per_step / wall, 1)
+    if args.perfetto_out:
+        doc = recorder.export_chrome_trace()
+        with open(args.perfetto_out, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        summary["perfetto_out"] = {
+            "path": args.perfetto_out,
+            "trace_events": len(doc["traceEvents"]),
+        }
+    print(json.dumps(summary))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,131 @@
+"""Prometheus exposition lint: scrape ``/metrics`` and validate text-format
+conformance (version 0.0.4) for every exported ``tpu_engine_*`` family —
+HELP/TYPE pairing and ordering, no duplicate families, valid sample syntax,
+escaped label values, counter naming. Pure-python: the renderer is
+hand-rolled (no client library in the image), so nothing else checks that
+a new family added to ``backend/routers/metrics.py`` actually parses."""
+
+import asyncio
+import re
+import threading
+
+import httpx
+import pytest
+from aiohttp import web
+
+from backend.main import create_app
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?P<labels>\{[^{}]*\})?"
+    r" (?P<value>[-+]?(?:[0-9]*\.)?[0-9]+(?:[eE][-+]?[0-9]+)?|NaN|[+-]?Inf)$"
+)
+# One label pair: name="value" with only escaped \, " and newline inside.
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\[\\"n])*)"')
+
+
+@pytest.fixture(scope="module")
+def client():
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    state = {}
+
+    def run():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(create_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        loop.run_until_complete(site.start())
+        state["port"] = runner.addresses[0][1]
+        started.set()
+        loop.run_forever()
+        loop.run_until_complete(runner.cleanup())
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(timeout=30)
+    with httpx.Client(base_url=f"http://127.0.0.1:{state['port']}", timeout=60) as c:
+        yield c
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=10)
+
+
+def _scrape(client) -> str:
+    r = client.get("/metrics")
+    assert r.status_code == 200
+    assert "version=0.0.4" in r.headers["Content-Type"]
+    return r.text
+
+
+def test_exposition_format_conformance(client):
+    text = _scrape(client)
+    helped, typed = {}, {}
+    current_family = None
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line:
+            continue
+        loc = f"line {lineno}: {line!r}"
+        if line.startswith("# HELP "):
+            parts = line.split(" ", 3)
+            assert len(parts) == 4 and parts[3].strip(), f"empty HELP — {loc}"
+            family = parts[2]
+            assert family not in helped, f"duplicate HELP for {family} — {loc}"
+            helped[family] = True
+            current_family = family
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            assert len(parts) == 4, loc
+            family, mtype = parts[2], parts[3]
+            assert mtype in ("gauge", "counter"), loc
+            assert family not in typed, f"duplicate TYPE for {family} — {loc}"
+            # TYPE must directly follow this family's HELP (grouped output).
+            assert family == current_family, f"TYPE without HELP — {loc}"
+            typed[family] = mtype
+            continue
+        assert not line.startswith("#"), f"unknown comment — {loc}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample — {loc}"
+        name = m.group("name")
+        # Samples are grouped under their family's HELP/TYPE header.
+        assert name == current_family, (
+            f"sample {name} outside its family block ({current_family}) — {loc}"
+        )
+        labels = m.group("labels")
+        if labels:
+            inner = labels[1:-1]
+            # Consuming every pair proves no unescaped quote slipped through.
+            consumed = ",".join(
+                f'{k}="{v}"' for k, v in _LABEL_RE.findall(inner)
+            )
+            assert consumed == inner, f"label escaping broken — {loc}"
+        float(m.group("value"))  # parses as a number
+    assert helped, "no families exported"
+    # Every family has BOTH a HELP and a TYPE, and only the repo prefix.
+    assert set(helped) == set(typed)
+    for family in helped:
+        assert family.startswith("tpu_engine_"), family
+
+
+def test_counter_families_follow_naming_convention(client):
+    text = _scrape(client)
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            _, _, family, mtype = line.split(" ")
+            if mtype == "counter":
+                assert family.endswith("_total"), (
+                    f"counter {family} must end in _total"
+                )
+
+
+def test_trace_families_always_present(client):
+    """The flight-recorder health plane exports even when idle — an
+    alerting rule on drops must never go 'no data'."""
+    text = _scrape(client)
+    for family in (
+        "tpu_engine_trace_spans_dropped_total",
+        "tpu_engine_trace_events_dropped_total",
+        "tpu_engine_trace_open_spans",
+        "tpu_engine_trace_traces_total",
+    ):
+        assert re.search(rf"^{family} ", text, re.M), family

@@ -1,0 +1,113 @@
+"""Flight-recorder HTTP surface: the filterable span query, the
+Chrome-trace export, and the structured 409 the profiling trace-start
+returns when a capture is already active."""
+
+import asyncio
+import threading
+
+import httpx
+import pytest
+from aiohttp import web
+
+from backend.main import create_app
+from tpu_engine import tracing
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _fresh_recorder():
+    """Serve a fresh recorder: earlier suites leave wall-clock traces on the
+    process-wide one, which would push this module's virtual-timestamped
+    seeds (t0=100.0) out of the newest-first ``traces()`` listing."""
+    prev = tracing.get_recorder()
+    tracing.set_recorder(tracing.FlightRecorder())
+    yield
+    tracing.set_recorder(prev)
+
+
+@pytest.fixture(scope="module")
+def client():
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    state = {}
+
+    def run():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(create_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        loop.run_until_complete(site.start())
+        state["port"] = runner.addresses[0][1]
+        started.set()
+        loop.run_forever()
+        loop.run_until_complete(runner.cleanup())
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(timeout=30)
+    with httpx.Client(base_url=f"http://127.0.0.1:{state['port']}", timeout=60) as c:
+        yield c
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=10)
+
+
+def _seed_trace():
+    """Record a small causal chain on the process recorder the app serves."""
+    rec = tracing.get_recorder()
+    root = rec.start_span("job:endpoint-test", kind="job", t0=100.0)
+    child = rec.start_span("attempt", kind="attempt", parent=root, t0=101.0)
+    child.end(t1=102.0)
+    root.end(t1=103.0)
+    rec.event("requeue", kind="scheduler", trace_id=root.trace_id, ts=101.5)
+    return root.trace_id
+
+
+def test_trace_query_endpoint(client):
+    tid = _seed_trace()
+    r = client.get("/api/v1/trace")
+    assert r.status_code == 200
+    body = r.json()
+    assert {"stats", "traces", "spans", "events"} <= set(body)
+    assert body["stats"]["spans_total"] >= 2
+    assert any(t["trace_id"] == tid for t in body["traces"])
+    # Filters narrow to one trace / one kind.
+    f = client.get("/api/v1/trace", params={"trace_id": tid, "kind": "attempt"})
+    spans = f.json()["spans"]
+    assert len(spans) == 1 and spans[0]["name"] == "attempt"
+    assert all(e["trace_id"] == tid for e in f.json()["events"])
+    # Bad limit → 400, not a 500.
+    assert client.get("/api/v1/trace", params={"limit": "x"}).status_code == 400
+
+
+def test_trace_export_endpoint(client):
+    tid = _seed_trace()
+    r = client.get(f"/api/v1/trace/{tid}.json")
+    assert r.status_code == 200
+    assert "attachment" in r.headers.get("Content-Disposition", "")
+    doc = r.json()
+    evs = doc["traceEvents"]
+    assert evs and all("ph" in e and "ts" in e and "pid" in e for e in evs)
+    body = [e["ts"] for e in evs if e["ph"] != "M"]
+    assert body == sorted(body)
+    assert doc["otherData"]["trace_id"] == tid
+    # Unknown trace → 404 with a detail body.
+    miss = client.get("/api/v1/trace/nope.json")
+    assert miss.status_code == 404 and "detail" in miss.json()
+
+
+def test_trace_start_conflict_is_structured(client, tmp_path_factory):
+    """Double-start returns 409 with the holder's dir and age, not a bare
+    string — the caller can decide to wait, stop, or pick another box."""
+    log_dir = str(tmp_path_factory.mktemp("trace"))
+    r = client.post("/api/v1/profile/trace/start", json={"log_dir": log_dir})
+    assert r.status_code == 200
+    try:
+        dup = client.post("/api/v1/profile/trace/start", json={})
+        assert dup.status_code == 409
+        body = dup.json()
+        assert "trace already active" in body["detail"]
+        active = body["active"]
+        assert active["log_dir"] == log_dir
+        assert active["started_at"] > 0
+        assert active["elapsed_s"] >= 0
+    finally:
+        assert client.post("/api/v1/profile/trace/stop").status_code == 200

@@ -29,6 +29,8 @@ from typing import Any, Optional
 import jax
 import orbax.checkpoint as ocp
 
+from tpu_engine import tracing
+
 _STABLE_POINTER = "stable.json"
 
 
@@ -57,11 +59,16 @@ class TrainCheckpointManager:
         save_interval_steps: int = 1,
         async_save: bool = True,
         fault_injector: Optional[Any] = None,
+        trace_id: Optional[str] = None,
     ):
         # Explicit injector wins; otherwise the process-active one (if armed)
         # is consulted per call, so tests/chaos runs can arm faults after
         # construction. None armed → the seams are single-attribute no-ops.
         self._fault_injector = fault_injector
+        # Flight-recorder trace this manager's saves/restores annotate
+        # (settable after construction — the supervisor binds it once the
+        # attempt's trace is known). None = untraced standalone use.
+        self.trace_id = trace_id
         self.directory = resolve_checkpoint_dir(directory)
         # Remote schemes (gs://, s3://): Orbax/tensorstore own directory
         # creation (``create=True`` below); a local mkdir on the mangled
@@ -98,22 +105,44 @@ class TrainCheckpointManager:
         wait: bool = False,
     ) -> bool:
         """Async save (sync when ``wait=True`` — the preemption path)."""
-        with self._lock:
-            inj = self._injector()
-            if inj is not None and inj.take_save_fault(step):
-                raise OSError(f"injected fault: checkpoint-save-ioerror at step {step}")
-            try:
-                saved = self._mgr.save(
-                    step,
-                    args=ocp.args.StandardSave(state),
-                    metrics=metrics,
-                    force=force,
+        t0 = time.time()
+        outcome = "saved"
+        try:
+            with self._lock:
+                inj = self._injector()
+                if inj is not None and inj.take_save_fault(step):
+                    raise OSError(
+                        f"injected fault: checkpoint-save-ioerror at step {step}"
+                    )
+                try:
+                    saved = self._mgr.save(
+                        step,
+                        args=ocp.args.StandardSave(state),
+                        metrics=metrics,
+                        force=force,
+                    )
+                except ocp.checkpoint_manager.StepAlreadyExistsError:
+                    saved = False
+                if wait:
+                    self._mgr.wait_until_finished()
+                if not saved:
+                    outcome = "skipped"
+                return bool(saved)
+        except Exception as e:
+            outcome = f"error: {type(e).__name__}"
+            raise
+        finally:
+            if self.trace_id is not None:
+                tracing.get_recorder().record_span(
+                    "checkpoint_save",
+                    kind="checkpoint_save",
+                    trace_id=self.trace_id,
+                    t0=t0,
+                    attrs={
+                        "step": step, "wait": wait, "force": force,
+                        "outcome": outcome,
+                    },
                 )
-            except ocp.checkpoint_manager.StepAlreadyExistsError:
-                saved = False
-            if wait:
-                self._mgr.wait_until_finished()
-            return bool(saved)
 
     def save_with_retry(
         self,
@@ -250,22 +279,46 @@ class TrainCheckpointManager:
             candidates = [step]
         else:
             candidates = list(reversed(self.all_steps()))
-        for s in candidates:
-            try:
-                # Injected corruption raises INSIDE the try so it rides the
-                # exact quarantine-and-fall-back path real corruption takes.
-                inj = self._injector()
-                if inj is not None and inj.take_restore_fault(s):
-                    raise OSError(
-                        f"injected fault: checkpoint-restore-corruption at step {s}"
+        t0 = time.time()
+        quarantined: list[int] = []
+        try:
+            for s in candidates:
+                try:
+                    # Injected corruption raises INSIDE the try so it rides the
+                    # exact quarantine-and-fall-back path real corruption takes.
+                    inj = self._injector()
+                    if inj is not None and inj.take_restore_fault(s):
+                        raise OSError(
+                            f"injected fault: checkpoint-restore-corruption at step {s}"
+                        )
+                    state = self._mgr.restore(
+                        s, args=ocp.args.StandardRestore(abstract_state)
                     )
-                state = self._mgr.restore(s, args=ocp.args.StandardRestore(abstract_state))
-                return s, state
-            except Exception:
-                self._quarantined.add(s)
-                if not fall_back:
-                    raise
-        return None, None
+                    self._trace_restore(t0, s, quarantined)
+                    return s, state
+                except Exception:
+                    self._quarantined.add(s)
+                    quarantined.append(s)
+                    if not fall_back:
+                        raise
+            self._trace_restore(t0, None, quarantined)
+            return None, None
+        except Exception:
+            self._trace_restore(t0, None, quarantined)
+            raise
+
+    def _trace_restore(
+        self, t0: float, step: Optional[int], quarantined: list[int]
+    ) -> None:
+        if self.trace_id is None:
+            return
+        tracing.get_recorder().record_span(
+            "checkpoint_restore",
+            kind="checkpoint_restore",
+            trace_id=self.trace_id,
+            t0=t0,
+            attrs={"step": step, "quarantined": list(quarantined)},
+        )
 
     def restore_stable(self, abstract_state: Any, before_step: Optional[int] = None):
         """Restore the last *stable* checkpoint (optionally strictly before a step)."""

@@ -35,6 +35,8 @@ from typing import Optional
 
 from pydantic import BaseModel, Field, model_validator
 
+from tpu_engine import tracing
+
 
 class FaultKind(str, enum.Enum):
     """The six injectable fault types (ISSUE archetype: robustness)."""
@@ -161,6 +163,10 @@ class FaultInjector:
         self._seq = 0
         self.events: list[FaultEvent] = []
         self.counters: dict[str, int] = {}
+        # Monotonic count of events evicted from the bounded log. The log
+        # used to truncate silently at MAX_EVENTS — a consumer paging the
+        # event list had no way to tell "quiet period" from "lost history".
+        self.events_dropped = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -310,7 +316,18 @@ class FaultInjector:
             )
         )
         if len(self.events) > self.MAX_EVENTS:
-            del self.events[: len(self.events) - self.MAX_EVENTS]
+            drop = len(self.events) - self.MAX_EVENTS
+            self.events_dropped += drop
+            del self.events[:drop]
+        # Mirror onto the shared flight-recorder timeline so fault history
+        # lines up with job/serving spans instead of living in an island
+        # log. The recorder has its own lock and never calls back in here.
+        tracing.get_recorder().event(
+            kind,
+            kind="fault",
+            trace_id="fleet",
+            attrs={"step": step, "device_index": device_index, "detail": detail},
+        )
 
     def record(
         self,
@@ -332,6 +349,7 @@ class FaultInjector:
                 "specs": [s.model_dump(mode="json") for s in self.plan.specs],
                 "active_chip_faults": {},  # filled below without the lock
                 "counters": dict(self.counters),
+                "events_dropped": self.events_dropped,
                 "events": [e.model_dump() for e in self.events[-50:]],
             }
 

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Any, Callable, Iterable, Optional
 
 import jax.numpy as jnp
@@ -120,6 +121,7 @@ class PlannerResult(BaseModel):
     pruned: list[dict[str, str]]  # invalid layouts: {"layout", "reason"}
     evaluated: int
     skip_reason: Optional[str] = None  # e.g. "no_estimate:<model>"
+    search_s: float = 0.0  # wall seconds the enumerate+rank pass took
 
     @property
     def best(self) -> Optional[PlacementPlan]:
@@ -600,6 +602,7 @@ class PlacementPlanner:
         available") — predicted-fastest wins, which naturally prefers the
         largest gang unless its layouts are HBM-infeasible.
         """
+        t_search0 = time.time()
         if config.model_name not in tfm.MODEL_CONFIGS:
             with self._lock:
                 self.no_estimate_refusals_total += 1
@@ -661,7 +664,7 @@ class PlacementPlanner:
             self.last_feasible = len(feasible)
         return PlannerResult(
             plans=feasible, infeasible=infeasible, pruned=pruned,
-            evaluated=evaluated,
+            evaluated=evaluated, search_s=time.time() - t_search0,
         )
 
     def _candidate_gangs(self, n_avail: int) -> list[int]:

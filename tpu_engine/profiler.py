@@ -218,6 +218,24 @@ class StepProfiler:
         return out
 
 
+class TraceActiveError(RuntimeError):
+    """Raised on double-start; carries the active capture's coordinates so
+    callers (the ``/api/v1/profile/start`` route, the anomaly auto-trace
+    hook) can report a structured conflict instead of a bare string."""
+
+    def __init__(self, log_dir: str, started_at: float):
+        self.log_dir = log_dir
+        self.started_at = started_at
+        super().__init__(f"trace already active (dir={log_dir})")
+
+    def describe(self) -> dict[str, Any]:
+        return {
+            "log_dir": self.log_dir,
+            "started_at": self.started_at,
+            "elapsed_s": round(time.time() - self.started_at, 3),
+        }
+
+
 class TraceSession:
     """On-demand ``jax.profiler`` trace capture (one at a time per process).
 
@@ -239,7 +257,9 @@ class TraceSession:
     def start(self, log_dir: str, duration_s: Optional[float] = None) -> dict[str, Any]:
         with self._lock:
             if self._active_dir is not None:
-                raise RuntimeError(f"trace already active (dir={self._active_dir})")
+                raise TraceActiveError(
+                    self._active_dir, self._started_at or time.time()
+                )
             jax.profiler.start_trace(log_dir)
             self._active_dir = log_dir
             self._started_at = time.time()

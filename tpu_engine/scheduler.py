@@ -41,6 +41,7 @@ from typing import Any, Callable, Optional
 
 import jax
 
+from tpu_engine import tracing
 from tpu_engine.hbm_estimate import (
     HBMEstimate,
     elastic_shrink_plan,
@@ -151,6 +152,28 @@ class Submission:
         self.auto_place = False
         self.placement_plan: Optional[dict[str, Any]] = None
         self.predicted_step_time_s: Optional[float] = None
+        # Flight-recorder identity: ONE trace per submission for its whole
+        # lifetime — every attempt, requeue, shrink and grow-back chains
+        # under this root span (closed at the terminal state).
+        rec = tracing.get_recorder()
+        self.trace_id = rec.new_trace_id()
+        self._root_span = rec.start_span(
+            f"job:{self.job_id}",
+            kind="job",
+            trace_id=self.trace_id,
+            attrs={
+                "submission_id": self.submission_id,
+                "model": config.model_name,
+                "priority": priority.name.lower(),
+                "submitter": submitter,
+                "workload": workload,
+            },
+        )
+
+    def finish_trace(self, state: str) -> None:
+        """Close the lifecycle root span (idempotent)."""
+        if self._root_span is not None and self._root_span.t1 is None:
+            self._root_span.end(state=state)
 
     @property
     def preemptible(self) -> bool:
@@ -188,6 +211,7 @@ class Submission:
             "finished_at": self.finished_at,
             "wait_s": self.wait_s,
             "last_skip_reason": self.last_skip_reason,
+            "trace_id": self.trace_id,
             "hbm_estimate": self.estimate.model_dump() if self.estimate else None,
             "placement": self.placement,
             "shrunk_mesh": self.shrunk_mesh,
@@ -366,6 +390,18 @@ class FleetScheduler:
             sub.auto_place = auto_place
             self._subs[sub.submission_id] = sub
             self.submitted_total += 1
+        tracing.get_recorder().event(
+            "submit",
+            kind="scheduler",
+            trace_id=sub.trace_id,
+            parent=sub._root_span,
+            attrs={
+                "priority": priority.name.lower(),
+                "submitter": submitter,
+                "mesh": "auto" if auto_place else "explicit",
+                "workload": workload,
+            },
+        )
         self._ensure_thread()
         self._wake.set()
         return sub
@@ -398,6 +434,7 @@ class FleetScheduler:
                 sub.state = SubmissionState.CANCELLED
                 sub.finished_at = time.time()
                 self.cancelled_total += 1
+                sub.finish_trace("cancelled")
                 return True
             sub.state = SubmissionState.CANCELLING
             if sub.job is not None:
@@ -518,6 +555,17 @@ class FleetScheduler:
                 self.requeues_total += 1
                 if str(getattr(job, "preemption_reason", "") or "").startswith("self-heal"):
                     self.self_heal_requeues_total += 1
+                tracing.get_recorder().event(
+                    "requeue",
+                    kind="scheduler",
+                    trace_id=sub.trace_id,
+                    parent=sub._root_span,
+                    attrs={
+                        "step": job.current_step,
+                        "reason": getattr(job, "preemption_reason", None),
+                        "preemptions": sub.preemptions,
+                    },
+                )
                 log.info(
                     "scheduler: %s preempted at step %s — requeued",
                     sub.submission_id, job.current_step,
@@ -545,6 +593,21 @@ class FleetScheduler:
                 else:
                     sub.state = SubmissionState.FAILED
                     self.failed_total += 1
+                sub.finish_trace(sub.state.value)
+
+    def _note_skip(self, sub: Submission, reason: str) -> None:
+        """Set the structured skip reason; a CHANGED reason is mirrored to
+        the flight recorder (recording every 0.1 s poll pass of the same
+        refusal would flood the bounded buffer with no information)."""
+        if reason != sub.last_skip_reason:
+            tracing.get_recorder().event(
+                "admission_skip",
+                kind="scheduler",
+                trace_id=sub.trace_id,
+                parent=sub._root_span,
+                attrs={"reason": reason},
+            )
+        sub.last_skip_reason = reason
 
     def _fleet(self) -> Optional[TPUFleetStatus]:
         if self.fleet_fn is None:
@@ -566,7 +629,7 @@ class FleetScheduler:
         for rank, sub in enumerate(queued[: max(self.backfill_depth, 1)]):
             if slots <= 0:
                 if rank == 0:
-                    sub.last_skip_reason = "at max_concurrent_jobs capacity"
+                    self._note_skip(sub, "at max_concurrent_jobs capacity")
                     # Eviction frees a slot and HBM — but never heals a
                     # chip. A head whose gang exceeds the healthy fleet
                     # must not thrash victims it can never replace.
@@ -622,15 +685,17 @@ class FleetScheduler:
                 n_avail=n_avail,
             )
         if result.skip_reason:  # no_estimate:<model>
-            sub.last_skip_reason = result.skip_reason
+            self._note_skip(sub, result.skip_reason)
             return None
         head = result.best
         if head is None:
             reasons = sorted(
                 {p.skip_reason for p in result.infeasible if p.skip_reason}
             )
-            sub.last_skip_reason = "auto-placement: no feasible layout" + (
-                f" — {reasons[0]}" if reasons else ""
+            self._note_skip(
+                sub,
+                "auto-placement: no feasible layout"
+                + (f" — {reasons[0]}" if reasons else ""),
             )
             return None
         # Plans that predicted faster than the choice but were unplaceable
@@ -652,12 +717,14 @@ class FleetScheduler:
                 {"layout": p.label, "reason": p.skip_reason}
                 for p in passed_over[:3]
             ],
+            "search_s": round(result.search_s, 6),
         }
         sub.predicted_step_time_s = head.predicted_step_time_s
         sub.config = head.config
         return head
 
     def _try_admit(self, sub: Submission, fleet: Optional[TPUFleetStatus]) -> bool:
+        t_admit0 = time.time()
         eligible = None
         if fleet is not None and fleet.devices:
             eligible = [d for d in fleet.devices if d.is_available]
@@ -667,9 +734,27 @@ class FleetScheduler:
         no_est_reason = None
         head = None
         if sub.auto_place:
+            t_plan0 = time.time()
             head = self._plan_auto(sub, eligible, n_avail)
             if head is None:
                 return False
+            # Recorded only for the CHOSEN plan — a queued-but-infeasible
+            # auto submission re-plans every poll pass and would flood.
+            tracing.get_recorder().record_span(
+                "placement_plan",
+                kind="placement_plan",
+                trace_id=sub.trace_id,
+                parent=sub._root_span,
+                t0=t_plan0,
+                attrs={
+                    "label": (sub.placement_plan or {}).get("label"),
+                    "evaluated": (sub.placement_plan or {}).get("evaluated"),
+                    "feasible": (sub.placement_plan or {}).get("feasible"),
+                    "pruned": (sub.placement_plan or {}).get("pruned"),
+                    "search_s": (sub.placement_plan or {}).get("search_s"),
+                    "predicted_step_time_s": sub.predicted_step_time_s,
+                },
+            )
             gang, est = head.gang, head.hbm_estimate
             sub.estimate = est
         else:
@@ -703,8 +788,9 @@ class FleetScheduler:
                 # paper's keep-training-on-a-degraded-fleet behavior.
                 shrink = elastic_shrink_plan(sub.config, len(eligible), estimate_fn)
                 if shrink is None:
-                    sub.last_skip_reason = (
-                        f"gang of {gang} device(s) > {len(eligible)} healthy chip(s)"
+                    self._note_skip(
+                        sub,
+                        f"gang of {gang} device(s) > {len(eligible)} healthy chip(s)",
                     )
                     return False
                 shrunk_mesh, gang, est = shrink
@@ -721,9 +807,10 @@ class FleetScheduler:
                     if d.hbm_free_gb - self._reserved.get(d.index, 0.0) >= need
                 ]
                 if gang > len(fits):
-                    sub.last_skip_reason = (
+                    self._note_skip(
+                        sub,
                         f"needs {need:.2f} GiB/device on {gang} chip(s); only "
-                        f"{len(fits)} have that headroom"
+                        f"{len(fits)} have that headroom",
                     )
                     return False
                 # Most-headroom-first keeps the fleet balanced.
@@ -739,6 +826,9 @@ class FleetScheduler:
         # devices, unhealthy one included. The factory receives the pin via
         # job_kwargs (stub factories that ignore kwargs are unaffected).
         sub.job_kwargs.pop("devices", None)
+        # The attempt joins the submission's trace: every compile/save/
+        # recovery span it records chains under this root.
+        sub.job_kwargs["trace_id"] = sub.trace_id
         # Self-healing detection: the supervisor watches the same fleet
         # health view admission uses (explicit caller wiring wins).
         if self.fleet_fn is not None:
@@ -753,10 +843,11 @@ class FleetScheduler:
         if pin_needed and placement:
             devs = self._runtime_devices_for(placement)
             if devs is None:
-                sub.last_skip_reason = (
+                self._note_skip(
+                    sub,
                     f"admission at {gang} device(s) admissible, but the "
                     f"fleet indices {placement} do not map onto this "
-                    "process's runtime devices"
+                    "process's runtime devices",
                 )
                 return False
             sub.job_kwargs["devices"] = devs
@@ -771,6 +862,7 @@ class FleetScheduler:
                 reason = f"{no_est_reason}; {reason}"
             sub.last_skip_reason = reason
             self.failed_total += 1
+            sub.finish_trace("failed")
             return False
 
         sub.job = job
@@ -784,7 +876,30 @@ class FleetScheduler:
         sub.admitted_gang = gang
         sub.shrunk_mesh = shrunk_mesh.model_dump() if shrunk_mesh is not None else None
         sub.last_admitted_at = time.time()
+        rec = tracing.get_recorder()
+        rec.record_span(
+            "admission",
+            kind="admission",
+            trace_id=sub.trace_id,
+            parent=sub._root_span,
+            t0=t_admit0,
+            t1=sub.last_admitted_at,
+            attrs={
+                "attempt": sub.attempts,
+                "gang": gang,
+                "placement": list(placement),
+                "shrunk_mesh": sub.shrunk_mesh,
+                "auto_place": sub.auto_place,
+            },
+        )
         if shrunk_mesh is not None:
+            rec.event(
+                "shrink_admit",
+                kind="scheduler",
+                trace_id=sub.trace_id,
+                parent=sub._root_span,
+                attrs={"mesh": sub.shrunk_mesh, "gang": gang},
+            )
             sub.last_resize_at = sub.last_admitted_at
             self.elastic_shrinks_total += 1
             log.warning(
@@ -900,6 +1015,17 @@ class FleetScheduler:
             sub.state = SubmissionState.PREEMPTING
             sub.last_resize_at = now
             self.preemptions_total += 1
+            tracing.get_recorder().event(
+                "grow_back",
+                kind="scheduler",
+                trace_id=sub.trace_id,
+                parent=sub._root_span,
+                attrs={
+                    "healthy": healthy,
+                    "target_gang": target,
+                    "current_gang": sub.admitted_gang,
+                },
+            )
             log.info(
                 "scheduler: growing %s back — %d healthy chip(s) now admit "
                 "gang %d (> current %d); checkpoint-requeue to resize",
@@ -927,6 +1053,24 @@ class FleetScheduler:
         victim = victims[0]
         victim.state = SubmissionState.PREEMPTING
         self.preemptions_total += 1
+        rec = tracing.get_recorder()
+        rec.event(
+            "preempt_victim",
+            kind="scheduler",
+            trace_id=victim.trace_id,
+            parent=victim._root_span,
+            attrs={"for": head.submission_id, "head_trace_id": head.trace_id},
+        )
+        rec.event(
+            "preempt_requested",
+            kind="scheduler",
+            trace_id=head.trace_id,
+            parent=head._root_span,
+            attrs={
+                "victim": victim.submission_id,
+                "victim_trace_id": victim.trace_id,
+            },
+        )
         log.warning(
             "scheduler: preempting %s (priority %s) for %s (priority %s)",
             victim.submission_id, victim.priority.name,

@@ -49,6 +49,7 @@ from typing import Any, Callable, Optional
 
 from pydantic import BaseModel, ConfigDict, Field
 
+from tpu_engine import tracing
 from tpu_engine.hbm_estimate import HBMEstimate, estimate_serving_hbm
 from tpu_engine.mesh_runtime import MeshConfig
 from tpu_engine.scheduler import (
@@ -539,6 +540,18 @@ class ServingFleet:
         self.scale_ups_total = 0
         self.scale_downs_total = 0
 
+        # Fleet-level flight-recorder lane: replica submissions and
+        # autoscaler decisions annotate this trace; each request gets its
+        # own trace (enqueue → route → completion) linked back to it.
+        rec = tracing.get_recorder()
+        self.trace_id = rec.new_trace_id()
+        self._fleet_span = rec.start_span(
+            f"serving_fleet:{spec.model_name}",
+            kind="serving_fleet",
+            trace_id=self.trace_id,
+            attrs={"model": spec.model_name, "submitter": submitter},
+        )
+
     # -- replica lifecycle ---------------------------------------------------
 
     def start(self) -> None:
@@ -549,6 +562,8 @@ class ServingFleet:
             for sid in list(self._replicas):
                 self.scheduler.cancel(sid)
             self.desired_replicas = 0
+        if self._fleet_span.t1 is None:
+            self._fleet_span.end(stopped=True)
 
     def _submit_replica(self) -> Submission:
         spec = self.spec
@@ -563,6 +578,16 @@ class ServingFleet:
             ),
         )
         self._replicas[sub.submission_id] = sub
+        tracing.get_recorder().event(
+            "replica_submit",
+            kind="serving",
+            trace_id=self.trace_id,
+            parent=self._fleet_span,
+            attrs={
+                "submission_id": sub.submission_id,
+                "replica_trace_id": sub.trace_id,
+            },
+        )
         return sub
 
     def scale_to(self, n: int) -> int:
@@ -624,6 +649,16 @@ class ServingFleet:
             self._req_seq += 1
             fid = f"req_{self._req_seq}"
             self.requests_total += 1
+            rec = tracing.get_recorder()
+            span = rec.start_span(
+                f"request:{fid}",
+                kind="serving_request",
+                attrs={
+                    "fleet_trace_id": self.trace_id,
+                    "prompt_tokens": len(prompt),
+                    "max_new_tokens": int(max_new_tokens),
+                },
+            )
             self._requests[fid] = {
                 "submitted_at": time.time(),
                 "prompt": list(prompt),
@@ -632,7 +667,13 @@ class ServingFleet:
                 "replica": None,
                 "engine_rid": None,
                 "done": False,
+                "trace_id": span.trace_id,
+                "_span": span,
             }
+            rec.event(
+                "enqueue", kind="serving", trace_id=span.trace_id, parent=span,
+                attrs={"fid": fid},
+            )
             self._pending.append((fid, self._requests[fid]))
             self._flush_pending()
             return fid
@@ -661,6 +702,13 @@ class ServingFleet:
                 still.append((fid, req))
                 continue
             req["replica"], req["engine_rid"] = sid, rid
+            tracing.get_recorder().event(
+                "route",
+                kind="serving",
+                trace_id=req.get("trace_id"),
+                parent=req.get("_span"),
+                attrs={"fid": fid, "replica": sid, "engine_rid": rid},
+            )
         self._pending.extend(still)
 
     @staticmethod
@@ -694,6 +742,13 @@ class ServingFleet:
                 if not req["done"]:
                     req["replica"] = req["engine_rid"] = None
                     self._pending.append((fid, req))
+                    tracing.get_recorder().event(
+                        "redispatch",
+                        kind="serving",
+                        trace_id=req.get("trace_id"),
+                        parent=req.get("_span"),
+                        attrs={"fid": fid, "reason": "replica lost"},
+                    )
                     return {"id": fid, "status": "pending", "replica": None}
                 return {"id": fid, "status": "done", "replica": req["replica"]}
             try:
@@ -701,6 +756,13 @@ class ServingFleet:
             except KeyError:
                 req["replica"] = req["engine_rid"] = None
                 self._pending.append((fid, req))
+                tracing.get_recorder().event(
+                    "redispatch",
+                    kind="serving",
+                    trace_id=req.get("trace_id"),
+                    parent=req.get("_span"),
+                    attrs={"fid": fid, "reason": "engine forgot request"},
+                )
                 return {"id": fid, "status": "pending", "replica": None}
             out = dict(out)
             out["id"] = fid
@@ -710,9 +772,17 @@ class ServingFleet:
                 self.completed_total += 1
                 n_new = len(out.get("tokens", []) or [])
                 self.tokens_total += n_new
-                self._latencies.append(
-                    (time.time(), (time.time() - req["submitted_at"]) * 1000.0)
-                )
+                latency_ms = (time.time() - req["submitted_at"]) * 1000.0
+                self._latencies.append((time.time(), latency_ms))
+                span = req.get("_span")
+                if span is not None and span.t1 is None:
+                    span.end(
+                        status=out.get("status"),
+                        tokens=n_new,
+                        replica=req["replica"],
+                        latency_ms=round(latency_ms, 3),
+                    )
+            out["trace_id"] = req.get("trace_id")
             return out
 
     # -- control loop --------------------------------------------------------
@@ -755,9 +825,23 @@ class ServingFleet:
             # not read as "need another replica".
             if desired > self.desired_replicas:
                 self.scale_ups_total += 1
+                tracing.get_recorder().event(
+                    "scale_up",
+                    kind="autoscaler",
+                    trace_id=self.trace_id,
+                    parent=self._fleet_span,
+                    attrs={"desired": desired, "running": n_running},
+                )
                 self.scale_to(desired)
             elif desired < self.desired_replicas and n_running >= self.desired_replicas:
                 self.scale_downs_total += 1
+                tracing.get_recorder().event(
+                    "scale_down",
+                    kind="autoscaler",
+                    trace_id=self.trace_id,
+                    parent=self._fleet_span,
+                    attrs={"desired": desired, "running": n_running},
+                )
                 self.scale_to(desired)
         return self.status()
 

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import logging
 import math
+import tempfile
 import threading
 import time
 import traceback
@@ -32,6 +33,7 @@ from typing import Any, Callable, Iterator, Optional, Sequence
 
 import jax
 
+from tpu_engine import tracing
 from tpu_engine.checkpoint import TrainCheckpointManager, abstract_state_like
 from tpu_engine.loss_monitor import (
     AlertSeverity,
@@ -88,6 +90,11 @@ class TrainingJob:
         health_check_interval_steps: int = 1,
         emergency_save_retries: int = 3,
         emergency_save_backoff_s: float = 0.05,
+        trace_id: Optional[str] = None,
+        anomaly_detection: bool = True,
+        anomaly_detector: Optional[tracing.StepTimeAnomalyDetector] = None,
+        anomaly_trace_session: Optional[Any] = None,
+        anomaly_trace_dir: Optional[str] = None,
     ):
         self.job_id = job_id
         self.config = config
@@ -135,6 +142,26 @@ class TrainingJob:
         self.recovery_state: Optional[str] = None
         self.recovery_events: list[dict[str, Any]] = []
         self.unhealthy_devices: list[int] = []
+
+        # Flight-recorder identity: the scheduler passes its submission's
+        # trace so every attempt chains under one lifecycle root; a
+        # standalone job gets its own trace lazily when the loop starts.
+        self.trace_id = trace_id
+        # Step-time anomaly attribution (Poplar-style: per-step wall time
+        # is the health signal). The detector flags outliers against a
+        # sliding baseline; the recorder attributes each to the span/event
+        # overlapping that step's wall window. A sustained regression can
+        # auto-start a bounded XPlane capture via anomaly_trace_session
+        # (any object with TraceSession's start(log_dir, duration_s)).
+        self._anomaly = anomaly_detector or (
+            tracing.StepTimeAnomalyDetector() if anomaly_detection else None
+        )
+        self._anomaly_trace_session = anomaly_trace_session
+        self._anomaly_trace_dir = anomaly_trace_dir
+        self._auto_trace_started = False
+        self._prev_step_end_ts: Optional[float] = None
+        self.anomalies_total = 0
+        self.last_anomaly: Optional[dict[str, Any]] = None
 
         self.status = JobStatus.PENDING
         self.error: Optional[str] = None
@@ -216,6 +243,12 @@ class TrainingJob:
         """Emergency path: flag stop; the train loop does the synchronous save."""
         log.warning("job %s: preemption (%s) — emergency checkpoint", self.job_id, reason)
         self.preemption_reason = reason
+        tracing.get_recorder().event(
+            "preemption",
+            kind="preempt_drain",
+            trace_id=self.trace_id,
+            attrs={"reason": reason, "step": self.current_step},
+        )
         self._stop.set()
 
     # -- self-healing ---------------------------------------------------------
@@ -232,6 +265,12 @@ class TrainingJob:
             {"kind": kind, "step": step, "detail": detail, "timestamp": time.time()}
         )
         del self.recovery_events[:-100]
+        tracing.get_recorder().event(
+            f"recovery:{kind}",
+            kind="recovery",
+            trace_id=self.trace_id,
+            attrs={"job_id": self.job_id, "step": step, "detail": detail},
+        )
         inj = self._injector()
         if inj is not None:
             inj.record(f"recovery:{kind}", step=step, detail=f"job {self.job_id}: {detail}")
@@ -467,6 +506,18 @@ class TrainingJob:
 
     def _run(self) -> None:
         self.started_at = time.time()
+        rec = tracing.get_recorder()
+        if self.trace_id is None:
+            self.trace_id = rec.new_trace_id()
+        if self.ckpt is not None:
+            self.ckpt.trace_id = self.trace_id
+        attempt_span = rec.start_span(
+            f"attempt:{self.job_id}",
+            kind="attempt",
+            trace_id=self.trace_id,
+            parent=rec.trace_root(self.trace_id),
+            attrs={"job_id": self.job_id},
+        )
         try:
             self.status = JobStatus.COMPILING
             # Warm-start compiles across restarts: a preempted job that
@@ -474,9 +525,13 @@ class TrainingJob:
             # this module's docstring promises; SURVEY.md §7 hard part c).
             from tpu_engine.compile_cache import enable_compilation_cache
 
-            enable_compilation_cache(self.config.compilation_cache_dir)
-            if self.program is None:
-                self.program = self._build_program()
+            with rec.start_span(
+                "compile", kind="compile", trace_id=self.trace_id,
+                parent=attempt_span,
+            ):
+                enable_compilation_cache(self.config.compilation_cache_dir)
+                if self.program is None:
+                    self.program = self._build_program()
             prog = self.program
 
             # Per-chip attribution: claim this job's chips in the fleet view
@@ -503,6 +558,13 @@ class TrainingJob:
                     self._state = state
                     start_step = int(step)
                     self.resumed_from_step = start_step
+                    rec.event(
+                        "resume",
+                        kind="supervisor",
+                        trace_id=self.trace_id,
+                        parent=attempt_span,
+                        attrs={"from_step": start_step},
+                    )
                     log.info("job %s: resumed from checkpoint step %d", self.job_id, start_step)
             if self._state is None:
                 self._state = prog.init(jax.random.PRNGKey(self.config.seed))
@@ -618,6 +680,13 @@ class TrainingJob:
                         # chaos runs stay deterministic and fast.
                         self.last_step_time_s = dt + slow
                         self.tokens_per_sec = tokens_per_batch / self.last_step_time_s
+                        rec.event(
+                            "host-slow",
+                            kind="fault",
+                            trace_id=self.trace_id,
+                            parent=attempt_span,
+                            attrs={"step": step, "penalty_s": slow},
+                        )
                     if inj.preempt_due(step):
                         # Synchronous injection (not via the watcher thread):
                         # the step that triggers is the step that saves.
@@ -630,6 +699,73 @@ class TrainingJob:
                     bad = self._unhealthy_mesh_devices()
                     if bad:
                         self._begin_self_heal(step, bad)
+
+                # Step-time anomaly attribution: flag against the sliding
+                # baseline, then attribute to whatever span/event overlaps
+                # this step's wall window (the previous step's end →  now
+                # covers inter-step work like a checkpoint save). The
+                # host-slow event above lands BEFORE this check, so an
+                # injected stall is both the anomaly and its cause.
+                if self._anomaly is not None:
+                    now_ts = time.time()
+                    observed = (
+                        self.last_step_time_s
+                        if self.last_step_time_s is not None
+                        else dt
+                    )
+                    anom = self._anomaly.observe(step, observed)
+                    if anom is not None:
+                        w0 = (
+                            self._prev_step_end_ts
+                            if self._prev_step_end_ts is not None
+                            else now_ts - observed
+                        )
+                        cause = rec.attribute(self.trace_id, w0, now_ts)
+                        anom["cause"] = cause
+                        self.anomalies_total += 1
+                        self.last_anomaly = dict(anom)
+                        rec.record_anomaly(
+                            cause,
+                            trace_id=self.trace_id,
+                            attrs={
+                                "job_id": self.job_id,
+                                "step": anom["step"],
+                                "duration_s": anom["duration_s"],
+                                "baseline_s": anom["baseline_s"],
+                                "sustained": anom["sustained"],
+                            },
+                        )
+                        if (
+                            anom["sustained"]
+                            and self._anomaly_trace_session is not None
+                            and not self._auto_trace_started
+                        ):
+                            # Opt-in: one bounded XPlane capture per job on
+                            # sustained regression (never a retry storm).
+                            self._auto_trace_started = True
+                            try:
+                                log_dir = self._anomaly_trace_dir or (
+                                    tempfile.mkdtemp(
+                                        prefix=f"anomtrace_{self.job_id}_"
+                                    )
+                                )
+                                self._anomaly_trace_session.start(
+                                    log_dir, duration_s=30.0
+                                )
+                                rec.event(
+                                    "auto_trace_started",
+                                    kind="supervisor",
+                                    trace_id=self.trace_id,
+                                    attrs={"log_dir": log_dir, "step": step},
+                                )
+                            except Exception as e:
+                                rec.event(
+                                    "auto_trace_unavailable",
+                                    kind="supervisor",
+                                    trace_id=self.trace_id,
+                                    attrs={"error": str(e)},
+                                )
+                    self._prev_step_end_ts = now_ts
 
                 alerts = self.monitor.ingest(
                     TrainingMetrics(
@@ -684,7 +820,21 @@ class TrainingJob:
             if self.ckpt is not None and self._state is not None:
                 with self._state_lock:
                     self._flush_state()
-                if self._final_save(step):
+                save_kind = (
+                    "emergency_save"
+                    if (
+                        self.preemption_reason is not None
+                        or self.recovery_state is not None
+                    )
+                    else "final_save"
+                )
+                with rec.start_span(
+                    save_kind, kind=save_kind, trace_id=self.trace_id,
+                    parent=attempt_span, attrs={"step": step},
+                ) as save_span:
+                    ok = self._final_save(step)
+                    save_span.annotate(ok=ok)
+                if ok:
                     self._advance_stable(step)
             if self.preemption_reason is not None:
                 self.status = JobStatus.PREEMPTED
@@ -698,6 +848,15 @@ class TrainingJob:
             self.status = JobStatus.FAILED
         finally:
             self.finished_at = time.time()
+            if attempt_span.t1 is None:
+                attempt_span.end(
+                    status=self.status.value,
+                    step=self.current_step,
+                    preemption_reason=self.preemption_reason,
+                    error=self.error,
+                    resumed_from_step=self.resumed_from_step,
+                    anomalies=self.anomalies_total,
+                )
             telemetry.unregister_job_devices(self.job_id)
             # Stop a sharded-read prefetch thread with the job (make_data_fn
             # attaches close when it owns a stream).
@@ -1122,6 +1281,9 @@ class TrainingJob:
             "recovery_state": self.recovery_state,
             "recovery_events": list(self.recovery_events),
             "unhealthy_devices": list(self.unhealthy_devices),
+            "trace_id": self.trace_id,
+            "anomalies_total": self.anomalies_total,
+            "last_anomaly": self.last_anomaly,
             "started_at": self.started_at,
             "finished_at": self.finished_at,
             "last_step_time_s": self.last_step_time_s,
