@@ -21,6 +21,7 @@ from backend import openapi
 from backend.http import cors_middleware, error_middleware, json_response
 from backend.routers import (
     faults,
+    goodput,
     metrics,
     monitoring,
     profiling,
@@ -79,6 +80,10 @@ async def root(request: web.Request) -> web.Response:
                 "shrink -> resume -> grow-back) with step-time anomaly "
                 "attribution and Chrome-trace/Perfetto export",
                 "Prometheus /metrics exporting both telemetry planes",
+                "fleet goodput ledger: per-submission wall-clock "
+                "decomposition (productive/queue/compile/checkpoint/"
+                "restore/preempt/shrink/host-slow/idle) with SLO "
+                "burn-rate alerting and Perfetto counter tracks",
                 "continuous-batching serving with SSE token streaming, "
                 "prompt-prefix KV reuse, int8 weights/KV, and speculative "
                 "decoding",
@@ -95,6 +100,7 @@ async def root(request: web.Request) -> web.Response:
                 "topology": "/api/v1/topology",
                 "profile": "/api/v1/profile",
                 "trace": "/api/v1/trace",
+                "goodput": "/api/v1/goodput",
                 "metrics": "/metrics",
                 "openapi": "/openapi.json",
                 "docs": "/docs",
@@ -132,6 +138,7 @@ def create_app() -> web.Application:
     topology.setup(app)
     profiling.setup(app)
     tracing.setup(app)
+    goodput.setup(app)
     serving.setup(app)
     metrics.setup(app)
     app.router.add_get("/", root)

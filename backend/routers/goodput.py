@@ -1,0 +1,37 @@
+"""Goodput-ledger routes — the query surface for
+``tpu_engine/goodput.py``:
+
+- ``GET /api/v1/goodput`` — refreshes the ledger against the live flight
+  recorder, then returns the full wall-clock decomposition (fleet /
+  per-tenant / per-workload category seconds + bucketed history) and the
+  SLO burn-rate view (one evaluation pass per read — alert transitions
+  fire onto the recorder's ``fleet`` timeline as a side effect, exactly
+  like a timer-driven evaluator would).
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from backend.http import json_response
+from tpu_engine import goodput as goodput_mod
+from tpu_engine import tracing
+
+
+async def goodput_view(request: web.Request) -> web.Response:
+    rec = tracing.get_recorder()
+    ledger = goodput_mod.get_ledger()
+    alerter = goodput_mod.get_alerter()
+    refreshed = ledger.refresh(rec)
+    return json_response(
+        {
+            "ledger": ledger.snapshot(),
+            "slo": alerter.evaluate(),
+            "refreshed_traces": refreshed,
+            "categories": list(goodput_mod.CATEGORIES),
+        }
+    )
+
+
+def setup(app: web.Application, prefix: str = "/api/v1") -> None:
+    app.router.add_get(f"{prefix}/goodput", goodput_view)

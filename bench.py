@@ -180,6 +180,9 @@ def main() -> None:
     chaos_line = _chaos_metric()
     if chaos_line is not None:
         print(json.dumps(chaos_line))
+    goodput_line = _goodput_metric()
+    if goodput_line is not None:
+        print(json.dumps(goodput_line))
     serving_line = _serving_fleet_metric()
     if serving_line is not None:
         print(json.dumps(serving_line))
@@ -344,6 +347,30 @@ def _chaos_metric() -> dict | None:
             "baseline_mttr_mean_s": trace["die_and_restart"]["mttr_mean_s"],
             "steps_saved": trace["steps_saved"],
             "zero_lost_steps": trace["self_heal"]["lost_steps"] == 0,
+        }
+    except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
+        return None
+
+
+def _goodput_metric() -> dict | None:
+    """JSON line after chaos: the goodput ledger's wall-clock decomposition
+    of the same seeded chaos trace — per-category breakdown (percent of
+    wall), the sum-to-wall invariant error, and the SLO burn-rate
+    alerter's deterministic ok->warning->page progression. Never fails
+    the bench: any error degrades to None."""
+    try:
+        from benchmarks.chaos import run_trace
+
+        gp = run_trace(seed=0)["goodput"]
+        return {
+            "metric": "goodput_ledger_chaos_breakdown",
+            "value": gp["goodput_fraction"],
+            "unit": "productive fraction of self-heal wall clock",
+            "breakdown_pct": gp["breakdown_pct"],
+            "sum_error_pct": gp["sum_error_pct"],
+            "slo_progression": gp["slo"]["progression"],
+            "alert_count": gp["slo"]["alert_count"],
+            "sum_to_wall_ok": gp["sum_error_pct"] < 1.0,
         }
     except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
         return None

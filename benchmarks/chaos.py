@@ -40,6 +40,11 @@ from typing import Optional
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tpu_engine.faults import FaultKind, FaultPlan  # noqa: E402
+from tpu_engine.goodput import (  # noqa: E402
+    CATEGORIES,
+    GoodputLedger,
+    SLOBurnRateAlerter,
+)
 from tpu_engine.tracing import FlightRecorder  # noqa: E402
 
 # Model: 8-chip gang, fsdp=2 inner axis — a shrunk mesh must keep the
@@ -234,14 +239,88 @@ def simulate_die_and_restart(events: list[dict]) -> dict:
     }
 
 
+def goodput_lane(
+    recorder: FlightRecorder, trace_id: str, wall: float
+) -> dict:
+    """Account the self-heal trace through the REAL goodput ledger (the
+    same decomposition live submissions get), then replay the SLO
+    burn-rate alerter over the run's virtual clock.
+
+    The fault plan is deterministic, so the alert progression is too:
+    the clean head of the run evaluates ok, the first fault cluster
+    burns the short+long windows past ``warning_burn``, and the
+    sustained degraded tail past ``page_burn``. Alert transitions land
+    as ``slo_alert`` events on the recorder's ``fleet`` timeline and
+    per-window counter samples as a Perfetto counter track — both ride
+    the same Chrome-trace export as the recovery chains they explain."""
+    ledger = GoodputLedger(clock=lambda: wall, bucket_s=60.0,
+                           history_buckets=256)
+    ledger.track(trace_id, tenant="chaos", workload="training",
+                 full_gang=N_CHIPS)
+    d = ledger.finalize(recorder, trace_id, now=wall)
+    assert d is not None
+    cats = d["categories"]
+    sum_error_pct = abs(sum(cats.values()) - d["wall_s"]) / d["wall_s"] * 100
+    alerter = SLOBurnRateAlerter(
+        ledger,
+        goodput_target=0.88,
+        short_window_s=120.0,
+        long_window_s=600.0,
+        warning_burn=1.5,
+        page_burn=3.0,
+        recorder=recorder,
+        clock=lambda: wall,
+    )
+    progression = ["ok"]
+    t = 0.0
+    while t <= wall + 60.0:
+        out = alerter.evaluate(now=t)
+        g = out["goodput"]
+        if g["state"] != progression[-1]:
+            progression.append(g["state"])
+        recorder.counter(
+            "goodput_burn",
+            {
+                "goodput_fraction_short": g["short_fraction"] or 1.0,
+                "burn_short": g["short_burn"] or 0.0,
+                "burn_long": g["long_burn"] or 0.0,
+            },
+            trace_id=trace_id,
+            ts=t,
+        )
+        t += 60.0
+    return {
+        "breakdown_s": {c: round(cats[c], 2) for c in CATEGORIES},
+        "breakdown_pct": {
+            c: round(100.0 * cats[c] / d["wall_s"], 2) for c in CATEGORIES
+        },
+        "wall_s": round(d["wall_s"], 1),
+        "goodput_fraction": round(d["goodput_fraction"], 4),
+        "sum_error_pct": round(sum_error_pct, 6),
+        "slo": {
+            "target": alerter.goodput_target,
+            "warning_burn": alerter.warning_burn,
+            "page_burn": alerter.page_burn,
+            "progression": progression,
+            "alert_count": len(alerter.alerts),
+            "alerts": list(alerter.alerts),
+        },
+    }
+
+
 def run_trace(
     seed: int = 0,
     n_faults: int = 12,
     recorder: Optional[FlightRecorder] = None,
 ) -> dict:
+    # The goodput lane needs the recorded spans even when the caller does
+    # not want a trace export — record into a private recorder then.
+    recorder = recorder or FlightRecorder()
+    trace_id = recorder.new_trace_id()
     events = chip_fault_trace(seed, n_faults=n_faults)
-    heal = simulate_self_heal(events, recorder=recorder)
+    heal = simulate_self_heal(events, recorder=recorder, trace_id=trace_id)
     die = simulate_die_and_restart(events)
+    goodput = goodput_lane(recorder, trace_id, heal["wall_s"])
     return {
         "seed": seed,
         "params": {
@@ -254,6 +333,7 @@ def run_trace(
         "fault_events": events,
         "self_heal": heal,
         "die_and_restart": die,
+        "goodput": goodput,
         "goodput_improvement": round(heal["goodput"] / die["goodput"], 3),
         "mttr_reduction": round(
             die["mttr_mean_s"] / heal["mttr_mean_s"], 3
@@ -282,10 +362,17 @@ def main() -> None:
             "trace_events": len(doc["traceEvents"]),
         }
     print(json.dumps(trace, indent=2))
+    gp = trace["goodput"]
     ok = (
         trace["self_heal"]["lost_steps"] == 0
         and trace["goodput_improvement"] > 1.0
         and (trace["mttr_reduction"] or 0.0) > 1.0
+        # Ledger invariant: the category breakdown re-derives the wall
+        # clock from spans alone — must sum to it within 1%.
+        and gp["sum_error_pct"] < 1.0
+        # The seeded fault plan drives the alerter through a full
+        # escalation before anything else happens.
+        and gp["slo"]["progression"][:3] == ["ok", "warning", "page"]
     )
     print(json.dumps({
         "metric": "chaos_goodput_self_heal_vs_die_restart",
@@ -293,6 +380,16 @@ def main() -> None:
         "unit": "x goodput under faults (die-and-restart = 1.0)",
         "mttr_reduction": trace["mttr_reduction"],
         "zero_lost_steps": trace["self_heal"]["lost_steps"] == 0,
+        "ok": ok,
+    }))
+    print(json.dumps({
+        "metric": "chaos_goodput_breakdown",
+        "value": gp["goodput_fraction"],
+        "unit": "productive fraction of self-heal wall clock",
+        "breakdown_pct": gp["breakdown_pct"],
+        "sum_error_pct": gp["sum_error_pct"],
+        "slo_progression": gp["slo"]["progression"],
+        "alert_count": gp["slo"]["alert_count"],
         "ok": ok,
     }))
     if not ok:

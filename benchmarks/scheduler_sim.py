@@ -44,6 +44,7 @@ from typing import Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from tpu_engine.goodput import GoodputLedger, set_ledger  # noqa: E402
 from tpu_engine.hbm_estimate import HBMEstimate, gang_size  # noqa: E402
 from tpu_engine.mesh_runtime import MeshConfig  # noqa: E402
 from tpu_engine.scheduler import (  # noqa: E402
@@ -177,6 +178,13 @@ _CRITICAL_LATECOMER = (JobPriority.CRITICAL, 4, 0.60, 2.0)
 
 def run_trace(max_concurrent_jobs: int = 3) -> dict:
     """Phase A. Returns the measured trace metrics vs the serial baseline."""
+    # Fresh process-wide ledger: the scheduler's submit/finish hooks track
+    # and finalize every submission's trace through it, so Phase A gets a
+    # real wall-clock decomposition for free (FakeJobs record no attempt
+    # spans — queue wait comes from submit events + admission spans, the
+    # rest of the root window counts productive).
+    ledger = GoodputLedger()
+    set_ledger(ledger)
     progress: dict[str, float] = {}
     durations: dict[int, float] = {}
     hbm_by_tag: dict[int, float] = {}
@@ -267,6 +275,7 @@ def run_trace(max_concurrent_jobs: int = 3) -> dict:
 
     crit_progress = progress.get(crit.submission_id, 0.0)
     preempt_victims = [s for s in subs if s.preemptions > 0]
+    gp = ledger.snapshot()
     return {
         "jobs": len(_TRACE) + 1,
         "slots": max_concurrent_jobs,
@@ -292,6 +301,14 @@ def run_trace(max_concurrent_jobs: int = 3) -> dict:
         "critical_work_s": round(crit_progress, 2),
         "gang8_skip_reason": blocked_reason,
         "gang8_final_state": blocked.state.value,
+        "goodput_ledger": {
+            "categories_s": {
+                c: v for c, v in gp["categories"].items() if v > 0
+            },
+            "goodput_fraction": gp["goodput_fraction"],
+            "traces_accounted": gp["traces_accounted"],
+            "invariant_violations": gp["invariant_violations"],
+        },
     }
 
 

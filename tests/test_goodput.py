@@ -1,0 +1,430 @@
+"""Goodput ledger: decomposition invariants, SLO burn-rate, chaos e2e.
+
+Property-style checks on :mod:`tpu_engine.goodput`:
+
+- the boundary-sweep decomposition's categories are disjoint and sum to
+  the wall window *by construction* — asserted over randomized overlap
+  soups, not one hand-picked trace;
+- preempt → requeue → re-admit boundaries account drain and queue wait
+  without double counting;
+- the incremental ledger is idempotent (refresh-per-scrape == one-shot);
+- the multi-window burn-rate alerter escalates ok → warning → page on a
+  degrading history and fires structured events on the recorder's
+  ``fleet`` timeline;
+- the chaos benchmark's end-to-end account sums to its wall clock within
+  1% and its alert progression is deterministic, with the alerts visible
+  in both the ``/api/v1/goodput`` payload and the Perfetto export.
+"""
+
+import asyncio
+import json
+import random
+
+from tpu_engine.goodput import (
+    CATEGORIES,
+    FLEET_TRACE_ID,
+    GoodputLedger,
+    SLOBurnRateAlerter,
+    decompose_trace,
+    set_alerter,
+    set_ledger,
+)
+from tpu_engine.scheduler import WAIT_BUCKETS_S, _observe_hist
+from tpu_engine.telemetry import DerivedDutySource
+from tpu_engine.tracing import FlightRecorder
+
+NOW = 1_000_000.0
+
+
+def _rec():
+    return FlightRecorder(clock=lambda: NOW)
+
+
+# ---------------------------------------------------------------------------
+# decompose_trace invariants
+# ---------------------------------------------------------------------------
+
+
+def _assert_invariants(d, wall):
+    assert set(d["categories"]) == set(CATEGORIES)
+    for c, v in d["categories"].items():
+        assert v >= -1e-9, f"negative {c}: {v}"
+    total = sum(d["categories"].values())
+    assert abs(total - wall) < 1e-6 * max(wall, 1.0), (
+        f"sum {total} != wall {wall}"
+    )
+    assert abs(d["sum_error_s"]) < 1e-6 * max(wall, 1.0)
+
+
+def test_decompose_sum_to_wall_randomized_overlap():
+    """Fuzz: arbitrary soups of overlapping overlay spans, fault events,
+    and attempt windows still sum to the wall window exactly."""
+    kinds = [
+        "compile", "checkpoint_save", "checkpoint_restore",
+        "emergency_save", "admission", "fault", "final_save",
+    ]
+    for seed in range(25):
+        rng = random.Random(seed)
+        rec = _rec()
+        tid = rec.new_trace_id()
+        wall = rng.uniform(50.0, 500.0)
+        root = rec.start_span("job:fuzz", kind="job", trace_id=tid, t0=0.0)
+        n_attempts = rng.randint(0, 3)
+        cursor = rng.uniform(0, wall * 0.1)
+        for _ in range(n_attempts):
+            a0 = cursor
+            a1 = min(wall, a0 + rng.uniform(1.0, wall / 2))
+            rec.record_span(
+                "attempt", kind="attempt", trace_id=tid, t0=a0, t1=a1
+            )
+            cursor = a1 + rng.uniform(0.0, wall * 0.1)
+        for _ in range(rng.randint(0, 12)):
+            k = rng.choice(kinds)
+            t0 = rng.uniform(-10.0, wall)
+            rec.record_span(
+                k, kind=k, trace_id=tid, t0=t0,
+                t1=t0 + rng.uniform(0.0, wall / 3),
+            )
+        for _ in range(rng.randint(0, 4)):
+            rec.event(
+                "host_slow", kind="fault", trace_id=tid,
+                ts=rng.uniform(0, wall),
+                attrs={"penalty_s": rng.uniform(0, 20.0)},
+            )
+        root.end(t1=wall)
+        d = decompose_trace(rec, tid)
+        assert d["wall_s"] == wall
+        _assert_invariants(d, wall)
+
+
+def test_decompose_overlay_priority_disjoint():
+    """Overlapping compile and checkpoint spans: every second is charged
+    to exactly one category, the higher-priority overlay winning."""
+    rec = _rec()
+    tid = rec.new_trace_id()
+    root = rec.start_span("job:x", kind="job", trace_id=tid, t0=0.0)
+    rec.record_span("compile", kind="compile", trace_id=tid, t0=10, t1=30)
+    rec.record_span(
+        "save", kind="checkpoint_save", trace_id=tid, t0=20, t1=40
+    )
+    root.end(t1=100.0)
+    d = decompose_trace(rec, tid)
+    _assert_invariants(d, 100.0)
+    c = d["categories"]
+    assert abs(c["compile"] - 10.0) < 1e-9          # [10,20) only
+    assert abs(c["checkpoint_save"] - 20.0) < 1e-9  # [20,40) wins overlap
+    assert abs(c["productive"] - 70.0) < 1e-9
+
+
+def test_preempt_requeue_boundaries():
+    """Preempt drain runs to the end of the attempt; the requeue's queue
+    wait runs to the end of the next admission pass; no double counting."""
+    rec = _rec()
+    tid = rec.new_trace_id()
+    root = rec.start_span("job:p", kind="job", trace_id=tid, t0=0.0)
+    rec.record_span("attempt-1", kind="attempt", trace_id=tid, t0=0, t1=40)
+    rec.event("preempt", kind="preempt_drain", trace_id=tid, ts=35.0)
+    rec.event("requeue", kind="scheduler", trace_id=tid, ts=40.0)
+    rec.record_span(
+        "admission", kind="admission", trace_id=tid, t0=58, t1=60
+    )
+    rec.record_span("attempt-2", kind="attempt", trace_id=tid, t0=60, t1=100)
+    root.end(t1=100.0)
+    d = decompose_trace(rec, tid)
+    _assert_invariants(d, 100.0)
+    c = d["categories"]
+    assert abs(c["productive"] - 75.0) < 1e-9    # [0,35) + [60,100)
+    assert abs(c["preempt_drain"] - 5.0) < 1e-9  # [35,40)
+    assert abs(c["queue_wait"] - 20.0) < 1e-9    # [40,60)
+    assert c["idle_unknown"] == 0.0
+
+
+def test_attempt_step_s_cap_spills_to_idle():
+    """The supervisor's measured per-step total caps productive time; the
+    untraced remainder is idle/unknown, not goodput."""
+    rec = _rec()
+    tid = rec.new_trace_id()
+    root = rec.start_span("job:s", kind="job", trace_id=tid, t0=0.0)
+    rec.record_span(
+        "attempt-1", kind="attempt", trace_id=tid, t0=0, t1=100,
+        attrs={"step_s": 60.0},
+    )
+    root.end(t1=100.0)
+    d = decompose_trace(rec, tid)
+    _assert_invariants(d, 100.0)
+    assert abs(d["categories"]["productive"] - 60.0) < 1e-9
+    assert abs(d["categories"]["idle_unknown"] - 40.0) < 1e-9
+
+
+def test_shrink_degraded_capacity_split():
+    """After a shrink admission, the running baseline splits into
+    productive × mesh/full plus the shrink-degraded deficit."""
+    rec = _rec()
+    tid = rec.new_trace_id()
+    root = rec.start_span(
+        "job:d", kind="job", trace_id=tid, t0=0.0, attrs={"n_chips": 8}
+    )
+    rec.record_span(
+        "shrink_admit", kind="admission", trace_id=tid, t0=49, t1=50,
+        attrs={"mesh": 4},
+    )
+    root.end(t1=100.0)
+    d = decompose_trace(rec, tid)
+    _assert_invariants(d, 100.0)
+    c = d["categories"]
+    assert abs(c["queue_wait"] - 1.0) < 1e-9          # the admission pass
+    assert abs(c["productive"] - (49 + 50 * 0.5)) < 1e-9
+    assert abs(c["shrink_degraded"] - 25.0) < 1e-9
+
+
+def test_async_checkpoint_save_not_charged():
+    """blocking=False saves overlap training — they must not displace
+    productive time."""
+    rec = _rec()
+    tid = rec.new_trace_id()
+    root = rec.start_span("job:a", kind="job", trace_id=tid, t0=0.0)
+    rec.record_span(
+        "save", kind="checkpoint_save", trace_id=tid, t0=10, t1=30,
+        attrs={"blocking": False},
+    )
+    root.end(t1=100.0)
+    d = decompose_trace(rec, tid)
+    assert d["categories"]["checkpoint_save"] == 0.0
+    assert abs(d["categories"]["productive"] - 100.0) < 1e-9
+
+
+# ---------------------------------------------------------------------------
+# GoodputLedger
+# ---------------------------------------------------------------------------
+
+
+def _busy_trace(rec):
+    tid = rec.new_trace_id()
+    root = rec.start_span("job:l", kind="job", trace_id=tid, t0=0.0)
+    rec.record_span("compile", kind="compile", trace_id=tid, t0=0, t1=20)
+    rec.record_span("save", kind="checkpoint_save", trace_id=tid,
+                    t0=100, t1=110)
+    root.end(t1=200.0)
+    return tid
+
+
+def test_ledger_incremental_matches_one_shot():
+    """refresh-per-scrape accounting == a single final accounting: the
+    per-trace cursor makes repeated passes idempotent."""
+    rec = _rec()
+    tid = _busy_trace(rec)
+
+    one = GoodputLedger(clock=lambda: 200.0)
+    one.track(tid, tenant="t", workload="w")
+    one.finalize(rec, tid, now=200.0)
+
+    inc = GoodputLedger(clock=lambda: 200.0)
+    inc.track(tid, tenant="t", workload="w")
+    for now in (50.0, 120.0, 120.0, 200.0):  # repeated + stalled scrapes
+        inc.refresh(rec, now=now)
+    inc.finalize(rec, tid, now=200.0)
+
+    a, b = one.snapshot(), inc.snapshot()
+    for c in CATEGORIES:
+        assert abs(a["categories"][c] - b["categories"][c]) < 1e-6, c
+    assert a["wall_s"] == b["wall_s"]
+    assert b["traces_accounted"] == 1
+    assert b["invariant_violations"] == 0
+    assert b["by_tenant"]["t"]["compile"] == a["by_tenant"]["t"]["compile"]
+
+
+def test_ledger_note_and_window_fraction():
+    """Explicit-timestamp accounting feeds the same history rings the
+    burn-rate windows read."""
+    led = GoodputLedger(clock=lambda: 120.0, bucket_s=60.0)
+    led.note("productive", 60.0, ts=60.0)
+    led.note("queue_wait", 60.0, ts=120.0)
+    assert abs(led.window_fraction(120.0, now=120.0) - 0.5) < 1e-9
+    # Only the second bucket in view -> all queue wait.
+    assert led.window_fraction(60.0, now=120.0) < 0.01
+    snap = led.snapshot()
+    assert snap["wall_s"] == 120.0
+    assert snap["goodput_fraction"] == 0.5
+
+
+def test_ledger_tenant_overflow_folds_to_other():
+    led = GoodputLedger(clock=lambda: 10.0, max_tenants=2)
+    for i in range(4):
+        led.note("productive", 1.0, tenant=f"t{i}", ts=float(i + 1))
+    snap = led.snapshot()
+    assert set(snap["by_tenant"]) == {"t0", "t1", "~other"}
+    assert snap["by_tenant"]["~other"]["productive"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate alerting
+# ---------------------------------------------------------------------------
+
+
+def test_alerter_escalates_and_fires_fleet_events():
+    """A degrading goodput history walks ok → warning → page; each
+    transition lands a structured slo_alert event on the fleet timeline;
+    recovery resolves back down."""
+    rec = _rec()
+    led = GoodputLedger(clock=lambda: 0.0, bucket_s=60.0, history_buckets=512)
+    al = SLOBurnRateAlerter(
+        led, goodput_target=0.9, short_window_s=120.0, long_window_s=360.0,
+        warning_burn=1.5, page_burn=3.0, recorder=rec, clock=lambda: 0.0,
+    )
+    seen = ["ok"]
+
+    def feed_and_eval(t, productive_frac):
+        led.note("productive", 60.0 * productive_frac, ts=t)
+        if productive_frac < 1.0:
+            led.note("queue_wait", 60.0 * (1 - productive_frac), ts=t)
+        out = al.evaluate(now=t)
+        if out["goodput"]["state"] != seen[-1]:
+            seen.append(out["goodput"]["state"])
+
+    t = 0.0
+    for frac in [1.0] * 6 + [0.8] * 6 + [0.3] * 6 + [1.0] * 8:
+        t += 60.0
+        feed_and_eval(t, frac)
+    assert seen[:3] == ["ok", "warning", "page"]
+    assert seen[-1] == "ok"  # the clean tail drains the windows
+    alerts = [e for e in rec.events(limit=0) if e["kind"] == "slo_alert"]
+    assert alerts and all(e["trace_id"] == FLEET_TRACE_ID for e in alerts)
+    assert alerts[0]["attrs"]["severity"] == "warning"
+    assert alerts[0]["attrs"]["short_burn"] >= 1.5
+    assert al.alerts_total["warning"] >= 1
+    assert al.alerts_total["page"] >= 1
+
+
+def test_alerter_serving_p99_slo():
+    led = GoodputLedger(clock=lambda: 0.0)
+    al = SLOBurnRateAlerter(
+        led, p99_slo_ms=100.0, serving_target=0.75,
+        short_window_s=60.0, long_window_s=120.0, clock=lambda: 0.0,
+    )
+    for i in range(20):
+        al.observe_p99(500.0, ts=float(i))  # every sample breaches
+    out = al.evaluate(now=20.0)
+    assert out["serving_p99"]["state"] == "page"
+    assert out["serving_p99"]["short_burn"] == 4.0  # 1.0 bad / 0.25 budget
+    al2_state = al.evaluate(now=500.0)  # samples age out of both windows
+    assert al2_state["serving_p99"]["state"] == "ok"
+
+
+def test_counter_events_render_as_perfetto_counter_track():
+    rec = _rec()
+    tid = rec.new_trace_id()
+    rec.record_span("job:c", kind="job", trace_id=tid, t0=0.0, t1=1.0)
+    rec.counter("goodput_burn", {"burn": 2.5, "label": "oops"},  # non-numeric dropped
+                trace_id=tid, ts=0.5)
+    doc = rec.export_chrome_trace()
+    counters = [e for e in doc["traceEvents"] if e.get("ph") == "C"]
+    assert len(counters) == 1
+    assert counters[0]["name"] == "goodput_burn"
+    assert counters[0]["args"] == {"burn": 2.5}
+
+
+# ---------------------------------------------------------------------------
+# chaos end-to-end + the /api/v1/goodput payload
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_breakdown_sums_and_alerts_everywhere():
+    """The chaos virtual-clock account: categories sum to wall within 1%,
+    productive equals the analytic 500 step-seconds, the alerter walks
+    ok → warning → page deterministically, and the alerts/counters are
+    visible in the Perfetto export of the same recorder."""
+    from benchmarks.chaos import TOTAL_STEPS, STEP_TIME_S, run_trace
+
+    rec = FlightRecorder(clock=lambda: 0.0)
+    trace = run_trace(seed=0, recorder=rec)
+    gp = trace["goodput"]
+    assert gp["sum_error_pct"] < 1.0
+    assert abs(gp["breakdown_s"]["productive"]
+               - TOTAL_STEPS * STEP_TIME_S) < 1.0
+    assert gp["slo"]["progression"][:3] == ["ok", "warning", "page"]
+    assert gp["slo"]["alert_count"] >= 2
+    doc = rec.export_chrome_trace()
+    names = [str(e.get("name", "")) for e in doc["traceEvents"]]
+    assert any(n.startswith("slo_alert:goodput:warning") for n in names)
+    assert any(n.startswith("slo_alert:goodput:page") for n in names)
+    assert any(e.get("ph") == "C" for e in doc["traceEvents"])
+
+
+def test_goodput_router_payload():
+    """GET /api/v1/goodput returns the ledger snapshot + SLO view with
+    the recent alerts inline (the handler ignores the request object)."""
+    from backend.routers.goodput import goodput_view
+
+    rec = _rec()
+    tid = _busy_trace(rec)
+    led = GoodputLedger(clock=lambda: 200.0)
+    led.track(tid, tenant="api", workload="training")
+    al = SLOBurnRateAlerter(led, recorder=rec, clock=lambda: 200.0)
+    al._transition("goodput", "warning", {"short_burn": 2.0}, now=150.0)
+    set_ledger(led)
+    set_alerter(al)
+    try:
+        import tpu_engine.tracing as tracing_mod
+
+        old_rec = tracing_mod.get_recorder()
+        tracing_mod.set_recorder(rec)
+        try:
+            resp = asyncio.run(goodput_view(None))
+        finally:
+            tracing_mod.set_recorder(old_rec)
+        body = json.loads(resp.text)
+        assert body["categories"] == list(CATEGORIES)
+        assert body["refreshed_traces"] == 1
+        assert body["ledger"]["by_tenant"]["api"]["compile"] > 0
+        assert body["slo"]["goodput"]["target"] == al.goodput_target
+        # The injected warning is in the alert history; the handler's own
+        # evaluate pass then correctly resolves it (burns don't support
+        # it), so the resolve transition is recorded too.
+        alerts = body["slo"]["recent_alerts"]
+        assert any(a["severity"] == "warning" for a in alerts)
+        assert alerts[-1]["previous"] == "warning"
+    finally:
+        set_ledger(None)
+        set_alerter(None)
+
+
+# ---------------------------------------------------------------------------
+# satellites: wait histograms + telemetry staleness
+# ---------------------------------------------------------------------------
+
+
+def test_wait_histogram_cumulative_and_in_stats():
+    hist = {b: 0 for b in WAIT_BUCKETS_S}
+    for v in (0.05, 0.3, 2.0, 100.0, 10_000.0):
+        _observe_hist(hist, v)
+    counts = [hist[b] for b in WAIT_BUCKETS_S]
+    assert counts == sorted(counts), "buckets must be cumulative"
+    assert hist[0.1] == 1 and hist[1800.0] == 4  # 10k only in +Inf
+
+    from tpu_engine.scheduler import FleetScheduler
+
+    s = FleetScheduler(poll_interval_s=0.05)
+    try:
+        stats = s.stats()
+        h = stats["admission_wait_histogram"]
+        assert set(h["buckets"]) == {str(b) for b in WAIT_BUCKETS_S}
+        assert h["count"] == 0 and h["sum"] == 0.0
+    finally:
+        s.shutdown()
+
+
+def test_telemetry_staleness_surface():
+    src = DerivedDutySource(window=4, max_age_s=0.0)
+    fresh = src.staleness()
+    assert fresh["last_sample_age_s"] is None
+    assert fresh["scopes"] == 0 and fresh["dropped_stale_total"] == 0
+
+    src.observe(0.5, 1.0, device_ids=[0, 1])
+    st = src.staleness()
+    assert st["last_sample_age_s"] is not None and st["last_sample_age_s"] < 5
+    assert st["scope_ages_s"].keys() == {"0,1"}
+    # max_age_s=0 -> the scope is already stale; sampling drops it and
+    # counts the drop.
+    assert src.sample(n_chips=2) is None
+    assert src.staleness()["dropped_stale_total"] == 1
+    assert src.staleness()["last_sample_age_s"] is not None  # survives drop

@@ -77,7 +77,7 @@ def test_exposition_format_conformance(client):
             parts = line.split(" ")
             assert len(parts) == 4, loc
             family, mtype = parts[2], parts[3]
-            assert mtype in ("gauge", "counter"), loc
+            assert mtype in ("gauge", "counter", "histogram"), loc
             assert family not in typed, f"duplicate TYPE for {family} — {loc}"
             # TYPE must directly follow this family's HELP (grouped output).
             assert family == current_family, f"TYPE without HELP — {loc}"
@@ -87,11 +87,24 @@ def test_exposition_format_conformance(client):
         m = _SAMPLE_RE.match(line)
         assert m, f"malformed sample — {loc}"
         name = m.group("name")
-        # Samples are grouped under their family's HELP/TYPE header.
-        assert name == current_family, (
-            f"sample {name} outside its family block ({current_family}) — {loc}"
-        )
         labels = m.group("labels")
+        # Samples are grouped under their family's HELP/TYPE header.
+        # Histogram families expose the conventional suffixed sample
+        # names; _bucket samples must carry an `le` label.
+        if typed.get(current_family) == "histogram":
+            allowed = {
+                current_family + s for s in ("_bucket", "_sum", "_count")
+            }
+            assert name in allowed, (
+                f"sample {name} outside histogram family "
+                f"({current_family}) — {loc}"
+            )
+            if name.endswith("_bucket"):
+                assert labels and 'le="' in labels, f"_bucket without le — {loc}"
+        else:
+            assert name == current_family, (
+                f"sample {name} outside its family block ({current_family}) — {loc}"
+            )
         if labels:
             inner = labels[1:-1]
             # Consuming every pair proves no unescaped quote slipped through.
@@ -116,6 +129,63 @@ def test_counter_families_follow_naming_convention(client):
                 assert family.endswith("_total"), (
                     f"counter {family} must end in _total"
                 )
+
+
+def test_histogram_families_conform(client):
+    """Every histogram family: cumulative monotone buckets, a +Inf bucket,
+    and +Inf == _count per label set."""
+    text = _scrape(client)
+    hist_families = [
+        line.split(" ")[2]
+        for line in text.splitlines()
+        if line.startswith("# TYPE ") and line.endswith(" histogram")
+    ]
+    assert "tpu_engine_scheduler_admission_wait_seconds" in hist_families
+    for family in hist_families:
+        # label-set (minus le) -> [(le, value)], count
+        buckets: dict[str, list[tuple[float, float]]] = {}
+        counts: dict[str, float] = {}
+        for line in text.splitlines():
+            m = _SAMPLE_RE.match(line)
+            if not m or not m.group("name").startswith(family):
+                continue
+            name = m.group("name")
+            pairs = dict(_LABEL_RE.findall(m.group("labels") or "{}"))
+            le = pairs.pop("le", None)
+            key = ",".join(f"{k}={v}" for k, v in sorted(pairs.items()))
+            value = float(m.group("value"))
+            if name == family + "_bucket":
+                bound = float("inf") if le == "+Inf" else float(le)
+                buckets.setdefault(key, []).append((bound, value))
+            elif name == family + "_count":
+                counts[key] = value
+        assert buckets, f"histogram {family} exported no buckets"
+        for key, series in buckets.items():
+            series.sort()
+            values = [v for _, v in series]
+            assert values == sorted(values), (
+                f"{family}{{{key}}} buckets not cumulative: {series}"
+            )
+            assert series[-1][0] == float("inf"), f"{family}{{{key}}} missing +Inf"
+            assert series[-1][1] == counts.get(key), (
+                f"{family}{{{key}}} +Inf bucket != _count"
+            )
+
+
+def test_goodput_slo_families_always_present(client):
+    """The goodput/SLO plane exports even when nothing has been accounted —
+    burn-rate alerting rules must never go 'no data'."""
+    text = _scrape(client)
+    for family in (
+        "tpu_engine_goodput_wall_seconds_total",
+        "tpu_engine_goodput_tracked_traces",
+        "tpu_engine_goodput_invariant_violations_total",
+        "tpu_engine_slo_goodput_target",
+        "tpu_engine_telemetry_stale_scopes_dropped_total",
+    ):
+        assert re.search(rf"^{family}[ {{]", text, re.M), family
+    assert re.search(r'^tpu_engine_slo_state\{slo="goodput"\} ', text, re.M)
+    assert re.search(r'^tpu_engine_slo_state\{slo="serving_p99"\} ', text, re.M)
 
 
 def test_trace_families_always_present(client):

@@ -1,0 +1,246 @@
+"""Bench regression sentinel: deterministic ``bench.py`` scalars vs BASELINE.
+
+``bench.py`` prints one JSON line per metric. Most values are hardware
+timings (useless to gate in CI), but a subset is **CPU-stable**: the
+chaos virtual-clock account, the goodput-ledger breakdown it feeds, and
+the analytic pipeline-schedule tick account are bit-deterministic on any
+machine. Those scalars live in ``BASELINE.json`` under ``"bench"``; this
+tool re-derives them and fails (exit 1) when any tracked scalar drifts
+by more than ``--threshold`` (default 15%) — the tier-1 gate that
+catches "the refactor silently changed the numbers".
+
+Modes:
+
+- ``--run-quick`` (the CI mode, ``.github/workflows/tier1.yml``):
+  re-computes just the deterministic metrics in-process — no devices, no
+  timed compute, a few seconds on CPU.
+- ``--input PATH|-`` — compare a saved ``bench.py`` JSON-lines output
+  (``-`` = stdin) instead; hardware-timing keys are skipped via the
+  noisy-key allowlist, so a full TPU bench log can be checked too.
+- ``--update`` — write the observed values back as the new baseline
+  (run after an *intentional* change, commit the diff).
+
+Keys are compared flattened one level (``breakdown_pct.productive``).
+Keys in :data:`NOISY_KEYS` (or ``--allow``) are never gated; metrics or
+keys missing from the baseline are reported as ``new`` (not failures),
+so adding a bench line never breaks CI until it is baselined.
+
+Run: ``JAX_PLATFORMS=cpu python tools/bench_sentinel.py --run-quick``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Iterable, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_THRESHOLD = 0.15
+
+# Wall-clock / load dependent keys: never gated, any machine any value.
+NOISY_KEYS = {
+    "makespan_s",
+    "mean_wait_s",
+    "serial_mean_wait_s",
+    "step_time_ms",
+    "bf16_step_time_ms",
+    "int8_step_time_ms",
+    "per_sample_ms",
+    "1f1b_per_sample_ms",
+    "tokens_per_sec",
+    "tokens_per_sec_per_chip",
+    "p50_ms",
+    "p99_ms",
+    "static_p99_ms",
+    "dt_ms",
+    "high_wait_s",
+    "speedup_vs_serial",
+    "goodput_work_s_per_wall_s",
+    "loss_delta_final",
+}
+
+
+def _flatten(line: dict) -> dict[str, float]:
+    """Numeric scalars of one metric line, nested dicts one level deep."""
+    out: dict[str, float] = {}
+    for k, v in line.items():
+        if k == "metric":
+            continue
+        if isinstance(v, bool):
+            out[k] = float(v)
+        elif isinstance(v, (int, float)):
+            out[k] = float(v)
+        elif isinstance(v, dict):
+            for kk, vv in v.items():
+                if isinstance(vv, (int, float)) and not isinstance(vv, bool):
+                    out[f"{k}.{kk}"] = float(vv)
+    return out
+
+
+def collect_quick() -> list[dict]:
+    """Re-derive the deterministic bench lines in-process (no timing)."""
+    from benchmarks.chaos import run_trace as chaos_trace
+    from tpu_engine.parallel.pipeline_zb import schedule_account
+
+    trace = chaos_trace(seed=0)
+    gp = trace["goodput"]
+    zb = schedule_account("zb", 4, 16)
+    f1b = schedule_account("1f1b", 4, 16)
+    return [
+        {
+            "metric": "chaos_goodput_self_heal_vs_die_restart",
+            "value": trace["goodput_improvement"],
+            "mttr_reduction": trace["mttr_reduction"],
+            "mttr_mean_s": trace["self_heal"]["mttr_mean_s"],
+            "baseline_mttr_mean_s": trace["die_and_restart"]["mttr_mean_s"],
+            "steps_saved": trace["steps_saved"],
+            "zero_lost_steps": trace["self_heal"]["lost_steps"] == 0,
+        },
+        {
+            "metric": "goodput_ledger_chaos_breakdown",
+            "value": gp["goodput_fraction"],
+            "breakdown_pct": gp["breakdown_pct"],
+            "sum_error_pct": gp["sum_error_pct"],
+            "alert_count": gp["slo"]["alert_count"],
+            "sum_to_wall_ok": gp["sum_error_pct"] < 1.0,
+        },
+        {
+            "metric": "pipeline_schedule_zb_vs_1f1b",
+            "ticks": zb["ticks"],
+            "busy_fraction": round(zb["busy_fraction"], 4),
+            "1f1b_busy_fraction": round(f1b["busy_fraction"], 4),
+            "burned_cost_vs_1f1b": round(
+                zb["burned_cost"] / f1b["burned_cost"], 3
+            ),
+        },
+    ]
+
+
+def read_lines(path: str) -> list[dict]:
+    """Parse ``bench.py`` output: one JSON object per non-empty line."""
+    fh = sys.stdin if path == "-" else open(path, encoding="utf-8")
+    try:
+        out = []
+        for raw in fh:
+            raw = raw.strip()
+            if not raw or not raw.startswith("{"):
+                continue
+            try:
+                obj = json.loads(raw)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict) and "metric" in obj:
+                out.append(obj)
+        return out
+    finally:
+        if fh is not sys.stdin:
+            fh.close()
+
+
+def compare(
+    lines: Iterable[dict],
+    baseline: dict[str, dict[str, float]],
+    threshold: float,
+    allow: Optional[set[str]] = None,
+) -> dict[str, Any]:
+    """Gate observed metric lines against the baseline scalars.
+
+    Returns ``{"ok", "regressions": [...], "new": [...], "checked": N}``;
+    a regression is any tracked key whose relative delta exceeds
+    ``threshold`` (absolute delta when the baseline value is 0)."""
+    allow = NOISY_KEYS | (allow or set())
+    regressions, new, checked = [], [], 0
+    for line in lines:
+        name = line["metric"]
+        base = baseline.get(name)
+        if base is None:
+            new.append({"metric": name})
+            continue
+        obs = _flatten(line)
+        for key, val in sorted(obs.items()):
+            if key in allow or key.split(".")[0] in allow:
+                continue
+            if key not in base:
+                new.append({"metric": name, "key": key, "observed": val})
+                continue
+            bv = float(base[key])
+            checked += 1
+            delta = abs(val - bv) if bv == 0 else abs(val - bv) / abs(bv)
+            if delta > threshold:
+                regressions.append({
+                    "metric": name,
+                    "key": key,
+                    "baseline": bv,
+                    "observed": val,
+                    "rel_delta": round(delta, 4),
+                })
+    return {
+        "ok": not regressions,
+        "threshold": threshold,
+        "checked": checked,
+        "regressions": regressions,
+        "new": new,
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--baseline",
+        default=os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "BASELINE.json",
+        ),
+    )
+    parser.add_argument(
+        "--input", default=None, metavar="PATH",
+        help="bench.py JSON-lines output to check ('-' = stdin)",
+    )
+    parser.add_argument(
+        "--run-quick", action="store_true",
+        help="re-derive the deterministic metrics in-process (CI mode)",
+    )
+    parser.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD)
+    parser.add_argument(
+        "--allow", action="append", default=[], metavar="KEY",
+        help="extra noisy key to skip (repeatable)",
+    )
+    parser.add_argument(
+        "--update", action="store_true",
+        help="write the observed scalars back as the new baseline",
+    )
+    args = parser.parse_args()
+    if not args.run_quick and args.input is None:
+        parser.error("one of --run-quick / --input is required")
+
+    lines = collect_quick() if args.run_quick else read_lines(args.input)
+    with open(args.baseline, encoding="utf-8") as f:
+        doc = json.load(f)
+    if args.update:
+        bench = doc.setdefault("bench", {})
+        for line in lines:
+            tracked = {
+                k: v for k, v in _flatten(line).items()
+                if k not in NOISY_KEYS and k.split(".")[0] not in NOISY_KEYS
+            }
+            if tracked:
+                bench[line["metric"]] = tracked
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        print(json.dumps({"updated": sorted(bench), "path": args.baseline}))
+        return
+
+    report = compare(
+        lines, doc.get("bench", {}), args.threshold, set(args.allow)
+    )
+    print(json.dumps(report, indent=2))
+    if not report["ok"]:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -133,6 +133,10 @@ class TrainCheckpointManager:
             raise
         finally:
             if self.trace_id is not None:
+                # "blocking" is the goodput-ledger contract: only a
+                # synchronous save displaces productive time; an async
+                # dispatch overlaps training and must not be charged to
+                # the checkpoint_save category.
                 tracing.get_recorder().record_span(
                     "checkpoint_save",
                     kind="checkpoint_save",
@@ -140,6 +144,7 @@ class TrainCheckpointManager:
                     t0=t0,
                     attrs={
                         "step": step, "wait": wait, "force": force,
+                        "blocking": bool(wait),
                         "outcome": outcome,
                     },
                 )

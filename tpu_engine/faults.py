@@ -167,6 +167,9 @@ class FaultInjector:
         # used to truncate silently at MAX_EVENTS — a consumer paging the
         # event list had no way to tell "quiet period" from "lost history".
         self.events_dropped = 0
+        # Cumulative reported host-slow stall seconds — the goodput
+        # ledger's host_slow category should reconcile against this.
+        self.host_slow_penalty_s_total = 0.0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -274,7 +277,9 @@ class FaultInjector:
         """Supervisor seam: reported step-time penalty (never an actual sleep)."""
         with self._lock:
             spec = self._take_locked(FaultKind.HOST_SLOW, step)
-            return float(spec.slow_s) if spec is not None else 0.0
+            pen = float(spec.slow_s) if spec is not None else 0.0
+            self.host_slow_penalty_s_total += pen
+            return pen
 
     def heal(self, device_index: int) -> int:
         """Clear active chip faults on a device; returns how many were healed."""
@@ -350,6 +355,9 @@ class FaultInjector:
                 "active_chip_faults": {},  # filled below without the lock
                 "counters": dict(self.counters),
                 "events_dropped": self.events_dropped,
+                "host_slow_penalty_s_total": round(
+                    self.host_slow_penalty_s_total, 6
+                ),
                 "events": [e.model_dump() for e in self.events[-50:]],
             }
 

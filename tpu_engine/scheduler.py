@@ -41,6 +41,7 @@ from typing import Any, Callable, Optional
 
 import jax
 
+from tpu_engine import goodput as goodput_mod
 from tpu_engine import tracing
 from tpu_engine.hbm_estimate import (
     HBMEstimate,
@@ -77,6 +78,17 @@ class SubmissionState(str, Enum):
 TERMINAL_STATES = frozenset(
     {SubmissionState.COMPLETED, SubmissionState.FAILED, SubmissionState.CANCELLED}
 )
+
+# Admission-wait histogram bucket upper bounds (seconds). Spans sub-second
+# idle-fleet admissions through multi-minute capacity waits; +Inf is
+# implicit in the exposition.
+WAIT_BUCKETS_S = (0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 1800.0)
+
+
+def _observe_hist(hist: dict[float, int], value: float) -> None:
+    for b in WAIT_BUCKETS_S:
+        if value <= b:
+            hist[b] += 1
 
 
 class QuotaExceeded(Exception):
@@ -171,9 +183,18 @@ class Submission:
         )
 
     def finish_trace(self, state: str) -> None:
-        """Close the lifecycle root span (idempotent)."""
+        """Close the lifecycle root span (idempotent), then settle the
+        submission's goodput account — terminal accounting drops the
+        ledger's per-trace cursor, so ledger memory is bounded by the
+        active set."""
         if self._root_span is not None and self._root_span.t1 is None:
             self._root_span.end(state=state)
+            try:
+                goodput_mod.get_ledger().finalize(
+                    tracing.get_recorder(), self.trace_id
+                )
+            except Exception:  # accounting must never break reaping
+                log.debug("goodput finalize failed", exc_info=True)
 
     @property
     def preemptible(self) -> bool:
@@ -302,6 +323,15 @@ class FleetScheduler:
         self.auto_admissions_total = 0
         self.no_estimate_skips_total = 0
         self._wait_samples: list[float] = []  # bounded; admitted-wait seconds
+        # Cumulative admission-wait histogram (Prometheus semantics: the
+        # bucket counts only grow, unlike the bounded sample window the
+        # mean gauges are computed from — both are exported).
+        self._wait_hist: dict[float, int] = {b: 0 for b in WAIT_BUCKETS_S}
+        self._wait_hist_sum = 0.0
+        self._wait_hist_count = 0
+        self._tenant_wait_hist: dict[str, dict[float, int]] = {}
+        self._tenant_wait_hist_sum: dict[str, float] = {}
+        self._tenant_wait_hist_count: dict[str, int] = {}
         # Per-submitter planes (the fairness follow-on needs a measured
         # baseline): admitted-wait samples and accumulated busy seconds
         # (admission → reap, summed across attempts — the goodput proxy).
@@ -401,6 +431,11 @@ class FleetScheduler:
                 "mesh": "auto" if auto_place else "explicit",
                 "workload": workload,
             },
+        )
+        # Goodput ledger hook: the trace is live from submit — queue wait
+        # accrues to the tenant from this moment, not from admission.
+        goodput_mod.get_ledger().track(
+            sub.trace_id, tenant=submitter, workload=workload
         )
         self._ensure_thread()
         self._wake.set()
@@ -780,6 +815,9 @@ class FleetScheduler:
 
         placement: list[int] = []
         shrunk_mesh = None
+        # The configured (pre-shrink) gang — the goodput ledger's
+        # healthy-mesh-equivalent baseline for the shrink-degraded split.
+        configured_gang = gang
         if eligible is not None:
             if gang > len(eligible):
                 # Elastic-shrink admission: a job with declared elastic
@@ -887,6 +925,7 @@ class FleetScheduler:
             attrs={
                 "attempt": sub.attempts,
                 "gang": gang,
+                "configured_gang": configured_gang,
                 "placement": list(placement),
                 "shrunk_mesh": sub.shrunk_mesh,
                 "auto_place": sub.auto_place,
@@ -918,10 +957,24 @@ class FleetScheduler:
                 self.planner.note_chosen(head)
         if sub.first_admitted_at is None:
             sub.first_admitted_at = time.time()
-            self._wait_samples.append(sub.wait_s or 0.0)
+            wait = sub.wait_s or 0.0
+            self._wait_samples.append(wait)
             del self._wait_samples[:-1000]
+            _observe_hist(self._wait_hist, wait)
+            self._wait_hist_sum += wait
+            self._wait_hist_count += 1
+            t_hist = self._tenant_wait_hist.setdefault(
+                sub.submitter, {b: 0 for b in WAIT_BUCKETS_S}
+            )
+            _observe_hist(t_hist, wait)
+            self._tenant_wait_hist_sum[sub.submitter] = (
+                self._tenant_wait_hist_sum.get(sub.submitter, 0.0) + wait
+            )
+            self._tenant_wait_hist_count[sub.submitter] = (
+                self._tenant_wait_hist_count.get(sub.submitter, 0) + 1
+            )
             waits = self._tenant_waits.setdefault(sub.submitter, [])
-            waits.append(sub.wait_s or 0.0)
+            waits.append(wait)
             del waits[:-200]
         self.admitted_total += 1
         job.start()
@@ -1142,6 +1195,14 @@ class FleetScheduler:
                 ),
                 "completed_total": self._tenant_completed.get(t, 0),
                 "goodput_busy_s": round(self._tenant_busy_s.get(t, 0.0), 3),
+                "wait_histogram": {
+                    "buckets": {
+                        str(b): c
+                        for b, c in self._tenant_wait_hist.get(t, {}).items()
+                    },
+                    "sum": round(self._tenant_wait_hist_sum.get(t, 0.0), 4),
+                    "count": self._tenant_wait_hist_count.get(t, 0),
+                },
             }
         return {
             "queue_depth": len(queued),
@@ -1153,6 +1214,11 @@ class FleetScheduler:
             "mean_admission_wait_s": (
                 round(sum(waits) / len(waits), 4) if waits else 0.0
             ),
+            "admission_wait_histogram": {
+                "buckets": {str(b): c for b, c in self._wait_hist.items()},
+                "sum": round(self._wait_hist_sum, 4),
+                "count": self._wait_hist_count,
+            },
             "submitted_total": self.submitted_total,
             "admitted_total": self.admitted_total,
             "preemptions_total": self.preemptions_total,

@@ -817,9 +817,19 @@ class ServingFleet:
                 sid: self._engine_router_stats(e) for sid, e in engines.items()
             })
             n_running = len(engines)
+            p99 = self.p99_latency_ms()
             desired = self.autoscaler.observe(
-                now, self.queue_depth(), self.p99_latency_ms(), n_running
+                now, self.queue_depth(), p99, n_running
             )
+            # Feed the fleet SLO alerter's serving-p99 window (burn-rate
+            # evaluation happens on the read path, not here).
+            if p99 is not None:
+                try:
+                    from tpu_engine import goodput as goodput_mod
+
+                    goodput_mod.get_alerter().observe_p99(p99, ts=now)
+                except Exception:  # alerting must never break serving
+                    pass
             # Only act on autoscaler output once the fleet has converged to
             # the previous desired count — scheduler admission latency must
             # not read as "need another replica".

@@ -518,6 +518,11 @@ class TrainingJob:
             parent=rec.trace_root(self.trace_id),
             attrs={"job_id": self.job_id},
         )
+        # Measured per-step wall total for this attempt — annotated onto the
+        # attempt span at close; the goodput ledger uses it as the cap on
+        # how much attempt time may count productive (untraced gaps fall to
+        # idle/unknown, not goodput).
+        attempt_step_s = 0.0
         try:
             self.status = JobStatus.COMPILING
             # Warm-start compiles across restarts: a preempted job that
@@ -657,6 +662,7 @@ class TrainingJob:
                 host = {k: float(v) for k, v in jax.device_get(metrics).items()}
                 self.profiler.mark("device")
                 dt = self.profiler.end_step()
+                attempt_step_s += dt
                 self.last_step_time_s = dt
                 self.tokens_per_sec = tokens_per_batch / dt if dt > 0 else None
                 # Feed the fleet's derived duty-cycle source: device-phase
@@ -852,6 +858,7 @@ class TrainingJob:
                 attempt_span.end(
                     status=self.status.value,
                     step=self.current_step,
+                    step_s=round(attempt_step_s, 6),
                     preemption_reason=self.preemption_reason,
                     error=self.error,
                     resumed_from_step=self.resumed_from_step,

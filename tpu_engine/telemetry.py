@@ -237,6 +237,11 @@ class DerivedDutySource:
         self._window = window
         self._max_age_s = max_age_s
         self._lock = threading.Lock()
+        # Staleness visibility: a dead telemetry source must be
+        # distinguishable from a never-alive one — age of the newest
+        # sample ever seen, plus how many scopes expired unread.
+        self._last_observed_at: Optional[float] = None
+        self.dropped_stale_total = 0
 
     def observe(
         self,
@@ -258,11 +263,41 @@ class DerivedDutySource:
         with self._lock:
             window, _ = self._scopes.get(key) or (deque(maxlen=self._window), 0.0)
             window.append((max(device_s, 0.0), wall_s))
-            self._scopes[key] = (window, time.time())
+            now = time.time()
+            self._scopes[key] = (window, now)
+            self._last_observed_at = now
 
     def reset(self) -> None:
         with self._lock:
             self._scopes.clear()
+            self._last_observed_at = None
+            self.dropped_stale_total = 0
+
+    def staleness(self) -> dict[str, Any]:
+        """Freshness surface: age of the newest sample (None = never fed),
+        per-scope ages, and how many scopes were silently expired — the
+        difference between "engine idle" and "telemetry wiring dead"."""
+        now = time.time()
+        with self._lock:
+            scope_ages = {
+                (
+                    "host"
+                    if key is None
+                    else ",".join(str(i) for i in sorted(key))
+                ): round(now - last, 3)
+                for key, (_, last) in self._scopes.items()
+            }
+            return {
+                "last_sample_age_s": (
+                    round(now - self._last_observed_at, 3)
+                    if self._last_observed_at is not None
+                    else None
+                ),
+                "scope_ages_s": scope_ages,
+                "scopes": len(scope_ages),
+                "max_age_s": self._max_age_s,
+                "dropped_stale_total": self.dropped_stale_total,
+            }
 
     def sample(self, n_chips: int) -> Optional[TelemetrySnapshot]:
         now = time.time()
@@ -271,6 +306,7 @@ class DerivedDutySource:
             for key, (window, last) in list(self._scopes.items()):
                 if now - last > self._max_age_s:
                     del self._scopes[key]  # stale scope: job gone idle
+                    self.dropped_stale_total += 1
                     continue
                 device = sum(d for d, _ in window)
                 wall = sum(w for _, w in window)

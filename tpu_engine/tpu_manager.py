@@ -170,6 +170,10 @@ class TPUFleetStatus(BaseModel):
     telemetry_sources: list[str] = Field(default_factory=list)
     # (location, score) per ICI link when the libtpu source reports them.
     ici_links: list[tuple[str, int]] = Field(default_factory=list)
+    # Derived-duty freshness (tpu_engine.telemetry.DerivedDutySource
+    # .staleness()): last-sample age + silently-expired scope count, so a
+    # dead telemetry feed is visible instead of quietly UNKNOWN.
+    telemetry_staleness: Optional[dict[str, Any]] = None
 
 
 class TPUManager:
@@ -524,6 +528,13 @@ class TPUManager:
         if not devices:
             fleet_alerts.append("No TPU devices detected")
 
+        from tpu_engine import telemetry as telemetry_mod
+
+        try:
+            staleness = telemetry_mod.derived_duty().staleness()
+        except Exception:
+            staleness = None
+
         return TPUFleetStatus(
             total_devices=len(devices),
             available_devices=available,
@@ -535,6 +546,7 @@ class TPUManager:
             fleet_alerts=fleet_alerts,
             telemetry_sources=telemetry_sources,
             ici_links=ici_links,
+            telemetry_staleness=staleness,
         )
 
     def select_best_device(

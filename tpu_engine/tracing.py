@@ -272,6 +272,25 @@ class FlightRecorder:
         self._persist(dict(ev, record="event"))
         return ev
 
+    def counter(
+        self,
+        name: str,
+        values: Dict[str, float],
+        trace_id: Optional[str] = None,
+        ts: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Record a counter sample: a named set of numeric series at one
+        timestamp. Stored as a ``kind="counter"`` event; the Chrome-trace
+        export renders it as a Perfetto counter track (``ph="C"``), so
+        goodput fraction / burn rate plot as stacked area charts next to
+        the span lanes that explain them."""
+        clean = {
+            k: float(v)
+            for k, v in values.items()
+            if isinstance(v, (int, float))
+        }
+        return self.event(name, kind="counter", trace_id=trace_id, ts=ts, attrs=clean)
+
     def _note_trace(self, trace_id: str, span_id: str, t0: float) -> None:
         # caller holds the lock
         if trace_id not in self._trace_roots:
@@ -547,6 +566,21 @@ class FlightRecorder:
         for e in events:
             trace = e["trace_id"] if e["trace_id"] is not None else "process"
             pid = _pid(trace)
+            if e["kind"] == "counter":
+                # Counter samples render as Perfetto counter tracks: one
+                # ph="C" event per sample, series stacked from args.
+                out.append(
+                    {
+                        "name": e["name"],
+                        "cat": e["kind"],
+                        "ph": "C",
+                        "ts": e["ts"] * 1e6,
+                        "pid": pid,
+                        "tid": 0,
+                        "args": dict(e["attrs"]),
+                    }
+                )
+                continue
             tid = _tid(trace, e["kind"])
             args = dict(e["attrs"])
             if e["parent_id"]:
