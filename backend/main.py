@@ -20,6 +20,7 @@ from aiohttp import web
 from backend import openapi
 from backend.http import cors_middleware, error_middleware, json_response
 from backend.routers import (
+    compile_cache,
     faults,
     goodput,
     metrics,
@@ -84,6 +85,10 @@ async def root(request: web.Request) -> web.Response:
                 "decomposition (productive/queue/compile/checkpoint/"
                 "restore/preempt/shrink/host-slow/idle) with SLO "
                 "burn-rate alerting and Perfetto counter tracks",
+                "fleet compile cache: layout-keyed warm-start index over "
+                "the persistent XLA cache, cache-aware placement ranking "
+                "and admission, and background precompile before "
+                "grow-back so preempt-resume pays a warm relink",
                 "continuous-batching serving with SSE token streaming, "
                 "prompt-prefix KV reuse, int8 weights/KV, and speculative "
                 "decoding",
@@ -101,6 +106,7 @@ async def root(request: web.Request) -> web.Response:
                 "profile": "/api/v1/profile",
                 "trace": "/api/v1/trace",
                 "goodput": "/api/v1/goodput",
+                "compile_cache": "/api/v1/compile-cache",
                 "metrics": "/metrics",
                 "openapi": "/openapi.json",
                 "docs": "/docs",
@@ -139,6 +145,7 @@ def create_app() -> web.Application:
     profiling.setup(app)
     tracing.setup(app)
     goodput.setup(app)
+    compile_cache.setup(app)
     serving.setup(app)
     metrics.setup(app)
     app.router.add_get("/", root)

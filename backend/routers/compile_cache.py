@@ -1,0 +1,51 @@
+"""Fleet compile-cache routes — the query surface for
+``tpu_engine/compile_index.py``:
+
+- ``GET /api/v1/compile-cache`` — the layout-keyed warm-start index (per-
+  layout entries with warm state and cold-compile EMAs, hit/miss totals,
+  sidecar path), the scheduler's precompile-before-grow-back counters and
+  the background :class:`PrecompileWorker` queue, and the XLA persistent
+  cache directory currently in use. ``?entries=0`` drops the per-layout
+  table for cheap polling.
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from backend import state
+from backend.http import json_response
+from tpu_engine import compile_cache as compile_cache_mod
+from tpu_engine import compile_index as compile_index_mod
+
+
+async def compile_cache_view(request: web.Request) -> web.Response:
+    sched = state.scheduler
+    index = getattr(sched, "compile_index", None) or compile_index_mod.get_index()
+    want_entries = request.query.get("entries", "1") not in ("0", "false")
+    sched_cc = (sched.stats() or {}).get("compile_cache", {})
+    return json_response(
+        {
+            "index": index.stats(),
+            "entries": index.entries() if want_entries else [],
+            "precompile": sched_cc.get("precompile", {}),
+            "scheduler": {
+                k: v
+                for k, v in sched_cc.items()
+                if k
+                in (
+                    "precompiles_started_total",
+                    "grow_back_warm_total",
+                    "grow_back_cold_total",
+                    "precompile_deadline_s",
+                    "precompile_before_grow",
+                )
+            },
+            "xla_cache_dir": compile_cache_mod.cache_dir_in_use(),
+            "runtime_fingerprint": compile_index_mod.runtime_fingerprint(),
+        }
+    )
+
+
+def setup(app: web.Application, prefix: str = "/api/v1") -> None:
+    app.router.add_get(f"{prefix}/compile-cache", compile_cache_view)

@@ -19,6 +19,16 @@ what training does meanwhile. Reports per-fault MTTR (time from fault to
 the next useful step) and goodput (useful full-mesh step-seconds per
 wall-second); ``bench.py`` reuses :func:`run_trace` for its chaos line.
 
+The self-heal resume overhead is split into admit + compile, and the
+compile leg is priced through a real (in-memory) ``CompileCacheIndex``:
+the first resume onto a given shrunk layout compiles cold, later resumes
+onto a layout the index has seen are warm cache hits, and grow-backs pay
+only the warm relink because the scheduler's background precompile runs
+the cold compile off the critical path. The same trace is replayed with
+the index off (every resume cold) — the on/off MTTR delta is the fleet
+compile cache's headline number. Compile spans carry ``cache_hit`` so the
+goodput lane's ``compile`` category splits warm vs cold.
+
 With ``--trace-out PATH`` the self-heal run also records its lifecycle in
 a ``FlightRecorder`` on the virtual clock — each fault's
 detect → emergency-save → requeue → shrink-admit → resume (→ grow-back)
@@ -39,6 +49,7 @@ from typing import Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from tpu_engine.compile_index import CompileCacheIndex  # noqa: E402
 from tpu_engine.faults import FaultKind, FaultPlan  # noqa: E402
 from tpu_engine.goodput import (  # noqa: E402
     CATEGORIES,
@@ -56,7 +67,9 @@ TOTAL_STEPS = 1_000
 STEP_TIME_S = 0.5          # full-mesh step time
 CKPT_INTERVAL_STEPS = 100  # periodic checkpoint cadence (both policies)
 CKPT_SAVE_S = 5.0          # synchronous save cost (periodic and emergency)
-RESUME_OVERHEAD_S = 20.0   # requeue + re-admit + recompile on a live plane
+RESUME_ADMIT_S = 5.0       # requeue + re-admit on a live plane
+COLD_COMPILE_S = 15.0      # XLA compile of a layout the cache has not seen
+WARM_COMPILE_S = 1.5       # persistent-cache hit: deserialize + relink only
 DIE_DETECT_S = 30.0        # external monitor poll latency (die-and-restart)
 DIE_RESTART_S = 120.0      # cold restart: reschedule + init + compile
 CHIP_RECOVERY_BASE_S = 60.0
@@ -92,10 +105,56 @@ def _usable(healthy: int) -> int:
     return max(MIN_CHIPS, (healthy // MODEL_AXIS) * MODEL_AXIS)
 
 
+def _layout_key(use: int) -> str:
+    """Index key for the shrunk-mesh layout running on ``use`` chips."""
+    return f"chaos|data{use // MODEL_AXIS}xfsdp{MODEL_AXIS}"
+
+
+def seed_initial_compile(index: CompileCacheIndex) -> None:
+    """The job's own startup compile put the full-mesh layout in the cache."""
+    index.record(
+        _layout_key(N_CHIPS), COLD_COMPILE_S, cache_hit=False,
+        label=_layout_key(N_CHIPS).split("|", 1)[1], model="chaos", via="chaos",
+    )
+
+
+def _resume_compile(index: Optional[CompileCacheIndex], use: int) -> tuple[float, bool]:
+    """Compile cost of a shrink-resume onto ``use`` chips: (seconds, warm)."""
+    if index is None:  # index off: a fresh process always compiles cold
+        return COLD_COMPILE_S, False
+    key = _layout_key(use)
+    if index.is_warm(key):
+        index.record(key, WARM_COMPILE_S, cache_hit=True, via="chaos")
+        return WARM_COMPILE_S, True
+    index.record(key, COLD_COMPILE_S, cache_hit=False,
+                 label=key.split("|", 1)[1], model="chaos", via="chaos")
+    return COLD_COMPILE_S, False
+
+
+def _grow_compile(index: Optional[CompileCacheIndex], use: int) -> tuple[float, bool]:
+    """Compile cost of a grow-back preempt-resume onto ``use`` chips.
+
+    With the index on, the scheduler precompiles the target layout in the
+    background *before* preempting (``precompile_before_grow``), so the
+    cold compile never lands on the critical path — the resume pays only
+    the warm relink either way; a never-seen layout is recorded as a
+    background precompile."""
+    if index is None:
+        return COLD_COMPILE_S, False
+    key = _layout_key(use)
+    if not index.is_warm(key):
+        index.record(key, COLD_COMPILE_S, cache_hit=False,
+                     label=key.split("|", 1)[1], model="chaos",
+                     via="precompile")
+    index.record(key, WARM_COMPILE_S, cache_hit=True, via="chaos")
+    return WARM_COMPILE_S, True
+
+
 def simulate_self_heal(
     events: list[dict],
     recorder: Optional[FlightRecorder] = None,
     trace_id: Optional[str] = None,
+    compile_index: Optional[CompileCacheIndex] = None,
 ) -> dict:
     clock = 0.0
     healthy = N_CHIPS
@@ -103,6 +162,9 @@ def simulate_self_heal(
     mttrs: list[float] = []
     grow_backs = 0
     degraded_s = 0.0
+    warm_resumes = 0
+    cold_resumes = 0
+    compile_s_total = 0.0
     i = 0
     # Flight-recorder lane (virtual-clock timestamps — the recorder takes
     # explicit t0/t1 everywhere for exactly this). Each fault's recovery
@@ -122,14 +184,26 @@ def simulate_self_heal(
             pending.pop(0)
             healthy += 1
             if _usable(healthy) > _usable(healthy - 1):
+                g_compile_s, g_warm = _grow_compile(compile_index, _usable(healthy))
+                g_admit_end = clock + CKPT_SAVE_S + RESUME_ADMIT_S
                 if recorder is not None:
                     recorder.record_span(
                         "grow_back", kind="admission", trace_id=trace_id,
-                        parent=chain_tail or root, t0=clock,
-                        t1=clock + CKPT_SAVE_S + RESUME_OVERHEAD_S,
+                        parent=chain_tail or root, t0=clock, t1=g_admit_end,
                         attrs={"step": step, "mesh": _usable(healthy)},
                     )
-                clock += CKPT_SAVE_S + RESUME_OVERHEAD_S
+                    recorder.record_span(
+                        "compile", kind="compile", trace_id=trace_id,
+                        parent=chain_tail or root, t0=g_admit_end,
+                        t1=g_admit_end + g_compile_s,
+                        attrs={"cache_hit": g_warm,
+                               "compile_s": g_compile_s,
+                               "layout": _layout_key(_usable(healthy))},
+                    )
+                clock = g_admit_end + g_compile_s
+                compile_s_total += g_compile_s
+                warm_resumes += 1 if g_warm else 0
+                cold_resumes += 0 if g_warm else 1
                 grow_backs += 1
         use = _usable(healthy)
         step_t = STEP_TIME_S * N_CHIPS / use
@@ -149,8 +223,11 @@ def simulate_self_heal(
             i += 1
             healthy -= 1
             # Detection is the in-band health check on this very step;
-            # emergency save persists `step`, shrink-resume follows.
-            down = CKPT_SAVE_S + RESUME_OVERHEAD_S
+            # emergency save persists `step`, shrink-resume follows. The
+            # compile leg is warm iff the index has seen this layout.
+            compile_s, warm = _resume_compile(compile_index, _usable(healthy))
+            down = CKPT_SAVE_S + RESUME_ADMIT_S + compile_s
+            admit_end = clock + CKPT_SAVE_S + RESUME_ADMIT_S
             if recorder is not None:
                 detect = recorder.record_span(
                     "detect", kind="fault", trace_id=trace_id, parent=root,
@@ -169,15 +246,24 @@ def simulate_self_heal(
                 )
                 admit = recorder.record_span(
                     "shrink_admit", kind="admission", trace_id=trace_id,
-                    parent=requeue, t0=clock + CKPT_SAVE_S, t1=clock + down,
+                    parent=requeue, t0=clock + CKPT_SAVE_S, t1=admit_end,
                     attrs={"step": step, "mesh": _usable(healthy)},
+                )
+                comp = recorder.record_span(
+                    "compile", kind="compile", trace_id=trace_id,
+                    parent=admit, t0=admit_end, t1=admit_end + compile_s,
+                    attrs={"cache_hit": warm, "compile_s": compile_s,
+                           "layout": _layout_key(_usable(healthy))},
                 )
                 chain_tail = recorder.record_span(
                     "resume", kind="supervisor", trace_id=trace_id,
-                    parent=admit, t0=clock + down, t1=clock + down,
+                    parent=comp, t0=clock + down, t1=clock + down,
                     attrs={"from_step": step},
                 )
             clock += down
+            compile_s_total += compile_s
+            warm_resumes += 1 if warm else 0
+            cold_resumes += 0 if warm else 1
             mttrs.append(step_t + down)
             pending.append(clock + ev["recovery_s"])
             pending.sort()
@@ -186,12 +272,16 @@ def simulate_self_heal(
         root.end(t1=wall, faults=len(mttrs), grow_backs=grow_backs)
     return {
         "policy": "self-heal",
+        "compile_index": compile_index is not None,
         "wall_s": round(wall, 1),
         "steps_run": TOTAL_STEPS,
         "lost_steps": 0,
         "faults": len(mttrs),
         "grow_backs": grow_backs,
         "degraded_step_s": round(degraded_s, 1),
+        "warm_resumes": warm_resumes,
+        "cold_resumes": cold_resumes,
+        "compile_s_total": round(compile_s_total, 1),
         "mttr_mean_s": round(sum(mttrs) / len(mttrs), 2) if mttrs else 0.0,
         "mttr_max_s": round(max(mttrs), 2) if mttrs else 0.0,
         "goodput": round(TOTAL_STEPS * STEP_TIME_S / wall, 4),
@@ -289,10 +379,15 @@ def goodput_lane(
             ts=t,
         )
         t += 60.0
+    split = d.get("compile_split") or {}
     return {
         "breakdown_s": {c: round(cats[c], 2) for c in CATEGORIES},
         "breakdown_pct": {
             c: round(100.0 * cats[c] / d["wall_s"], 2) for c in CATEGORIES
+        },
+        "compile_split_s": {
+            "warm_s": round(float(split.get("warm_s", 0.0)), 2),
+            "cold_s": round(float(split.get("cold_s", 0.0)), 2),
         },
         "wall_s": round(d["wall_s"], 1),
         "goodput_fraction": round(d["goodput_fraction"], 4),
@@ -318,9 +413,19 @@ def run_trace(
     recorder = recorder or FlightRecorder()
     trace_id = recorder.new_trace_id()
     events = chip_fault_trace(seed, n_faults=n_faults)
-    heal = simulate_self_heal(events, recorder=recorder, trace_id=trace_id)
+    # Primary lane: compile index ON (a real in-memory CompileCacheIndex,
+    # pre-seeded with the job's own startup compile). The same trace is
+    # replayed with the index OFF — every resume pays the cold compile.
+    index = CompileCacheIndex(path=None, default_cold_s=COLD_COMPILE_S)
+    seed_initial_compile(index)
+    heal = simulate_self_heal(
+        events, recorder=recorder, trace_id=trace_id, compile_index=index
+    )
+    heal_off = simulate_self_heal(events, compile_index=None)
     die = simulate_die_and_restart(events)
     goodput = goodput_lane(recorder, trace_id, heal["wall_s"])
+    mttr_on = heal["mttr_mean_s"]
+    mttr_off = heal_off["mttr_mean_s"]
     return {
         "seed": seed,
         "params": {
@@ -329,16 +434,31 @@ def run_trace(
             "total_steps": TOTAL_STEPS,
             "step_time_s": STEP_TIME_S,
             "ckpt_interval_steps": CKPT_INTERVAL_STEPS,
+            "resume_admit_s": RESUME_ADMIT_S,
+            "cold_compile_s": COLD_COMPILE_S,
+            "warm_compile_s": WARM_COMPILE_S,
         },
         "fault_events": events,
         "self_heal": heal,
+        "self_heal_index_off": heal_off,
         "die_and_restart": die,
         "goodput": goodput,
         "goodput_improvement": round(heal["goodput"] / die["goodput"], 3),
         "mttr_reduction": round(
-            die["mttr_mean_s"] / heal["mttr_mean_s"], 3
-        ) if heal["mttr_mean_s"] else None,
+            die["mttr_mean_s"] / mttr_on, 3
+        ) if mttr_on else None,
         "steps_saved": die["lost_steps"],
+        "compile_cache": {
+            "mttr_on_s": mttr_on,
+            "mttr_off_s": mttr_off,
+            "mttr_warm_reduction_pct": round(
+                100.0 * (1.0 - mttr_on / mttr_off), 2
+            ) if mttr_off else 0.0,
+            "warm_resumes": heal["warm_resumes"],
+            "cold_resumes": heal["cold_resumes"],
+            "wall_saved_s": round(heal_off["wall_s"] - heal["wall_s"], 1),
+            "index": index.stats(),
+        },
     }
 
 
@@ -363,10 +483,13 @@ def main() -> None:
         }
     print(json.dumps(trace, indent=2))
     gp = trace["goodput"]
+    cc = trace["compile_cache"]
     ok = (
         trace["self_heal"]["lost_steps"] == 0
         and trace["goodput_improvement"] > 1.0
         and (trace["mttr_reduction"] or 0.0) > 1.0
+        # The warm-start index must beat the index-off lane outright.
+        and cc["mttr_on_s"] < cc["mttr_off_s"]
         # Ledger invariant: the category breakdown re-derives the wall
         # clock from spans alone — must sum to it within 1%.
         and gp["sum_error_pct"] < 1.0
@@ -380,6 +503,17 @@ def main() -> None:
         "unit": "x goodput under faults (die-and-restart = 1.0)",
         "mttr_reduction": trace["mttr_reduction"],
         "zero_lost_steps": trace["self_heal"]["lost_steps"] == 0,
+        "ok": ok,
+    }))
+    print(json.dumps({
+        "metric": "chaos_compile_cache_warm_start",
+        "value": cc["mttr_warm_reduction_pct"],
+        "unit": "% MTTR reduction, compile index on vs off",
+        "mttr_on_s": cc["mttr_on_s"],
+        "mttr_off_s": cc["mttr_off_s"],
+        "warm_resumes": cc["warm_resumes"],
+        "cold_resumes": cc["cold_resumes"],
+        "wall_saved_s": cc["wall_saved_s"],
         "ok": ok,
     }))
     print(json.dumps({

@@ -1,27 +1,47 @@
 """Persistent XLA compilation cache (SURVEY.md §7 hard part c — warm-start
-compiles bound resume MTTR)."""
+compiles bound resume MTTR): structured enable results, resolution order,
+CPU-backend exclusion, the cache-unused latch, and explicit re-points."""
 
 import os
 
 import jax
 import jax.numpy as jnp
+import pytest
 
-from tpu_engine import compile_cache
+from tpu_engine import compile_cache, compile_index
+from tpu_engine.compile_cache import CacheEnableResult
+
+
+@pytest.fixture(autouse=True)
+def _fresh_index():
+    """Each test gets a pristine process-wide compile index — the enable
+    path attaches the index sidecar to the cache dir as a side effect."""
+    compile_index.reset_index()
+    yield
+    compile_index.reset_index()
 
 
 def test_enable_populates_cache(tmp_path, monkeypatch):
     d = str(tmp_path / "xla-cache")
     monkeypatch.setattr(compile_cache, "_enabled_dir", None)
     # force=True: the CPU test backend is normally excluded (see below).
-    assert compile_cache.enable_compilation_cache(d, force=True) == d
+    res = compile_cache.enable_compilation_cache(d, force=True)
+    assert res == d  # CacheEnableResult compares equal to its dir string
+    assert res.enabled and res.changed and not res.repointed
+    assert res.skipped_reason is None
     # Lower the threshold so this test's trivial compile qualifies.
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     f = jax.jit(lambda x: jnp.tanh(x @ x).sum())
     f(jnp.ones((64, 64))).block_until_ready()
     assert os.listdir(d), "no cache entries written"
-    # Idempotent re-enable keeps the directory.
-    assert compile_cache.enable_compilation_cache(d, force=True) == d
+    # Idempotent re-enable keeps the directory and reports changed=False.
+    again = compile_cache.enable_compilation_cache(d, force=True)
+    assert again == d and again.enabled and not again.changed
     assert compile_cache.cache_dir_in_use() == d
+    # Enabling attached the fleet index's sidecar next to the executables.
+    assert compile_index.get_index().stats()["sidecar_path"] == os.path.join(
+        d, compile_index.SIDECAR_NAME
+    )
 
 
 def test_env_var_resolution(tmp_path, monkeypatch):
@@ -32,15 +52,72 @@ def test_env_var_resolution(tmp_path, monkeypatch):
     assert os.path.isdir(d)
 
 
+def test_resolution_order_explicit_beats_env_beats_default(tmp_path, monkeypatch):
+    """Explicit argument > JAX_COMPILATION_CACHE_DIR > the local default."""
+    explicit = str(tmp_path / "explicit")
+    env = str(tmp_path / "env")
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", env)
+    # Explicit argument wins over the env var.
+    monkeypatch.setattr(compile_cache, "_enabled_dir", None)
+    assert compile_cache.enable_compilation_cache(explicit, force=True) == explicit
+    # Env var wins over the default.
+    monkeypatch.setattr(compile_cache, "_enabled_dir", None)
+    assert compile_cache.enable_compilation_cache(None, force=True) == env
+    # Neither → the local default (no mkdir assertion: HOME is real).
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR")
+    monkeypatch.setattr(compile_cache, "_enabled_dir", None)
+    assert (
+        compile_cache.enable_compilation_cache(None, force=True)
+        == compile_cache.DEFAULT_CACHE_DIR
+    )
+
+
 def test_cpu_backend_is_excluded_by_default(tmp_path, monkeypatch):
     """XLA:CPU AOT reloads don't round-trip machine features (observed
     interpreter SIGILLs in the CPU test mesh) — the cache only enables on
-    accelerator backends unless forced."""
+    accelerator backends unless forced. The skip is a structured result
+    now, falsy and naming its reason."""
     d = str(tmp_path / "cpu-skip")
     monkeypatch.setattr(compile_cache, "_enabled_dir", None)
-    assert compile_cache.enable_compilation_cache(d) is None
+    res = compile_cache.enable_compilation_cache(d)
+    assert isinstance(res, CacheEnableResult)
+    assert not res  # nothing enabled → falsy
+    assert res == None  # noqa: E711 — dir comparison, the legacy contract
+    assert res.skipped_reason == "cpu-backend"
     assert not os.path.exists(d)
     assert compile_cache.cache_dir_in_use() is None
+
+
+def test_cpu_skip_preserves_prior_enable(tmp_path, monkeypatch):
+    """A later un-forced call on CPU must not disturb an earlier forced
+    enable: the result still reports the active dir and stays truthy."""
+    d = str(tmp_path / "forced")
+    monkeypatch.setattr(compile_cache, "_enabled_dir", None)
+    assert compile_cache.enable_compilation_cache(d, force=True) == d
+    res = compile_cache.enable_compilation_cache(str(tmp_path / "other"))
+    assert res.skipped_reason == "cpu-backend"
+    assert res and res == d  # prior enable intact
+    assert compile_cache.cache_dir_in_use() == d
+
+
+def test_explicit_repoint_resets_and_flags(tmp_path, monkeypatch, caplog):
+    """Enabling with a *different* explicit dir is a deliberate re-point:
+    new executables land in the new dir, the transition is logged, and the
+    result carries repointed=True. Old entries are not migrated."""
+    a = str(tmp_path / "cache-a")
+    b = str(tmp_path / "cache-b")
+    monkeypatch.setattr(compile_cache, "_enabled_dir", None)
+    assert compile_cache.enable_compilation_cache(a, force=True) == a
+    with caplog.at_level("WARNING", logger=compile_cache.log.name):
+        res = compile_cache.enable_compilation_cache(b, force=True)
+    assert res == b and res.changed and res.repointed
+    assert compile_cache.cache_dir_in_use() == b
+    assert any("re-pointed" in r.message for r in caplog.records)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.jit(lambda x: jnp.sinh(x @ x).sum())(
+        jnp.ones((48, 48))
+    ).block_until_ready()
+    assert os.listdir(b), "post-re-point compile did not land in the new dir"
 
 
 def test_supervisor_enables_without_crashing(tmp_path, monkeypatch):

@@ -1,0 +1,502 @@
+"""Fleet compile cache: layout-keyed warm-start index, cache-aware
+placement ranking, and precompile-before-grow-back through the real
+FleetScheduler — all analytic / thread-stubbed, so everything is tier-1.
+
+The headline perf claims (chaos MTTR with the index on vs off, warm-
+preferring admission mean wait) are asserted here against the seeded
+virtual-clock benchmarks, so a refactor that erases the win fails CI.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from tpu_engine import compile_index, faults
+from tpu_engine.compile_index import (
+    SIDECAR_NAME,
+    CompileCacheIndex,
+    PrecompileWorker,
+    index_key,
+    key_for_config,
+    label_for_config,
+    model_digest,
+    runtime_fingerprint,
+)
+from tpu_engine.faults import FaultKind, FaultPlan, FaultSpec
+from tpu_engine.mesh_runtime import MeshConfig
+from tpu_engine.placement import PlacementPlanner
+from tpu_engine.scheduler import FleetScheduler, SubmissionState
+from tpu_engine.sharding import TPUTrainConfig
+from tpu_engine.supervisor import JobStatus
+from tpu_engine.tpu_manager import TPUManager
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_state():
+    """No fault plan or process-wide index leaks across tests."""
+    faults.clear_active()
+    compile_index.reset_index()
+    yield
+    faults.clear_active()
+    compile_index.reset_index()
+
+
+def cfg(**kw):
+    base = dict(
+        model_name="gpt-tiny",
+        mesh=MeshConfig(data=1, fsdp=2),
+        micro_batch_size=1,
+        seq_len=32,
+        precision="fp32",
+        total_steps=5,
+        activation_checkpointing=False,
+        checkpoint_dir="/tmp/compile_index_test",  # preemptibility flag only
+    )
+    base.update(kw)
+    return TPUTrainConfig(**base)
+
+
+def wait_until(pred, timeout=5.0, interval=0.01):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# index: keying, warm/cold ledger, EMA
+# ---------------------------------------------------------------------------
+
+
+def test_record_marks_warm_and_zeroes_expected_compile():
+    idx = CompileCacheIndex()
+    key = "digest|rt|data2xfsdp4·s3"
+    assert not idx.is_warm(key)
+    # Nothing measured anywhere yet → the pessimistic default.
+    assert idx.expected_compile_s(key) == idx.default_cold_s
+    idx.record(key, 12.0, cache_hit=False, label="data2xfsdp4·s3", model="gpt-tiny")
+    assert idx.is_warm(key)
+    assert idx.expected_compile_s(key) == 0.0  # warm → next admission is free
+    st = idx.stats()
+    assert st["entries"] == 1 and st["warm_entries"] == 1
+    assert st["misses_total"] == 1 and st["hits_total"] == 0
+    assert st["cold_compile_s_total"] == 12.0
+    # A later hit on the same layout counts as a hit, stays warm.
+    idx.record(key, 0.4, cache_hit=True)
+    assert idx.stats()["hits_total"] == 1 and idx.is_warm(key)
+
+
+def test_cold_ema_per_layout_with_global_fallback():
+    idx = CompileCacheIndex(ema_alpha=0.3)
+    idx.record("k1", 10.0, cache_hit=False)
+    assert idx.expected_cold_s("k1") == 10.0
+    idx.record("k1", 20.0, cache_hit=False)
+    # EMA: 0.7 * 10 + 0.3 * 20 = 13.0 (per-layout and global move together
+    # here — k1 is the only layout ever measured).
+    assert idx.expected_cold_s("k1") == pytest.approx(13.0)
+    # A never-seen layout predicts the global cold EMA, not the default.
+    assert idx.expected_compile_s("k-unseen") == pytest.approx(13.0)
+    assert idx.stats()["global_cold_ema_s"] == pytest.approx(13.0)
+
+
+def test_key_helpers_are_deterministic_and_layout_sensitive():
+    c = cfg(mesh=MeshConfig(data=2, fsdp=4))
+    assert key_for_config(c) == key_for_config(c)
+    assert runtime_fingerprint() in key_for_config(c)
+    assert model_digest(c) == model_digest(c)
+    # A different model shape digests differently …
+    assert model_digest(c) != model_digest(cfg(seq_len=64))
+    # … and a different mesh labels differently under the same digest.
+    lbl_a = label_for_config(c)
+    lbl_b = label_for_config(c, mesh={"data": 4, "fsdp": 2}, gang=8)
+    assert lbl_a != lbl_b
+    assert index_key(lbl_a, c) != index_key(lbl_b, c)
+
+
+def test_sidecar_round_trip_and_merge(tmp_path):
+    path = str(tmp_path / SIDECAR_NAME)
+    idx = CompileCacheIndex(path=path)
+    idx.record("k1", 7.0, cache_hit=False, label="lay1", model="gpt-tiny")
+    doc = json.loads((tmp_path / SIDECAR_NAME).read_text())
+    assert doc["version"] == 1 and "k1" in doc["entries"]
+    # A fresh process pointed at the same sidecar starts warm.
+    reborn = CompileCacheIndex(path=path)
+    assert reborn.is_warm("k1")
+    assert reborn.expected_cold_s("k1") == 7.0
+    # attach_dir merges what a previous process persisted without
+    # clobbering this process's own observations.
+    other = CompileCacheIndex()
+    other.record("k2", 3.0, cache_hit=False)
+    other.attach_dir(str(tmp_path))
+    assert other.is_warm("k1") and other.is_warm("k2")
+    assert other.stats()["sidecar_path"] == path
+    # … and persists the merged view back for the next process.
+    merged = json.loads((tmp_path / SIDECAR_NAME).read_text())
+    assert set(merged["entries"]) == {"k1", "k2"}
+
+
+def test_lru_bound_evicts_oldest(tmp_path):
+    clock = iter(range(100))
+    idx = CompileCacheIndex(
+        path=str(tmp_path / SIDECAR_NAME), max_entries=3,
+        clock=lambda: float(next(clock)),
+    )
+    for i in range(5):
+        idx.record(f"k{i}", 1.0, cache_hit=False)
+    st = idx.stats()
+    assert st["entries"] == 3 and st["evictions_total"] == 2
+    assert not idx.is_warm("k0") and not idx.is_warm("k1")
+    assert idx.is_warm("k4")
+    # The bound holds on disk too — the sidecar can never grow unbounded.
+    doc = json.loads((tmp_path / SIDECAR_NAME).read_text())
+    assert len(doc["entries"]) == 3
+
+
+def test_invalidate_drops_warmth():
+    idx = CompileCacheIndex()
+    idx.record("k1", 5.0, cache_hit=False)
+    idx.record("k2", 5.0, cache_hit=False)
+    assert idx.invalidate("k1") == 1
+    assert not idx.is_warm("k1") and idx.is_warm("k2")
+    assert idx.invalidate() == 1  # wipe-the-cache-dir path
+    assert idx.stats()["entries"] == 0
+
+
+# ---------------------------------------------------------------------------
+# planner: warm annotation + warm-first ranking band
+# ---------------------------------------------------------------------------
+
+
+def test_planner_annotates_warm_and_tiebreaks_within_band():
+    c = cfg(mesh=MeshConfig(data=2, fsdp=4), micro_batch_size=2)
+    idx = CompileCacheIndex()
+    # Unbounded band: ANY warm feasible layout outranks every cold one.
+    planner = PlacementPlanner(
+        compile_index=idx, prefer_warm_max_slowdown_pct=10_000.0
+    )
+    cold = planner.plan(c, n_avail=8)
+    assert len(cold.plans) >= 2
+    assert all(p.compile_warm is False for p in cold.plans)
+    assert all(p.expected_compile_s == idx.default_cold_s for p in cold.plans)
+    assert planner.warm_tiebreaks_total == 0
+    # Warm the layout the cold ranking put LAST; with the band wide open it
+    # must now rank first, and the planner counts the inversion.
+    slowest = cold.plans[-1]
+    idx.record(idx.key_for_plan(slowest), 9.0, cache_hit=False)
+    warm = planner.plan(c, n_avail=8)
+    assert warm.plans[0].label == slowest.label
+    assert warm.plans[0].compile_warm is True
+    assert warm.plans[0].expected_compile_s == 0.0
+    assert planner.warm_tiebreaks_total == 1
+
+
+def test_planner_band_bounds_the_warm_preference():
+    """A warm plan slower than the band never wins on warmth alone."""
+    c = cfg(mesh=MeshConfig(data=2, fsdp=4), micro_batch_size=2)
+    idx = CompileCacheIndex()
+    planner = PlacementPlanner(compile_index=idx, prefer_warm_max_slowdown_pct=0.0)
+    cold = planner.plan(c, n_avail=8)
+    fastest, slowest = cold.plans[0], cold.plans[-1]
+    assert fastest.predicted_step_time_s < slowest.predicted_step_time_s
+    idx.record(idx.key_for_plan(slowest), 9.0, cache_hit=False)
+    again = planner.plan(c, n_avail=8)
+    assert again.plans[0].label == fastest.label  # ranking unchanged
+    assert planner.warm_tiebreaks_total == 0
+    assert planner.stats()["warm_tiebreaks_total"] == 0
+    assert planner.stats()["compile_index_attached"] is True
+
+
+# ---------------------------------------------------------------------------
+# precompile worker: success, injected failure, bounded queue
+# ---------------------------------------------------------------------------
+
+
+def test_precompile_worker_warms_index():
+    idx = CompileCacheIndex()
+    compiled = []
+    worker = PrecompileWorker(idx, compile_fn=compiled.append)
+    try:
+        assert worker.request("k1", label="lay1") == "queued"
+        assert wait_until(lambda: worker.status("k1") == "warm")
+        assert idx.is_warm("k1")
+        assert compiled and compiled[0].key == "k1"
+        assert idx.entries()[0]["last_via"] == "precompile"
+        st = worker.stats()
+        assert st["completed_total"] == 1 and st["failed_total"] == 0
+        # Re-requesting a warm key is a no-op.
+        assert worker.request("k1") == "warm"
+    finally:
+        worker.shutdown()
+
+
+def test_precompile_worker_fails_under_injected_fault():
+    faults.activate(FaultPlan(
+        seed=7,
+        specs=[FaultSpec(kind=FaultKind.PRECOMPILE_ERROR, at_step=0)],
+    ))
+    idx = CompileCacheIndex()
+    compiled = []
+    worker = PrecompileWorker(idx, compile_fn=compiled.append)
+    try:
+        assert worker.request("k1") == "queued"
+        assert wait_until(lambda: worker.status("k1") == "failed")
+        assert not idx.is_warm("k1")
+        assert not compiled  # the fault fires before the compile attempt
+        assert worker.stats()["failed_total"] == 1
+        # The fault spec is spent (count=1): a retry succeeds.
+        assert worker.request("k1") == "queued"
+        assert wait_until(lambda: worker.status("k1") == "warm")
+        assert idx.is_warm("k1")
+    finally:
+        worker.shutdown()
+
+
+def test_precompile_worker_bounds_pending():
+    gate = threading.Event()
+    idx = CompileCacheIndex()
+    worker = PrecompileWorker(idx, compile_fn=lambda t: gate.wait(5.0), max_pending=1)
+    try:
+        assert worker.request("k1") == "queued"
+        assert worker.request("k2") == "rejected"
+        assert worker.stats()["rejected_total"] == 1
+    finally:
+        gate.set()
+        worker.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# scheduler: precompile-before-grow-back round trip
+# ---------------------------------------------------------------------------
+
+
+class StubWatcher:
+    def __init__(self):
+        self.fired = threading.Event()
+
+    def simulate_interruption(self):
+        self.fired.set()
+
+
+class StubJob:
+    """Thread-backed TrainingJob stand-in (see tests/test_scheduler.py)."""
+
+    def __init__(self, sub):
+        self.job_id = sub.job_id
+        self.config = sub.config
+        self.status = JobStatus.PENDING
+        self.error = None
+        self.current_step = 0
+        self.watcher = StubWatcher()
+        self._stop = threading.Event()
+        self._done = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    @property
+    def is_alive(self):
+        return self._thread.is_alive()
+
+    def start(self):
+        self._thread.start()
+
+    def join(self, timeout=None):
+        self._thread.join(timeout)
+
+    def describe(self):
+        return {"job_id": self.job_id, "status": self.status.value}
+
+    def finish(self):
+        self._done.set()
+
+    def _run(self):
+        self.status = JobStatus.RUNNING
+        while not self._done.is_set():
+            if self._stop.is_set():
+                self.status = JobStatus.STOPPED
+                return
+            if self.watcher.fired.is_set():
+                self.status = JobStatus.PREEMPTED  # the "emergency save"
+                return
+            self._done.wait(0.005)
+        self.status = JobStatus.COMPLETED
+
+
+def _chip(i, **kw):
+    base = dict(
+        index=i, device_kind="TPU v5e", hbm_total_gb=16.0, hbm_used_gb=4.0,
+        duty_cycle_pct=50.0, temperature_c=50.0,
+    )
+    base.update(kw)
+    return base
+
+
+def _degraded_fleet():
+    mgr = TPUManager()
+    return mgr.get_fleet_status(
+        metrics=[_chip(0, temperature_c=91.0)] + [_chip(i) for i in range(1, 8)]
+    )
+
+
+def _healthy_fleet():
+    mgr = TPUManager()
+    return mgr.get_fleet_status(metrics=[_chip(i) for i in range(8)])
+
+
+@pytest.fixture
+def sched_factory():
+    created = []
+
+    def make(**kw):
+        jobs = []
+
+        def factory(sub):
+            job = StubJob(sub)
+            jobs.append(job)
+            return job
+
+        kw.setdefault("job_factory", factory)
+        kw.setdefault("poll_interval_s", 0.01)
+        kw.setdefault("grow_back_cooldown_s", 0.0)
+        s = FleetScheduler(**kw)
+        s._stub_jobs = jobs
+        created.append(s)
+        return s
+
+    yield make
+    for s in created:
+        for j in getattr(s, "_stub_jobs", []):
+            j.finish()
+        s.shutdown()
+
+
+def elastic_cfg(**kw):
+    base = dict(mesh=MeshConfig(data=4, fsdp=2), elastic_min_devices=2)
+    base.update(kw)
+    return cfg(**base)
+
+
+def _grow_back_round_trip(sched_factory, **sched_kw):
+    """Shrunk admission on a degraded fleet, heal, grow back to the full
+    gang, complete — returns the scheduler for counter assertions."""
+    fleet_holder = {"fleet": _degraded_fleet()}
+    s = sched_factory(
+        max_concurrent_jobs=1, fleet_fn=lambda: fleet_holder["fleet"], **sched_kw
+    )
+    sub = s.submit(elastic_cfg())
+    assert wait_until(lambda: sub.state == SubmissionState.RUNNING)
+    assert sub.admitted_gang == 6
+    fleet_holder["fleet"] = _healthy_fleet()
+    assert wait_until(
+        lambda: sub.state == SubmissionState.RUNNING and sub.admitted_gang == 8,
+        timeout=10.0,
+    )
+    assert sub.shrunk_mesh is None and sub.attempts == 2
+    # Round trip intact: the resize was checkpoint-requeue-readmit, nothing
+    # was dropped, and the job can run to completion on the full gang.
+    s._stub_jobs[-1].finish()
+    assert wait_until(lambda: sub.state == SubmissionState.COMPLETED)
+    assert s.stats()["reserved_hbm_gib"] == 0.0
+    return s
+
+
+def test_grow_back_waits_for_background_precompile(sched_factory):
+    idx = CompileCacheIndex()
+    warmed = []
+    s = _grow_back_round_trip(
+        sched_factory, compile_index=idx, precompile_fn=warmed.append
+    )
+    st = s.stats()
+    cc = st["compile_cache"]
+    assert st["grow_backs_total"] == 1
+    # The grow was gated: a background precompile of the target layout ran
+    # first, and the preempt only fired once the index said warm.
+    assert cc["precompiles_started_total"] == 1
+    assert cc["grow_back_warm_total"] == 1 and cc["grow_back_cold_total"] == 0
+    assert cc["precompile"]["completed_total"] == 1
+    assert len(warmed) == 1 and warmed[0].gang == 8
+    assert idx.is_warm(warmed[0].key)
+    assert idx.entries()[0]["last_via"] == "precompile"
+
+
+def test_grow_back_proceeds_cold_under_precompile_error(sched_factory):
+    """An injected precompile-error must delay the grow-back, never wedge
+    it: the resize proceeds cold and the job still completes."""
+    faults.activate(FaultPlan(
+        seed=7,
+        specs=[FaultSpec(kind=FaultKind.PRECOMPILE_ERROR, at_step=0, count=5)],
+    ))
+    idx = CompileCacheIndex()
+    warmed = []
+    s = _grow_back_round_trip(
+        sched_factory, compile_index=idx, precompile_fn=warmed.append
+    )
+    cc = s.stats()["compile_cache"]
+    assert s.stats()["grow_backs_total"] == 1
+    assert cc["precompiles_started_total"] >= 1
+    assert cc["grow_back_cold_total"] == 1 and cc["grow_back_warm_total"] == 0
+    assert cc["precompile"]["failed_total"] >= 1
+    assert not warmed  # the fault fires before the compile body
+
+
+def test_grow_back_deadline_unwedges_a_stuck_precompile(sched_factory):
+    """A precompiler that never finishes only holds the resize until the
+    deadline; then the grow proceeds cold."""
+    gate = threading.Event()
+    idx = CompileCacheIndex()
+    s = _grow_back_round_trip(
+        sched_factory,
+        compile_index=idx,
+        precompile_fn=lambda t: gate.wait(30.0),
+        precompile_deadline_s=0.2,
+    )
+    gate.set()
+    cc = s.stats()["compile_cache"]
+    assert cc["grow_back_cold_total"] == 1 and cc["grow_back_warm_total"] == 0
+
+
+def test_grow_back_gate_disabled_is_the_old_behavior(sched_factory):
+    called = []
+    s = _grow_back_round_trip(
+        sched_factory,
+        precompile_before_grow=False,
+        compile_index=CompileCacheIndex(),
+        precompile_fn=called.append,
+    )
+    cc = s.stats()["compile_cache"]
+    assert not called
+    assert cc["precompiles_started_total"] == 0
+    assert cc["grow_back_warm_total"] == 0 and cc["grow_back_cold_total"] == 0
+
+
+# ---------------------------------------------------------------------------
+# headline numbers: the benches must keep showing the win
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_mttr_lower_with_index_on():
+    from benchmarks.chaos import run_trace
+
+    trace = run_trace(seed=0)
+    cc = trace["compile_cache"]
+    assert cc["mttr_on_s"] < cc["mttr_off_s"]
+    assert cc["mttr_warm_reduction_pct"] > 0
+    assert cc["warm_resumes"] > 0 and cc["wall_saved_s"] > 0
+    # Warm-start must not cost correctness: still zero lost steps.
+    assert trace["self_heal"]["lost_steps"] == 0
+    assert trace["self_heal_index_off"]["lost_steps"] == 0
+
+
+def test_warm_admission_sim_reduces_mean_wait():
+    from benchmarks.scheduler_sim import run_warm_admission
+
+    res = run_warm_admission(seed=0)
+    assert res["mean_wait_warm_s"] < res["mean_wait_fifo_s"]
+    assert res["wait_reduction_pct"] > 0
+    # Honest win: same work, same compiles — only the order changes.
+    assert res["warm_preferring"]["cold_compiles"] == res["fifo"]["cold_compiles"]
+    assert res["warm_preferring"]["makespan_s"] == pytest.approx(
+        res["fifo"]["makespan_s"]
+    )

@@ -83,10 +83,13 @@ def _flatten(line: dict) -> dict[str, float]:
 def collect_quick() -> list[dict]:
     """Re-derive the deterministic bench lines in-process (no timing)."""
     from benchmarks.chaos import run_trace as chaos_trace
+    from benchmarks.scheduler_sim import run_warm_admission
     from tpu_engine.parallel.pipeline_zb import schedule_account
 
     trace = chaos_trace(seed=0)
     gp = trace["goodput"]
+    cc = trace["compile_cache"]
+    warm = run_warm_admission(seed=0)
     zb = schedule_account("zb", 4, 16)
     f1b = schedule_account("1f1b", 4, 16)
     return [
@@ -106,6 +109,18 @@ def collect_quick() -> list[dict]:
             "sum_error_pct": gp["sum_error_pct"],
             "alert_count": gp["slo"]["alert_count"],
             "sum_to_wall_ok": gp["sum_error_pct"] < 1.0,
+        },
+        {
+            "metric": "compile_cache_warm_start",
+            "value": cc["mttr_warm_reduction_pct"],
+            "mttr_on_s": cc["mttr_on_s"],
+            "mttr_off_s": cc["mttr_off_s"],
+            "warm_resumes": cc["warm_resumes"],
+            "cold_resumes": cc["cold_resumes"],
+            "wall_saved_s": cc["wall_saved_s"],
+            "mean_wait_fifo_s": warm["mean_wait_fifo_s"],
+            "mean_wait_warm_s": warm["mean_wait_warm_s"],
+            "wait_reduction_pct": warm["wait_reduction_pct"],
         },
         {
             "metric": "pipeline_schedule_zb_vs_1f1b",

@@ -9,13 +9,17 @@ them across processes, so the supervisor's resume path costs restore + one
 
 Enabled by the worker CLI and by every supervised job
 (``tpu_engine/supervisor.py``); idempotent and safe to call at any point —
-JAX consults the cache per compilation, not at backend init.
+JAX consults the cache per compilation, not at backend init. The fleet-level
+warm/cold bookkeeping over this cache lives in
+``tpu_engine/compile_index.py`` — enabling here attaches that index's JSON
+sidecar to the cache dir.
 """
 
 from __future__ import annotations
 
 import logging
 import os
+from dataclasses import dataclass
 from typing import Optional
 
 log = logging.getLogger(__name__)
@@ -27,17 +31,58 @@ DEFAULT_CACHE_DIR = os.path.join(
 _enabled_dir: Optional[str] = None
 
 
+@dataclass(frozen=True, eq=False)
+class CacheEnableResult:
+    """Structured outcome of :func:`enable_compilation_cache`.
+
+    ``dir`` is the directory the cache is active with after this call (None
+    when nothing is enabled); ``changed`` means this call touched JAX config
+    (first enable, or a re-point); ``repointed`` flags the explicit
+    already-enabled → different-explicit-dir transition; ``skipped_reason``
+    names why the call was a no-op (currently only ``"cpu-backend"``).
+
+    Compares equal to the directory string (and to None when nothing is
+    enabled) so existing ``enable_compilation_cache(d) == d`` call sites
+    keep working; truthiness is "the cache is enabled".
+    """
+
+    dir: Optional[str]
+    enabled: bool
+    changed: bool = False
+    repointed: bool = False
+    skipped_reason: Optional[str] = None
+
+    def __bool__(self) -> bool:
+        return self.enabled
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, CacheEnableResult):
+            return (self.dir, self.enabled, self.changed, self.repointed,
+                    self.skipped_reason) == (
+                        other.dir, other.enabled, other.changed,
+                        other.repointed, other.skipped_reason)
+        if other is None or isinstance(other, str):
+            return self.dir == other
+        return NotImplemented
+
+
 def enable_compilation_cache(
     cache_dir: Optional[str] = None, force: bool = False
-) -> Optional[str]:
+) -> CacheEnableResult:
     """Point JAX's persistent compilation cache at ``cache_dir`` (idempotent).
 
     Resolution order: explicit argument > ``JAX_COMPILATION_CACHE_DIR`` env
     (set by infra/tpu-jobset.yaml onto a persistent volume) > the local
-    default. Returns the directory in use, or None when skipped. The
-    thresholds are lowered so the train step (which takes seconds to
-    minutes to compile) always qualifies, while trivial sub-second compiles
-    stay out of the cache.
+    default. Returns a :class:`CacheEnableResult`. The thresholds are
+    lowered so the train step (which takes seconds to minutes to compile)
+    always qualifies, while trivial sub-second compiles stay out of the
+    cache.
+
+    Calling again with a *different* explicit directory is an explicit
+    **re-point**: the cache singleton is reset (so executables land in the
+    new directory, not the first one), the transition is logged, and the
+    result carries ``repointed=True``. Entries already written to the old
+    directory are not migrated.
 
     NOT enabled on the CPU backend unless ``force``: XLA:CPU AOT reloads
     are compiled with machine-feature sets that do not round-trip
@@ -52,13 +97,24 @@ def enable_compilation_cache(
         or DEFAULT_CACHE_DIR
     )
     if _enabled_dir == d:
-        return d
+        return CacheEnableResult(dir=d, enabled=True, changed=False)
     import jax
 
     if not force and jax.default_backend() == "cpu":
         log.info("CPU backend: persistent compilation cache not enabled")
-        return None
+        return CacheEnableResult(
+            dir=_enabled_dir,
+            enabled=_enabled_dir is not None,
+            skipped_reason="cpu-backend",
+        )
 
+    repointed = _enabled_dir is not None
+    if repointed:
+        log.warning(
+            "persistent XLA compilation cache re-pointed: %s -> %s "
+            "(existing entries are not migrated)",
+            _enabled_dir, d,
+        )
     os.makedirs(d, exist_ok=True)
     prev = getattr(jax.config, "jax_compilation_cache_dir", None)
     jax.config.update("jax_compilation_cache_dir", d)
@@ -78,7 +134,15 @@ def enable_compilation_cache(
             log.warning("could not reset jax compilation cache singleton")
     _enabled_dir = d
     log.info("persistent XLA compilation cache: %s", d)
-    return d
+    # The fleet compile index persists its layout-keyed sidecar next to the
+    # executables it describes — warmth then survives the process.
+    try:
+        from tpu_engine.compile_index import get_index
+
+        get_index().attach_dir(d)
+    except Exception:  # the index must never break cache enablement
+        log.debug("compile index sidecar attach failed", exc_info=True)
+    return CacheEnableResult(dir=d, enabled=True, changed=True, repointed=repointed)
 
 
 def cache_dir_in_use() -> Optional[str]:

@@ -39,7 +39,7 @@ from tpu_engine import tracing
 
 
 class FaultKind(str, enum.Enum):
-    """The six injectable fault types (ISSUE archetype: robustness)."""
+    """The seven injectable fault types (ISSUE archetype: robustness)."""
 
     CHIP_UNHEALTHY = "chip-unhealthy"
     HOST_SLOW = "host-slow"
@@ -47,6 +47,7 @@ class FaultKind(str, enum.Enum):
     CHECKPOINT_RESTORE_CORRUPTION = "checkpoint-restore-corruption"
     TELEMETRY_NAN = "telemetry-nan"
     PREEMPTION_SIGNAL = "preemption-signal"
+    PRECOMPILE_ERROR = "precompile-error"
 
 
 # Kinds that attach to a specific chip and stay active until healed/expired.
@@ -58,6 +59,7 @@ _CONSUMABLE_KINDS = frozenset(
         FaultKind.CHECKPOINT_RESTORE_CORRUPTION,
         FaultKind.PREEMPTION_SIGNAL,
         FaultKind.HOST_SLOW,
+        FaultKind.PRECOMPILE_ERROR,
     }
 )
 
@@ -71,7 +73,8 @@ class FaultSpec(BaseModel):
     `telemetry-nan`) name a ``device_index`` (fleet snapshot index) and stay
     active for ``duration_steps`` observed steps — or until
     :meth:`FaultInjector.heal` — modelling a chip that recovers. Consumable
-    faults (save/restore/preempt/host-slow) fire ``count`` times then spend.
+    faults (save/restore/preempt/host-slow/precompile) fire ``count`` times
+    then spend.
     """
 
     kind: FaultKind
@@ -116,9 +119,16 @@ class FaultPlan(BaseModel):
         max_step: int = 50,
         n_devices: int = 8,
     ) -> "FaultPlan":
-        """Reproducible random plan: same seed → identical specs."""
+        """Reproducible random plan: same seed → identical specs.
+
+        ``precompile-error`` is a scheduler-side fault (the background
+        precompile worker's seam), not a per-training-step fault, and is
+        excluded from the draw so every seeded plan — and every chaos
+        trace derived from one — stays byte-identical across the kind's
+        introduction. Inject it with an explicit :class:`FaultSpec`.
+        """
         rng = random.Random(seed)
-        kinds = list(FaultKind)
+        kinds = [k for k in FaultKind if k is not FaultKind.PRECOMPILE_ERROR]
         specs = []
         for _ in range(n_faults):
             kind = rng.choice(kinds)
@@ -262,6 +272,13 @@ class FaultInjector:
         """Checkpoint seam: consume one save-IOError fault if due."""
         with self._lock:
             return self._take_locked(FaultKind.CHECKPOINT_SAVE_IOERROR, step) is not None
+
+    def take_precompile_fault(self, step: int) -> bool:
+        """Precompile-worker seam: consume one precompile-error fault if due
+        (:class:`~tpu_engine.compile_index.PrecompileWorker` consults this
+        before every background AOT attempt)."""
+        with self._lock:
+            return self._take_locked(FaultKind.PRECOMPILE_ERROR, step) is not None
 
     def take_restore_fault(self, step: int) -> bool:
         """Checkpoint seam: consume one restore-corruption fault if due."""

@@ -151,6 +151,7 @@ def decompose_trace(
         return {
             "wall_s": 0.0, "categories": empty, "segments": [],
             "goodput_fraction": None, "sum_error_s": 0.0,
+            "compile_split": {"warm_s": 0.0, "cold_s": 0.0},
         }
 
     def span_end(s: Dict[str, Any]) -> float:
@@ -158,6 +159,8 @@ def decompose_trace(
 
     # -- overlay intervals per category --------------------------------------
     overlays: Dict[str, List[Tuple[float, float]]] = {c: [] for c in _OVERLAY_PRIORITY}
+    compile_warm_raw = 0.0  # raw (pre-sweep) compile span seconds by verdict
+    compile_cold_raw = 0.0
     admissions = sorted(
         (s for s in spans if s["kind"] == "admission"), key=lambda s: s["t0"]
     )
@@ -175,6 +178,14 @@ def decompose_trace(
         iv = _clip(s["t0"], span_end(s), w0, w1)
         if iv:
             overlays[cat].append(iv)
+            # Warm/cold sub-attribution of compile time: the supervisor's
+            # compile spans carry a ``cache_hit`` attr (fed by the fleet
+            # compile index); missing/false counts cold — pessimistic.
+            if cat == "compile":
+                if s["attrs"].get("cache_hit"):
+                    compile_warm_raw += iv[1] - iv[0]
+                else:
+                    compile_cold_raw += iv[1] - iv[0]
     for e in events:
         # Host-slow faults are *reported* stalls: the supervisor records
         # the event right after the step, penalty carried in attrs — the
@@ -342,12 +353,21 @@ def decompose_trace(
 
     wall = w1 - w0
     total = sum(cats.values())
+    # Proportional warm/cold split of the swept compile seconds: raw span
+    # seconds may overlap (double compiles across attempts) but the sweep
+    # assigned each elementary segment once — scale the raw verdict mix
+    # onto the disjoint total so warm_s + cold_s == categories["compile"]
+    # exactly and the 9-category sum-to-wall invariant is untouched.
+    comp = cats["compile"]
+    raw = compile_warm_raw + compile_cold_raw
+    warm_s = comp * compile_warm_raw / raw if comp > 0 and raw > 0 else 0.0
     return {
         "wall_s": wall,
         "categories": cats,
         "segments": segments,
         "goodput_fraction": (cats["productive"] / wall) if wall > 0 else None,
         "sum_error_s": total - wall,
+        "compile_split": {"warm_s": warm_s, "cold_s": comp - warm_s},
     }
 
 
@@ -399,6 +419,11 @@ class GoodputLedger:
         self.traces_accounted = 0
         self.invariant_violations = 0
         self.accounted_wall_s = 0.0
+        # Warm/cold sub-attribution of the `compile` category (fed by the
+        # decomposition's per-span cache_hit verdicts; never a 10th
+        # category — warm_s + cold_s tracks categories["compile"]).
+        self._compile_warm_s = 0.0
+        self._compile_cold_s = 0.0
 
     # -- tracking ------------------------------------------------------------
 
@@ -466,9 +491,12 @@ class GoodputLedger:
         tenant: str = "anonymous",
         workload: str = "training",
         ts: Optional[float] = None,
+        compile_warm: Optional[bool] = None,
     ) -> None:
         """Explicit-timestamp escape hatch: fold ``seconds`` of ``category``
-        ending at ``ts`` without a trace (sims, external accounting)."""
+        ending at ``ts`` without a trace (sims, external accounting).
+        ``compile_warm`` attributes a ``compile`` contribution to the
+        warm/cold sub-split."""
         if category not in CATEGORIES:
             raise ValueError(f"unknown goodput category {category!r}")
         if seconds <= 0:
@@ -477,6 +505,11 @@ class GoodputLedger:
         with self._lock:
             self._fold_segment(ts - seconds, ts, category, 1.0, tenant, workload)
             self.accounted_wall_s += seconds
+            if category == "compile" and compile_warm is not None:
+                if compile_warm:
+                    self._compile_warm_s += seconds
+                else:
+                    self._compile_cold_s += seconds
 
     def account_trace(
         self,
@@ -526,6 +559,9 @@ class GoodputLedger:
             for a, b, cat, wgt in d["segments"]:
                 self._fold_segment(a, b, cat, wgt, meta["tenant"], meta["workload"])
             self.accounted_wall_s += d["wall_s"]
+            split = d.get("compile_split") or {}
+            self._compile_warm_s += float(split.get("warm_s", 0.0))
+            self._compile_cold_s += float(split.get("cold_s", 0.0))
             if abs(d["sum_error_s"]) > self.tolerance * max(d["wall_s"], 1e-9):
                 self.invariant_violations += 1
             if final:
@@ -610,6 +646,10 @@ class GoodputLedger:
                 "traces_accounted": self.traces_accounted,
                 "invariant_violations": self.invariant_violations,
                 "accounted_wall_s": round(self.accounted_wall_s, 3),
+                "compile_split": {
+                    "warm_s": round(self._compile_warm_s, 3),
+                    "cold_s": round(self._compile_cold_s, 3),
+                },
             }
 
 

@@ -92,6 +92,12 @@ class PlacementPlan(BaseModel):
     predicted_comm_s: float  # total collective seconds (streamed + exposed)
     predicted_exposed_comm_s: float = 0.0  # critical-path share of the above
     predicted_step_time_s: float
+    # Compile-cache verdict (None/0 when the planner has no index): is this
+    # exact layout warm in the persistent XLA cache, and what cold-compile
+    # cost does admission pay when it is not (per-layout EMA of measured
+    # cold compiles — see tpu_engine/compile_index.py).
+    compile_warm: Optional[bool] = None
+    expected_compile_s: float = 0.0
     hbm_estimate: Optional[HBMEstimate] = None
     feasible: bool = True
     skip_reason: Optional[str] = None
@@ -143,6 +149,8 @@ class PlannerResult(BaseModel):
                 "predicted_step_time_s": round(p.predicted_step_time_s, 6),
                 "predicted_bubble_fraction": round(p.predicted_bubble_fraction, 4),
                 "predicted_comm_s": round(p.predicted_comm_s, 6),
+                "compile_warm": p.compile_warm,
+                "expected_compile_s": round(p.expected_compile_s, 3),
                 "hbm_gib_per_device": (
                     round(p.hbm_estimate.device_total_gib, 3)
                     if p.hbm_estimate else None
@@ -249,6 +257,8 @@ class PlacementPlanner:
         ),
         max_gang_enumeration: int = 16,
         hbm_margin_frac: float = 0.35,
+        compile_index: Optional[Any] = None,
+        prefer_warm_max_slowdown_pct: float = 5.0,
     ):
         if peak_flops is None:
             try:
@@ -279,6 +289,13 @@ class PlacementPlanner:
         # anything beyond it. The gate charges every estimate this
         # fraction on top before comparing to headroom.
         self.hbm_margin_frac = hbm_margin_frac
+        # Compile-cache awareness: with an index attached, every candidate
+        # is annotated warm/cold and the ranking tie-breaks toward warm
+        # layouts — a warm plan may be preferred over a cold one predicted
+        # up to ``prefer_warm_max_slowdown_pct`` percent faster (the cold
+        # plan's one-time compile usually dwarfs that step-time edge).
+        self.compile_index = compile_index
+        self.prefer_warm_max_slowdown_pct = prefer_warm_max_slowdown_pct
 
         self._lock = threading.Lock()
         self.plans_evaluated_total = 0
@@ -286,6 +303,7 @@ class PlacementPlanner:
         self.plans_hbm_rejected_total = 0
         self.plans_chosen_total = 0
         self.no_estimate_refusals_total = 0
+        self.warm_tiebreaks_total = 0
         self.prune_reasons: dict[str, int] = {}
         self.last_feasible = 0
         self.last_chosen_predicted_s: Optional[float] = None
@@ -535,7 +553,7 @@ class PlacementPlanner:
             + dcn_bytes / self.dcn_bytes_s
         )
         comm_s = stream_s + exposed_s
-        return PlacementPlan(
+        plan = PlacementPlan(
             mesh={
                 "data": data, "fsdp": fsdp, "pipe": pipe,
                 "sequence": seq_axis, "model": model_ax,
@@ -555,6 +573,14 @@ class PlacementPlanner:
             predicted_step_time_s=max(compute_s, stream_s) + exposed_s,
             config=cfg,
         )
+        if self.compile_index is not None:
+            try:
+                key = self.compile_index.key_for_plan(plan)
+                plan.compile_warm = self.compile_index.is_warm(key)
+                plan.expected_compile_s = self.compile_index.expected_compile_s(key)
+            except Exception:  # the index must never block prediction
+                log.debug("compile index consult failed", exc_info=True)
+        return plan
 
     def predict(
         self,
@@ -650,18 +676,36 @@ class PlacementPlanner:
             )
             return p.predicted_step_time_s / samples
 
-        # Tiebreak equal predicted throughput by projected HBM: when two
-        # layouts cost the same (fully-overlapped comm makes e.g. fsdp16
-        # and data2xfsdp8 identical), the one with more headroom is
-        # strictly safer to admit.
+        # Warm-first band: with a compile index attached, any WARM layout
+        # predicted within ``prefer_warm_max_slowdown_pct`` of the fastest
+        # feasible plan outranks every cold one — admission then pays zero
+        # compile instead of the cold EMA. The band bounds the trade: a
+        # warm plan more than the knob slower never wins on warmth alone.
+        best_ps = min(map(_per_sample, feasible), default=0.0)
+        warm_band = best_ps * (1.0 + self.prefer_warm_max_slowdown_pct / 100.0)
+
+        # Tiebreak equal predicted throughput by expected compile cost
+        # (0 when warm), then projected HBM: when two layouts cost the
+        # same (fully-overlapped comm makes e.g. fsdp16 and data2xfsdp8
+        # identical), the warm one resumes without a compile and the one
+        # with more headroom is strictly safer to admit.
         feasible.sort(key=lambda p: (
+            0 if (p.compile_warm and _per_sample(p) <= warm_band) else 1,
             _per_sample(p),
+            p.expected_compile_s,
             p.hbm_estimate.device_total_gib if p.hbm_estimate else float("inf"),
             -p.gang,
         ))
+        warm_tiebreak = bool(
+            feasible
+            and feasible[0].compile_warm
+            and _per_sample(feasible[0]) > best_ps
+        )
         with self._lock:
             self.plans_hbm_rejected_total += len(infeasible)
             self.last_feasible = len(feasible)
+            if warm_tiebreak:
+                self.warm_tiebreaks_total += 1
         return PlannerResult(
             plans=feasible, infeasible=infeasible, pruned=pruned,
             evaluated=evaluated, search_s=time.time() - t_search0,
@@ -797,6 +841,9 @@ class PlacementPlanner:
                 "plans_hbm_rejected_total": self.plans_hbm_rejected_total,
                 "plans_chosen_total": self.plans_chosen_total,
                 "no_estimate_refusals_total": self.no_estimate_refusals_total,
+                "warm_tiebreaks_total": self.warm_tiebreaks_total,
+                "compile_index_attached": self.compile_index is not None,
+                "prefer_warm_max_slowdown_pct": self.prefer_warm_max_slowdown_pct,
                 "last_feasible": self.last_feasible,
                 "last_chosen_predicted_s": self.last_chosen_predicted_s,
                 "prune_reasons": top_reasons,

@@ -41,6 +41,7 @@ from typing import Any, Callable, Optional
 
 import jax
 
+from tpu_engine import compile_index as compile_index_mod
 from tpu_engine import goodput as goodput_mod
 from tpu_engine import tracing
 from tpu_engine.hbm_estimate import (
@@ -282,6 +283,10 @@ class FleetScheduler:
         grow_back: bool = True,
         grow_back_cooldown_s: float = 30.0,
         planner: Optional[PlacementPlanner] = None,
+        compile_index: Optional[compile_index_mod.CompileCacheIndex] = None,
+        precompile_before_grow: bool = True,
+        precompile_deadline_s: float = 60.0,
+        precompile_fn: Optional[Callable[..., None]] = None,
     ):
         self.grow_back = grow_back
         # Hysteresis window: a shrunk job is not grown back until this long
@@ -299,9 +304,33 @@ class FleetScheduler:
         self.quotas = dict(quotas or {})
         self.checkpoint_root = checkpoint_root
         self.poll_interval_s = poll_interval_s
+        # Compile-cache awareness: admission ranking (via the planner) and
+        # grow-back both consult the layout-keyed warm index, and grow-back
+        # warms its target mesh in the background before preempting. The
+        # process index is the default so the supervisor's compile spans
+        # (which have no scheduler handle) feed the same ledger admission
+        # reads.
+        self.compile_index = (
+            compile_index if compile_index is not None
+            else compile_index_mod.get_index()
+        )
+        self.precompile_before_grow = precompile_before_grow
+        # How long a grow-back waits for its background precompile before
+        # resizing cold anyway — a broken precompiler must delay the grow,
+        # never prevent it.
+        self.precompile_deadline_s = precompile_deadline_s
+        self.precompiler = compile_index_mod.PrecompileWorker(
+            self.compile_index, compile_fn=precompile_fn
+        )
+        # submission_id → (target layout key, precompile requested at).
+        self._grow_precompiles: dict[str, tuple[str, float]] = {}
         # One planner per scheduler: auto admission, grow-back, the
         # launcher plan and the /plan endpoint share its counter plane.
-        self.planner = planner or PlacementPlanner(estimate_fn=estimate_fn)
+        self.planner = planner or PlacementPlanner(
+            estimate_fn=estimate_fn, compile_index=self.compile_index
+        )
+        if self.planner.compile_index is None:
+            self.planner.compile_index = self.compile_index
 
         self._lock = threading.RLock()
         self._subs: dict[str, Submission] = {}
@@ -322,6 +351,9 @@ class FleetScheduler:
         self.self_heal_requeues_total = 0
         self.auto_admissions_total = 0
         self.no_estimate_skips_total = 0
+        self.precompiles_started_total = 0
+        self.grow_back_warm_total = 0
+        self.grow_back_cold_total = 0
         self._wait_samples: list[float] = []  # bounded; admitted-wait seconds
         # Cumulative admission-wait histogram (Prometheus semantics: the
         # bucket counts only grow, unlike the bounded sample window the
@@ -521,6 +553,7 @@ class FleetScheduler:
         self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
+        self.precompiler.shutdown()
 
     # -- internals (all hold self._lock) --------------------------------------
 
@@ -1064,6 +1097,11 @@ class FleetScheduler:
             )
             if target is None:
                 continue
+            if not self._grow_target_warm_or_deadline(sub, target, now):
+                # Background precompile of the target layout in flight —
+                # hold the preempt until the destination mesh is warm (or
+                # the deadline/failure path lets the grow proceed cold).
+                continue
             self.grow_backs_total += 1
             sub.state = SubmissionState.PREEMPTING
             sub.last_resize_at = now
@@ -1086,6 +1124,85 @@ class FleetScheduler:
             )
             sub.job.watcher.simulate_interruption()
             return
+
+    def _grow_target_key(self, sub: Submission, target: int) -> Optional[str]:
+        """(key, label) of the layout a grow-back to ``target`` lands on:
+        the configured mesh when the target is the full gang, else the
+        elastic family's mesh at that size. None when the layout cannot be
+        determined — the grow then proceeds ungated (a keying problem must
+        never pin a job at its shrunk size)."""
+        try:
+            cfg = sub.config
+            full = gang_size(cfg, max(target, sub.admitted_gang or 1))
+            if target >= full:
+                mesh = cfg.mesh
+            else:
+                shrink = elastic_shrink_plan(
+                    cfg, target, sub.estimate_fn or self.estimate_fn
+                )
+                if shrink is None:
+                    return None
+                mesh = shrink[0]
+            label = compile_index_mod.label_for_config(cfg, mesh=mesh, gang=target)
+            return compile_index_mod.index_key(label, cfg)
+        except Exception:
+            log.debug("grow-back layout keying failed", exc_info=True)
+            return None
+
+    def _grow_target_warm_or_deadline(
+        self, sub: Submission, target: int, now: float
+    ) -> bool:
+        """Precompile-before-grow-back gate: True when the resize may
+        proceed (target warm, precompile disabled/unkeyable, failed, or the
+        deadline lapsed — the last two proceed *cold*); False while the
+        background warm-up is still in flight."""
+        if not self.precompile_before_grow:
+            return True
+        key = self._grow_target_key(sub, target)
+        if key is None:
+            return True
+        if self.compile_index.is_warm(key):
+            self.grow_back_warm_total += 1
+            self._grow_precompiles.pop(sub.submission_id, None)
+            return True
+        pending = self._grow_precompiles.get(sub.submission_id)
+        if pending is None or pending[0] != key:
+            # First sight of this target (or the target moved): kick the
+            # background warm-up and hold the preempt.
+            state = self.precompiler.request(
+                key,
+                label=key.rsplit("|", 1)[-1],
+                config=sub.config,
+                gang=target,
+            )
+            self._grow_precompiles[sub.submission_id] = (key, now)
+            if state == "queued":
+                self.precompiles_started_total += 1
+            tracing.get_recorder().event(
+                "grow_back_precompile",
+                kind="scheduler",
+                trace_id=sub.trace_id,
+                parent=sub._root_span,
+                attrs={"target_gang": target, "key": key, "state": state},
+            )
+            return False
+        status = self.precompiler.status(key)
+        if status in ("queued", "running") and (
+            now - pending[1] < self.precompile_deadline_s
+        ):
+            return False
+        # Warm (completed between passes), failed, rejected, or deadline —
+        # the grow proceeds; cold when the index still says so.
+        self._grow_precompiles.pop(sub.submission_id, None)
+        if self.compile_index.is_warm(key):
+            self.grow_back_warm_total += 1
+        else:
+            self.grow_back_cold_total += 1
+            log.info(
+                "scheduler: grow-back of %s proceeding COLD (precompile %s)",
+                sub.submission_id, status or "missing",
+            )
+        return True
 
     def _maybe_preempt(self, head: Submission) -> None:
         """Evict the lowest-priority running job strictly below ``head``'s
@@ -1232,6 +1349,15 @@ class FleetScheduler:
             "auto_admissions_total": self.auto_admissions_total,
             "no_estimate_skips_total": self.no_estimate_skips_total,
             "placement": self.planner.stats(),
+            "compile_cache": {
+                **self.compile_index.stats(),
+                "precompile": self.precompiler.stats(),
+                "precompiles_started_total": self.precompiles_started_total,
+                "grow_back_warm_total": self.grow_back_warm_total,
+                "grow_back_cold_total": self.grow_back_cold_total,
+                "precompile_deadline_s": self.precompile_deadline_s,
+                "precompile_before_grow": self.precompile_before_grow,
+            },
             "running_shrunk": sum(
                 1
                 for s in self._subs.values()

@@ -504,6 +504,51 @@ class TrainingJob:
         state_shape = jax.eval_shape(lambda: prog.init(jax.random.PRNGKey(self.config.seed)))
         return abstract_state_like(prog.state_shardings, state_shape)
 
+    def _note_compile_outcome(self, compile_s: float) -> Optional[bool]:
+        """Classify this attempt's compile as warm (persistent-cache hit)
+        or cold, and record the outcome into the fleet compile index.
+
+        The classification is a cheap wall-clock heuristic against the
+        index's measured cold-compile EMA: a layout the index already calls
+        warm stays a hit unless the measured wall time blew far past the
+        cold reference (cache evicted under us); a layout the index has
+        never seen is a hit only when the compile came in at a small
+        fraction of the cold reference (another process warmed the shared
+        cache dir). Returns None (and records nothing) when keying fails —
+        the index must never break the compile path.
+        """
+        try:
+            from tpu_engine import compile_index as compile_index_mod
+
+            idx = compile_index_mod.get_index()
+            mesh = self.elastic_mesh or self.config.mesh
+            gang = (
+                len(self._devices) if self._devices
+                else jax.device_count()
+            )
+            label = compile_index_mod.label_for_config(
+                self.config, mesh=mesh, gang=gang
+            )
+            key = compile_index_mod.index_key(label, self.config)
+            prior_warm = idx.is_warm(key)
+            cold_ref = idx.expected_cold_s(key)
+            if prior_warm:
+                cache_hit = cold_ref is None or compile_s <= max(
+                    0.5 * cold_ref, 1.0
+                )
+            else:
+                cache_hit = (
+                    cold_ref is not None and compile_s < 0.33 * cold_ref
+                )
+            idx.record(
+                key, compile_s, cache_hit,
+                label=label, model=self.config.model_name,
+            )
+            return cache_hit
+        except Exception:
+            log.debug("compile index record failed", exc_info=True)
+            return None
+
     def _run(self) -> None:
         self.started_at = time.time()
         rec = tracing.get_recorder()
@@ -533,10 +578,19 @@ class TrainingJob:
             with rec.start_span(
                 "compile", kind="compile", trace_id=self.trace_id,
                 parent=attempt_span,
-            ):
+            ) as compile_span:
+                t_compile0 = time.time()
                 enable_compilation_cache(self.config.compilation_cache_dir)
                 if self.program is None:
                     self.program = self._build_program()
+                compile_s = max(time.time() - t_compile0, 0.0)
+                # Warm/cold classification feeds the fleet compile index
+                # (scheduler admission + grow-back read it) and lets the
+                # goodput ledger split `compile` into warm vs cold time.
+                cache_hit = self._note_compile_outcome(compile_s)
+                compile_span.annotate(
+                    cache_hit=cache_hit, compile_s=round(compile_s, 6),
+                )
             prog = self.program
 
             # Per-chip attribution: claim this job's chips in the fleet view
