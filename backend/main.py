@@ -23,6 +23,7 @@ from backend.routers import (
     compile_cache,
     faults,
     goodput,
+    hetero,
     metrics,
     monitoring,
     profiling,
@@ -89,6 +90,10 @@ async def root(request: web.Request) -> web.Response:
                 "the persistent XLA cache, cache-aware placement ranking "
                 "and admission, and background precompile before "
                 "grow-back so preempt-resume pays a warm relink",
+                "throughput-weighted heterogeneous sharding: per-process "
+                "relative-throughput tracking with HBM-feasible integer "
+                "row rebalancing, so a slow-but-healthy host stops gating "
+                "the gang (rebalance preferred over elastic shrink)",
                 "continuous-batching serving with SSE token streaming, "
                 "prompt-prefix KV reuse, int8 weights/KV, and speculative "
                 "decoding",
@@ -106,6 +111,7 @@ async def root(request: web.Request) -> web.Response:
                 "profile": "/api/v1/profile",
                 "trace": "/api/v1/trace",
                 "goodput": "/api/v1/goodput",
+                "hetero": "/api/v1/hetero",
                 "compile_cache": "/api/v1/compile-cache",
                 "metrics": "/metrics",
                 "openapi": "/openapi.json",
@@ -145,6 +151,7 @@ def create_app() -> web.Application:
     profiling.setup(app)
     tracing.setup(app)
     goodput.setup(app)
+    hetero.setup(app)
     compile_cache.setup(app)
     serving.setup(app)
     metrics.setup(app)

@@ -1,0 +1,27 @@
+"""Heterogeneity-plane routes — the query surface for
+``tpu_engine/hetero.py``:
+
+- ``GET /api/v1/hetero`` — the active job's per-process relative-
+  throughput estimates, current row assignment, imbalance ratio,
+  recovered-goodput fraction and the rebalancer's hysteresis counters
+  (dry runs, skips by reason, live rebalances). ``active: false`` when
+  no training job has a heterogeneity plane attached.
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from backend.http import json_response
+from tpu_engine import hetero as hetero_mod
+
+
+async def hetero_view(request: web.Request) -> web.Response:
+    reb = hetero_mod.get_active()
+    if reb is None:
+        return json_response({"active": False, "stats": None})
+    return json_response({"active": True, "stats": reb.stats()})
+
+
+def setup(app: web.Application, prefix: str = "/api/v1") -> None:
+    app.router.add_get(f"{prefix}/hetero", hetero_view)

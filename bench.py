@@ -192,6 +192,9 @@ def main() -> None:
     placement_line = _placement_metric()
     if placement_line is not None:
         print(json.dumps(placement_line))
+    hetero_line = _hetero_metric()
+    if hetero_line is not None:
+        print(json.dumps(hetero_line))
 
 
 def _comm_compress_metric(n_dev: int) -> dict | None:
@@ -522,6 +525,35 @@ def _placement_metric() -> dict | None:
             "top_pick_within_5pct": sweep["top_pick_within_5pct"],
             "top_pick_measured_ms": sweep["top_pick_measured_ms"],
             "fastest_measured_ms": sweep["fastest_measured_ms"],
+        }
+    except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
+        return None
+
+
+def _hetero_metric() -> dict | None:
+    """Ninth JSON line: throughput-weighted heterogeneous sharding — the
+    steady-state goodput a rebalanced gang retains on a seeded 25%-
+    degraded host vs the uniform gang (which gates every step on the slow
+    host) and vs evicting the host (benchmarks/chaos.py hetero lane,
+    deterministic virtual clock). Never fails the bench: any error
+    degrades to None."""
+    try:
+        from benchmarks.chaos import run_hetero_lane
+
+        het = run_hetero_lane(seed=0)
+        return {
+            "metric": "hetero_rebalance_goodput",
+            "value": het["steady_goodput_on"],
+            "unit": "steady-state goodput fraction of heterogeneous ideal",
+            "rebalance_off": het["steady_goodput_off"],
+            "shrink": het["steady_goodput_shrink"],
+            "goodput_recovered": het["goodput_recovered"],
+            "rebalance_step": het["rebalance_on"]["rebalance_step"],
+            "assignment": het["rebalance_on"]["assignment"],
+            "global_batch_preserved": (
+                sum(het["rebalance_on"]["assignment"])
+                == het["params"]["global_micro"]
+            ),
         }
     except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
         return None

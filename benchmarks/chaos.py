@@ -49,8 +49,14 @@ from typing import Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from tpu_engine import hetero as hetero_mod  # noqa: E402
 from tpu_engine.compile_index import CompileCacheIndex  # noqa: E402
-from tpu_engine.faults import FaultKind, FaultPlan  # noqa: E402
+from tpu_engine.faults import (  # noqa: E402
+    FaultInjector,
+    FaultKind,
+    FaultPlan,
+    FaultSpec,
+)
 from tpu_engine.goodput import (  # noqa: E402
     CATEGORIES,
     GoodputLedger,
@@ -329,6 +335,154 @@ def simulate_die_and_restart(events: list[dict]) -> dict:
     }
 
 
+# -- heterogeneous sharding lane ----------------------------------------------
+# A second, independent trace: no chips die, but one host runs sustained-slow
+# (a seeded faults.py HOST_SLOW plan). The synchronous gang gates every step
+# on that host unless the heterogeneity plane (tpu_engine/hetero.py) reweights
+# the per-process row assignment. Three policies replay the identical plan on
+# the same virtual clock: rebalance-off (uniform rows forever), rebalance-on
+# (a live HeteroRebalancer fed by the injector's host-slow signals), and
+# shrink (evict the slow host, 7-chip uniform gang). Goodput here is measured
+# against the *heterogeneous* ideal — every host contributing exactly its
+# capacity — so rebalance can approach 1.0 while shrink, which throws the
+# slow host's remaining 75% away, cannot.
+HET_HOSTS = 8
+HET_GLOBAL_MICRO = 128
+HET_STEPS = 400
+HET_TAIL_STEPS = 100       # steady-state window: the last N steps
+HET_CHECK_EVERY = 10       # rebalance consult cadence (steps)
+HET_SHRINK_AT_STEP = 25    # when the shrink policy evicts the slow host
+# Reported per-step stall while uniformly loaded; the slow host's true rate
+# is STEP/(STEP+stall) = 0.75 — the headline 25%-degraded host.
+HET_SLOW_S = STEP_TIME_S / 3.0
+
+
+def host_slow_plan(seed: int) -> FaultPlan:
+    """Sustained host-slow on one seeded host: fires every step."""
+    import random as _random
+
+    host = _random.Random(seed).randrange(HET_HOSTS)
+    return FaultPlan(seed=seed, specs=[
+        FaultSpec(
+            kind=FaultKind.HOST_SLOW, at_step=1, device_index=host,
+            slow_s=round(HET_SLOW_S, 6), count=HET_STEPS,
+        )
+    ])
+
+
+def simulate_hetero(
+    policy: str,
+    plan: FaultPlan,
+    recorder: Optional[FlightRecorder] = None,
+    trace_id: Optional[str] = None,
+) -> dict:
+    """Replay ``plan`` under one policy on the virtual clock.
+
+    The injector is the only degradation source: a consumed HOST_SLOW spec
+    both slows the simulated host (truth) and feeds the ThroughputTracker
+    (signal) — exactly the supervisor's ``take_host_slow`` seam."""
+    inj = FaultInjector(plan)
+    inj.arm()
+    rate = [1.0] * HET_HOSTS           # ground-truth relative rates
+    rows_u = HET_GLOBAL_MICRO // HET_HOSTS
+    vclock = 0.0
+    tracker = hetero_mod.ThroughputTracker(HET_HOSTS)
+    reb = hetero_mod.HeteroRebalancer(
+        tracker, HET_GLOBAL_MICRO, dry_run=False, cooldown_s=30.0,
+        min_gain=0.01, clock=lambda: vclock,
+        recorder=recorder, trace_id=trace_id,
+    )
+    assignment = list(reb.assignment)
+    active = list(range(HET_HOSTS))
+    shrunk = False
+    downtime_s = 0.0
+    rebalance_step: Optional[int] = None
+    ideal_wall = 0.0
+    tail_wall = tail_ideal = 0.0
+    for step in range(1, HET_STEPS + 1):
+        spec = inj.take_host_slow(step)
+        if spec is not None:
+            idx = int(spec.device_index or 0)
+            rate[idx] = STEP_TIME_S / (STEP_TIME_S + float(spec.slow_s))
+            tracker.note_host_slow(idx, float(spec.slow_s), STEP_TIME_S)
+        if policy == "shrink" and not shrunk and step >= HET_SHRINK_AT_STEP:
+            # Evict the slow host: emergency save + re-admit + cold compile,
+            # then a 7-host uniform gang carries the full global batch.
+            shrunk = True
+            slow_host = min(range(HET_HOSTS), key=lambda h: rate[h])
+            active = [h for h in range(HET_HOSTS) if h != slow_host]
+            assignment = hetero_mod.uniform_assignment(
+                HET_GLOBAL_MICRO, len(active)
+            )
+            downtime_s = CKPT_SAVE_S + RESUME_ADMIT_S + COLD_COMPILE_S
+            vclock += downtime_s
+        # Synchronous gang: the step ends when the slowest member finishes
+        # its rows; a host's nominal pace is rows_u rows per STEP_TIME_S.
+        step_s = max(
+            assignment[j] * STEP_TIME_S / (rows_u * rate[h])
+            for j, h in enumerate(active)
+        )
+        ideal_s = HET_GLOBAL_MICRO * STEP_TIME_S / (rows_u * sum(rate))
+        vclock += step_s
+        ideal_wall += ideal_s
+        tracker.observe_step(step_s)
+        if policy == "rebalance-on" and step % HET_CHECK_EVERY == 0:
+            r_plan = reb.maybe_rebalance(step)
+            if r_plan is not None:
+                assignment = list(r_plan.assignment)
+                if rebalance_step is None:
+                    rebalance_step = step
+        if step > HET_STEPS - HET_TAIL_STEPS:
+            tail_wall += step_s
+            tail_ideal += ideal_s
+    return {
+        "policy": policy,
+        "wall_s": round(vclock, 1),
+        "ideal_wall_s": round(ideal_wall, 1),
+        "downtime_s": round(downtime_s, 1),
+        "goodput": round(ideal_wall / vclock, 4),
+        "steady_goodput": round(tail_ideal / tail_wall, 4),
+        "assignment": list(assignment),
+        "active_hosts": len(active),
+        "rebalance_step": rebalance_step,
+        "rebalancer": reb.stats() if policy == "rebalance-on" else None,
+    }
+
+
+def run_hetero_lane(
+    seed: int = 0, recorder: Optional[FlightRecorder] = None
+) -> dict:
+    """Rebalance-on vs rebalance-off vs shrink on one seeded slow-host plan."""
+    plan = host_slow_plan(seed)
+    trace_id = recorder.new_trace_id() if recorder is not None else None
+    on = simulate_hetero("rebalance-on", plan, recorder=recorder,
+                         trace_id=trace_id)
+    off = simulate_hetero("rebalance-off", plan)
+    shrink = simulate_hetero("shrink", plan)
+    return {
+        "seed": seed,
+        "params": {
+            "n_hosts": HET_HOSTS,
+            "global_micro": HET_GLOBAL_MICRO,
+            "steps": HET_STEPS,
+            "slow_host_rate": round(
+                STEP_TIME_S / (STEP_TIME_S + HET_SLOW_S), 4
+            ),
+            "slow_host": int(plan.specs[0].device_index or 0),
+            "check_every_steps": HET_CHECK_EVERY,
+        },
+        "rebalance_on": on,
+        "rebalance_off": off,
+        "shrink": shrink,
+        "steady_goodput_on": on["steady_goodput"],
+        "steady_goodput_off": off["steady_goodput"],
+        "steady_goodput_shrink": shrink["steady_goodput"],
+        "goodput_recovered": round(
+            on["steady_goodput"] - off["steady_goodput"], 4
+        ),
+    }
+
+
 def goodput_lane(
     recorder: FlightRecorder, trace_id: str, wall: float
 ) -> dict:
@@ -473,6 +627,7 @@ def main() -> None:
     args = parser.parse_args()
     recorder = FlightRecorder() if args.trace_out else None
     trace = run_trace(args.seed, n_faults=args.faults, recorder=recorder)
+    trace["hetero"] = run_hetero_lane(args.seed, recorder=recorder)
     if recorder is not None:
         doc = recorder.export_chrome_trace()
         with open(args.trace_out, "w", encoding="utf-8") as f:
@@ -526,7 +681,29 @@ def main() -> None:
         "alert_count": gp["slo"]["alert_count"],
         "ok": ok,
     }))
-    if not ok:
+    het = trace["hetero"]
+    het_ok = (
+        # Headline: the rebalanced gang retains >= 90% of the heterogeneous
+        # ideal on a 25%-degraded host...
+        het["steady_goodput_on"] >= 0.90
+        # ...while the uniform gang gates on the slow host...
+        and het["steady_goodput_off"] <= 0.80
+        # ...and beats shrinking, which discards the host's remaining 75%.
+        and het["steady_goodput_on"] > het["steady_goodput_shrink"]
+        # The rebalance preserved the declared global batch exactly.
+        and sum(het["rebalance_on"]["assignment"]) == HET_GLOBAL_MICRO
+    )
+    print(json.dumps({
+        "metric": "chaos_hetero_rebalance_goodput",
+        "value": het["steady_goodput_on"],
+        "unit": "steady-state goodput fraction of heterogeneous ideal",
+        "rebalance_off": het["steady_goodput_off"],
+        "shrink": het["steady_goodput_shrink"],
+        "goodput_recovered": het["goodput_recovered"],
+        "assignment": het["rebalance_on"]["assignment"],
+        "ok": het_ok,
+    }))
+    if not (ok and het_ok):
         raise SystemExit(1)
 
 

@@ -335,6 +335,166 @@ def test_sharded_stream_dead_producer_reraises_not_deadlocks(token_file):
     s.close()
 
 
+# -- non-uniform row assignments (heterogeneous sharding, PR 11) -------------
+
+
+def test_sharded_stream_non_uniform_split_exactly_once(token_file):
+    """A throughput-weighted [5, 3] split still tiles the global batch:
+    every row consumed exactly once, read volume proportional to rows."""
+    from tpu_engine.data import _ShardedTokenStream
+
+    accum, gm, seq = 2, 8, 64
+    ref = TokenFileDataset(token_file, seq_len=seq)
+    ref.start(accum * gm, seed=7)
+    steps = 96  # > one epoch: exercises the wrap under unequal windows
+
+    rows = [5, 3]
+    shards, counters = [], []
+    start = 0
+    for r in rows:
+        ds = _CountingDataset(TokenFileDataset(token_file, seq_len=seq))
+        counters.append(ds)
+        shards.append(_ShardedTokenStream(
+            ds, accum, gm, start, r, seed=7, prefetch=False,
+        ))
+        start += r
+
+    for step in range(steps):
+        full = ref.next_batch().reshape(accum, gm, seq)
+        local0 = shards[0].next()
+        local1 = shards[1].next()
+        assert local0.shape == (accum, 5, seq)
+        assert local1.shape == (accum, 3, seq)
+        assert (np.concatenate([local0, local1], axis=1) == full).all(), step
+
+    for c, r in zip(counters, rows):
+        assert c.rows_read == steps * accum * r
+    ref.close()
+
+
+def test_sharded_stream_reassign_mid_run_keeps_exact_coverage(token_file):
+    """reassign() at a step boundary moves the row windows without
+    disturbing the deterministic walk: the tiles keep reassembling the
+    reference batch exactly, before and after the rebalance."""
+    from tpu_engine.data import _ShardedTokenStream
+
+    accum, gm, seq = 2, 8, 64
+    ref = TokenFileDataset(token_file, seq_len=seq)
+    ref.start(accum * gm, seed=11)
+    shards = [
+        _ShardedTokenStream(
+            TokenFileDataset(token_file, seq_len=seq),
+            accum, gm, pi * (gm // 2), gm // 2, seed=11, prefetch=False,
+        )
+        for pi in range(2)
+    ]
+
+    def check(step):
+        full = ref.next_batch().reshape(accum, gm, seq)
+        got = np.concatenate([s.next() for s in shards], axis=1)
+        assert (got == full).all(), step
+
+    for step in range(10):
+        check(step)
+    # Rebalance 4/4 -> 5/3 at the boundary, on every process.
+    shards[0].reassign(0, 5)
+    shards[1].reassign(5, 3)
+    for step in range(10, 20):
+        check(step)
+    # And back the other way, 5/3 -> 2/6.
+    shards[0].reassign(0, 2)
+    shards[1].reassign(2, 6)
+    for step in range(20, 30):
+        check(step)
+    ref.close()
+
+
+def test_sharded_stream_reassign_rejects_out_of_range_window(token_file):
+    from tpu_engine.data import _ShardedTokenStream
+
+    s = _ShardedTokenStream(
+        TokenFileDataset(token_file, seq_len=64), 1, 8, 0, 4, seed=3,
+        prefetch=False,
+    )
+    for bad in [(0, 0), (-1, 4), (5, 4), (0, 9)]:
+        with pytest.raises(ValueError, match="row window"):
+            s.reassign(*bad)
+    # The failed reassigns left the stream usable with its old window.
+    assert s.next().shape == (1, 4, 64)
+
+
+def test_sharded_stream_non_uniform_deterministic_under_seed(token_file):
+    """Same seed + same windows => bit-identical streams, so every
+    process derives the identical global walk regardless of its share."""
+    from tpu_engine.data import _ShardedTokenStream
+
+    def run(seed):
+        s = _ShardedTokenStream(
+            TokenFileDataset(token_file, seq_len=64), 2, 8, 3, 5, seed=seed,
+            prefetch=False,
+        )
+        return [s.next().copy() for _ in range(12)]
+
+    a, b = run(5), run(5)
+    for x, y in zip(a, b):
+        assert (x == y).all()
+    c = run(6)
+    assert any((x != y).any() for x, y in zip(a, c))
+
+
+def test_validate_row_assignment_rejections():
+    from tpu_engine.data import validate_row_assignment
+
+    assert validate_row_assignment([5, 3], 8, 2) == [5, 3]
+    assert validate_row_assignment((4.0, 4), 8, 2, accum=2) == [4, 4]
+    # Wrong sum: would drop or double-read rows of every step's batch.
+    with pytest.raises(ValueError, match="expected accum x global micro"):
+        validate_row_assignment([5, 4], 8, 2)
+    with pytest.raises(ValueError, match="expected accum x global micro"):
+        validate_row_assignment([3, 3], 8, 2, accum=2)
+    # Wrong length: one entry per process, always.
+    with pytest.raises(ValueError, match="2 entries for 3 processes"):
+        validate_row_assignment([4, 4], 8, 3)
+    # Zero/negative rows: every process must hold at least one row.
+    with pytest.raises(ValueError, match=">= 1"):
+        validate_row_assignment([8, 0], 8, 2)
+
+
+def test_make_data_fn_row_assignment_end_to_end(token_file):
+    """make_data_fn(row_assignment=...) rejects bad vectors up front and
+    exposes a reassign() hook that revalidates before moving the window."""
+    from tpu_engine.mesh_runtime import MeshConfig
+    from tpu_engine.sharding import ShardingStage, TPUTrainConfig
+    from tpu_engine.train import build_train_program
+
+    cfg = TPUTrainConfig(
+        model_name="gpt-tiny", sharding_stage=ShardingStage.FULL_PARTITIONING,
+        mesh=MeshConfig(data=2, fsdp=4), micro_batch_size=1,
+        gradient_accumulation_steps=1, seq_len=64, precision="fp32",
+        activation_checkpointing=False,
+    )
+    prog = build_train_program(cfg)  # global_micro = 8
+    ds = TokenFileDataset(token_file, seq_len=64)
+    with pytest.raises(ValueError, match="expected accum x global micro"):
+        make_data_fn(
+            prog, ds, process_count=2, process_index=0, row_assignment=[5, 4],
+        )
+    with pytest.raises(ValueError, match="entries for"):
+        make_data_fn(
+            prog, ds, process_count=2, process_index=0, row_assignment=[8],
+        )
+    # A valid non-uniform vector builds, and reassign() revalidates.
+    fn = make_data_fn(
+        prog, ds, process_count=2, process_index=0, row_assignment=[5, 3],
+    )
+    try:
+        assert fn.reassign([6, 2]) == [6, 2]
+        with pytest.raises(ValueError, match="expected accum x global micro"):
+            fn.reassign([6, 3])
+    finally:
+        fn.close()
+
+
 def test_make_data_fn_rejects_indivisible_process_count(token_file):
     from tpu_engine.mesh_runtime import MeshConfig
     from tpu_engine.sharding import ShardingStage, TPUTrainConfig

@@ -193,6 +193,50 @@ def test_async_checkpoint_save_not_charged():
     assert abs(d["categories"]["productive"] - 100.0) < 1e-9
 
 
+def test_host_slow_reconciles_with_injector_counter():
+    """The invariant promised next to ``host_slow_penalty_s_total`` in
+    faults.py: every stall second the injector accrues must land in the
+    ledger's host_slow category. Drive a seeded HOST_SLOW plan through
+    the real ``take_host_slow`` seam, mirror each consumed penalty as the
+    supervisor's ``kind="fault"`` + ``penalty_s`` event, and reconcile
+    the decomposition against the injector's counter exactly."""
+    from tpu_engine.faults import (
+        FaultInjector,
+        FaultKind,
+        FaultPlan,
+        FaultSpec,
+    )
+
+    plan = FaultPlan(seed=3, specs=[
+        FaultSpec(kind=FaultKind.HOST_SLOW, at_step=5, device_index=0,
+                  slow_s=0.5, count=3),
+        FaultSpec(kind=FaultKind.HOST_SLOW, at_step=40, device_index=1,
+                  slow_s=0.75),
+    ])
+    inj = FaultInjector(plan)
+    inj.arm()
+    rec = _rec()
+    tid = rec.new_trace_id()
+    root = rec.start_span("job:h", kind="job", trace_id=tid, t0=0.0)
+    rec.record_span("attempt-1", kind="attempt", trace_id=tid, t0=0, t1=100)
+    t = 0.0
+    for step in range(1, 101):
+        t += 1.0  # one virtual second per step keeps penalties disjoint
+        spec = inj.take_host_slow(step)
+        if spec is not None:
+            rec.event(
+                "host-slow", kind="fault", trace_id=tid, ts=t,
+                attrs={"step": step, "penalty_s": float(spec.slow_s)},
+            )
+    root.end(t1=100.0)
+    assert abs(inj.host_slow_penalty_s_total - (3 * 0.5 + 0.75)) < 1e-9
+    d = decompose_trace(rec, tid)
+    _assert_invariants(d, 100.0)
+    assert abs(
+        d["categories"]["host_slow"] - inj.host_slow_penalty_s_total
+    ) < 1e-6
+
+
 # ---------------------------------------------------------------------------
 # GoodputLedger
 # ---------------------------------------------------------------------------

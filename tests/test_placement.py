@@ -308,3 +308,60 @@ def test_stats_and_observation_plane():
     assert st["observations_total"] == 1
     assert st["step_time_abs_rel_error"] == pytest.approx(1.0)
     assert st["prune_reasons"]  # top prune reasons surface for operators
+
+
+def test_throughput_fn_scales_the_cost_model():
+    """PR 11: per-device relative throughput is a cost-model input — a
+    degraded gang predicts proportionally slower, and an absent (or
+    healthy) throughput feed leaves every prediction byte-identical."""
+    base = PlacementPlanner()
+    healthy = PlacementPlanner(throughput_fn=lambda: [1.0] * 8)
+    slow = PlacementPlanner(throughput_fn=lambda: [0.5] * 8)
+
+    r_base = base.plan(cfg(), devices=chips(8), gang=8)
+    r_healthy = healthy.plan(cfg(), devices=chips(8), gang=8)
+    r_slow = slow.plan(cfg(), devices=chips(8), gang=8)
+    assert r_healthy.best.predicted_step_time_s == r_base.best.predicted_step_time_s
+    assert r_base.best.assumed_rel_throughput == 1.0
+    assert r_slow.best.assumed_rel_throughput == pytest.approx(0.5)
+    assert r_slow.best.predicted_step_time_s > r_base.best.predicted_step_time_s
+
+    # A throughput feed that dies must never take planning down with it.
+    def boom():
+        raise RuntimeError("hetero plane gone")
+
+    broken = PlacementPlanner(throughput_fn=boom)
+    r_broken = broken.plan(cfg(), devices=chips(8), gang=8)
+    assert r_broken.best.predicted_step_time_s == r_base.best.predicted_step_time_s
+    assert broken.stats()["throughput_fn_attached"] is True
+
+
+def test_calibration_sidecar_persists_and_reloads(tmp_path):
+    """record_observation() calibration survives a planner restart via the
+    compile-index-style atomic sidecar, and surfaces in stats()."""
+    cache = str(tmp_path)
+    planner = PlacementPlanner(calibration_path=cache)
+    planner.record_observation(predicted_s=2.0, observed_s=1.0)
+    planner.record_observation(predicted_s=1.0, observed_s=1.0)
+    st = planner.stats()["calibration"]
+    assert st["attached"] is True
+    assert st["observations_total"] == 2
+    # EMA(alpha=0.3) over rel errors [1.0, 0.0] -> 0.7.
+    assert st["ema_rel_error"] == pytest.approx(0.7)
+    assert st["persist_errors_total"] == 0
+    sidecar = tmp_path / PlacementPlanner.CALIBRATION_SIDECAR
+    assert sidecar.exists()
+
+    # A fresh planner (the post-restart scheduler) resumes the EMA.
+    reborn = PlacementPlanner(calibration_path=cache)
+    st2 = reborn.stats()["calibration"]
+    assert st2["ema_rel_error"] == pytest.approx(0.7)
+    assert st2["observations_total"] == 2
+
+    # Persistence failures degrade to a counter, never an exception.
+    blocker = tmp_path / "blocker"
+    blocker.write_text("not a directory")
+    fragile = PlacementPlanner(calibration_path=cache)
+    fragile._calibration_path = str(blocker / "sub" / "x.json")
+    fragile.record_observation(predicted_s=2.0, observed_s=1.0)
+    assert fragile.stats()["calibration"]["persist_errors_total"] == 1

@@ -82,6 +82,7 @@ def _flatten(line: dict) -> dict[str, float]:
 
 def collect_quick() -> list[dict]:
     """Re-derive the deterministic bench lines in-process (no timing)."""
+    from benchmarks.chaos import run_hetero_lane
     from benchmarks.chaos import run_trace as chaos_trace
     from benchmarks.scheduler_sim import run_warm_admission
     from tpu_engine.parallel.pipeline_zb import schedule_account
@@ -90,6 +91,7 @@ def collect_quick() -> list[dict]:
     gp = trace["goodput"]
     cc = trace["compile_cache"]
     warm = run_warm_admission(seed=0)
+    het = run_hetero_lane(seed=0)
     zb = schedule_account("zb", 4, 16)
     f1b = schedule_account("1f1b", 4, 16)
     return [
@@ -121,6 +123,18 @@ def collect_quick() -> list[dict]:
             "mean_wait_fifo_s": warm["mean_wait_fifo_s"],
             "mean_wait_warm_s": warm["mean_wait_warm_s"],
             "wait_reduction_pct": warm["wait_reduction_pct"],
+        },
+        {
+            "metric": "hetero_rebalance_goodput",
+            "value": het["steady_goodput_on"],
+            "rebalance_off": het["steady_goodput_off"],
+            "shrink": het["steady_goodput_shrink"],
+            "goodput_recovered": het["goodput_recovered"],
+            "rebalance_step": het["rebalance_on"]["rebalance_step"],
+            "global_batch_preserved": (
+                sum(het["rebalance_on"]["assignment"])
+                == het["params"]["global_micro"]
+            ),
         },
         {
             "metric": "pipeline_schedule_zb_vs_1f1b",

@@ -328,7 +328,10 @@ class _ShardedTokenStream:
             raise ValueError(f"batch {batch} > num_sequences {n}")
         self._ds = dataset
         self._accum, self._gm = accum, global_micro
-        self._r0, self._rows = row_start, row_count
+        # One tuple, swapped atomically: the prefetch thread reads the
+        # window mid-step and a reassign must never hand it a torn
+        # (new start, old count) pair.
+        self._window = (int(row_start), int(row_count))
         self._walk = _PermWalk(n, seed, shuffle)
         self._queue: Any = None
         self._dead: Optional[Exception] = None
@@ -346,13 +349,33 @@ class _ShardedTokenStream:
     def epoch(self) -> int:
         return self._walk.epoch
 
+    def reassign(self, row_start: int, row_count: int) -> None:
+        """Move this process's row window (heterogeneous rebalance).
+
+        The walk itself is untouched — every process still derives the
+        identical global index matrix each step, so as long as all
+        processes reassign at the same step boundary the global batch
+        stays covered exactly once. With prefetch on, the one in-flight
+        batch was read under the old window; the new window takes effect
+        from the next produced batch — the same step skew on every
+        process, because the prefetch depth is fixed at one.
+        """
+        r0, rows = int(row_start), int(row_count)
+        if rows < 1 or r0 < 0 or r0 + rows > self._gm:
+            raise ValueError(
+                f"row window [{r0}, {r0 + rows}) outside global micro "
+                f"batch of {self._gm} rows"
+            )
+        self._window = (r0, rows)
+
     def _read_local(self) -> np.ndarray:
+        r0, rows = self._window
         g = self._walk.next_indices(self._accum * self._gm).reshape(
             self._accum, self._gm
         )
-        block = g[:, self._r0:self._r0 + self._rows]  # [accum, rows]
+        block = g[:, r0:r0 + rows]  # [accum, rows]
         flat = self._ds.read_batch(block.reshape(-1))
-        return flat.reshape(self._accum, self._rows, -1)
+        return flat.reshape(self._accum, rows, -1)
 
     def _producer(self) -> None:
         while not self._stop.is_set():
@@ -395,20 +418,54 @@ class _ShardedTokenStream:
             self._thread.join(timeout=2.0)
 
 
-def _place_global(batch: np.ndarray, sharding: Any) -> jax.Array:
+def validate_row_assignment(
+    assignment: Any, global_micro: int, process_count: int, accum: int = 1
+) -> list[int]:
+    """Validate a non-uniform rows-per-process vector (heterogeneous
+    sharding, ``tpu_engine/hetero.py``): one positive entry per process,
+    summing to the declared global micro batch exactly — a bad vector
+    would silently drop or double-read rows of every step's
+    ``accum × global_micro`` batch, so it is rejected loudly instead."""
+    rows = [int(r) for r in assignment]
+    if len(rows) != process_count:
+        raise ValueError(
+            f"row assignment has {len(rows)} entries for "
+            f"{process_count} processes"
+        )
+    if any(r < 1 for r in rows):
+        raise ValueError(f"row assignment entries must be >= 1, got {rows}")
+    if sum(rows) != int(global_micro):
+        raise ValueError(
+            f"row assignment {rows} covers {accum} x {sum(rows)} rows per "
+            f"step, expected accum x global micro batch = "
+            f"{accum} x {global_micro}"
+        )
+    return rows
+
+
+def _place_global(
+    batch: np.ndarray, sharding: Any, row_assignment: Optional[list[int]] = None
+) -> jax.Array:
     """Place a host [accum, global_micro, seq] batch onto the mesh.
 
     Multi-process SYNTHETIC batches: every process holds the identical
     global batch and contributes its contiguous row block (mesh devices
     are ordered by process, so batch-axis shards are process-contiguous;
     the sequence axis, if sharded, stays process-local on one host's slice
-    under the canonical (data, fsdp, sequence, model) order). File-backed
-    multi-process reads do NOT come through here — ``make_data_fn`` shards
-    the reads themselves (``_ShardedTokenStream``).
+    under the canonical (data, fsdp, sequence, model) order). A
+    ``row_assignment`` replaces the implicit equal split with per-process
+    block sizes (prefix sums give the offsets). File-backed multi-process
+    reads do NOT come through here — ``make_data_fn`` shards the reads
+    themselves (``_ShardedTokenStream``).
     """
     if jax.process_count() > 1:
-        rows = batch.shape[1] // jax.process_count()
-        r0 = jax.process_index() * rows
+        pi = jax.process_index()
+        if row_assignment is not None:
+            r0 = sum(row_assignment[:pi])
+            rows = row_assignment[pi]
+        else:
+            rows = batch.shape[1] // jax.process_count()
+            r0 = pi * rows
         local = batch[:, r0:r0 + rows]
         return jax.make_array_from_process_local_data(
             sharding, local, global_shape=batch.shape
@@ -430,6 +487,7 @@ def make_data_fn(
     *,
     process_count: Optional[int] = None,
     process_index: Optional[int] = None,
+    row_assignment: Optional[Any] = None,
 ) -> Callable[[int], jax.Array]:
     """Adapt a dataset into the supervisor's ``data_fn(step)`` contract.
 
@@ -443,6 +501,14 @@ def make_data_fn(
     scales as 1/process_count, and hosts need not even hold rows outside
     their block in page cache. ``process_count``/``process_index``
     override the runtime's view (test seam).
+
+    ``row_assignment`` replaces the implicit equal split with a
+    non-uniform rows-per-process vector (throughput-weighted heterogeneous
+    sharding, ``tpu_engine/hetero.py``); it must sum to the global micro
+    batch exactly. The returned ``data_fn`` additionally exposes
+    ``data_fn.reassign(assignment)`` so a live rebalance can move the row
+    windows without rebuilding the stream — callers must invoke it at the
+    same step boundary on every process.
     """
     accum, global_micro, seq_len = program.global_batch_shape()
     _check_seq_len(dataset, seq_len)
@@ -451,14 +517,19 @@ def make_data_fn(
     sharding = program.batch_sharding
 
     if pc > 1 and hasattr(dataset, "read_batch"):
-        if global_micro % pc != 0:
-            raise ValueError(
-                f"global micro batch {global_micro} not divisible by "
-                f"process count {pc}"
+        if row_assignment is not None:
+            rows_vec = validate_row_assignment(
+                row_assignment, global_micro, pc, accum
             )
-        rows = global_micro // pc
+        else:
+            if global_micro % pc != 0:
+                raise ValueError(
+                    f"global micro batch {global_micro} not divisible by "
+                    f"process count {pc}"
+                )
+            rows_vec = [global_micro // pc] * pc
         stream = _ShardedTokenStream(
-            dataset, accum, global_micro, pi * rows, rows, seed
+            dataset, accum, global_micro, sum(rows_vec[:pi]), rows_vec[pi], seed
         )
 
         def data_fn(step: int) -> jax.Array:
@@ -467,17 +538,34 @@ def make_data_fn(
                 sharding, local, global_shape=(accum, global_micro, seq_len)
             )
 
+        def reassign(assignment: Any) -> list[int]:
+            rv = validate_row_assignment(assignment, global_micro, pc, accum)
+            stream.reassign(sum(rv[:pi]), rv[pi])
+            return rv
+
         # Owners must stop the prefetch thread with the job (the supervisor
         # calls this in its finally block).
         data_fn.close = stream.close  # type: ignore[attr-defined]
+        data_fn.reassign = reassign  # type: ignore[attr-defined]
         return data_fn
 
     dataset.start(accum * global_micro, seed=seed)
+    assign_box: list[Optional[list[int]]] = [
+        validate_row_assignment(row_assignment, global_micro, pc, accum)
+        if row_assignment is not None else None
+    ]
 
     def data_fn(step: int) -> jax.Array:
         flat = dataset.next_batch()  # [accum*global_micro, seq_len] int32
-        return _place_global(flat.reshape(accum, global_micro, seq_len), sharding)
+        return _place_global(
+            flat.reshape(accum, global_micro, seq_len), sharding, assign_box[0]
+        )
 
+    def reassign(assignment: Any) -> list[int]:
+        assign_box[0] = validate_row_assignment(assignment, global_micro, pc, accum)
+        return assign_box[0]
+
+    data_fn.reassign = reassign  # type: ignore[attr-defined]
     return data_fn
 
 

@@ -290,13 +290,20 @@ class FaultInjector:
         with self._lock:
             return self._take_locked(FaultKind.PREEMPTION_SIGNAL, step) is not None
 
-    def host_slow_penalty_s(self, step: int) -> float:
-        """Supervisor seam: reported step-time penalty (never an actual sleep)."""
+    def take_host_slow(self, step: int) -> Optional[FaultSpec]:
+        """Supervisor seam: consume one host-slow fault if due, returning
+        the full spec — the heterogeneity plane needs ``device_index`` to
+        attribute the stall to a host, not just the penalty magnitude."""
         with self._lock:
             spec = self._take_locked(FaultKind.HOST_SLOW, step)
-            pen = float(spec.slow_s) if spec is not None else 0.0
-            self.host_slow_penalty_s_total += pen
-            return pen
+            if spec is not None:
+                self.host_slow_penalty_s_total += float(spec.slow_s)
+            return spec
+
+    def host_slow_penalty_s(self, step: int) -> float:
+        """Supervisor seam: reported step-time penalty (never an actual sleep)."""
+        spec = self.take_host_slow(step)
+        return float(spec.slow_s) if spec is not None else 0.0
 
     def heal(self, device_index: int) -> int:
         """Clear active chip faults on a device; returns how many were healed."""

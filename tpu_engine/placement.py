@@ -41,10 +41,12 @@ predicted-fastest feasible plan, ``TPULauncher`` dry runs and
 
 from __future__ import annotations
 
+import json
 import logging
+import os
 import threading
 import time
-from typing import Any, Callable, Iterable, Optional
+from typing import Any, Callable, Iterable, Optional, Sequence
 
 import jax.numpy as jnp
 from pydantic import BaseModel, ConfigDict, Field
@@ -98,6 +100,11 @@ class PlacementPlan(BaseModel):
     # cold compiles — see tpu_engine/compile_index.py).
     compile_warm: Optional[bool] = None
     expected_compile_s: float = 0.0
+    # Mean relative throughput the cost model assumed for this gang (1.0 =
+    # every chip at nominal speed; < 1 when the heterogeneity plane reports
+    # degraded hosts — see tpu_engine/hetero.py). Observability only: the
+    # compute term was already divided by it.
+    assumed_rel_throughput: float = 1.0
     hbm_estimate: Optional[HBMEstimate] = None
     feasible: bool = True
     skip_reason: Optional[str] = None
@@ -259,6 +266,9 @@ class PlacementPlanner:
         hbm_margin_frac: float = 0.35,
         compile_index: Optional[Any] = None,
         prefer_warm_max_slowdown_pct: float = 5.0,
+        throughput_fn: Optional[Callable[[], Sequence[float]]] = None,
+        calibration_path: Optional[str] = None,
+        calibration_alpha: float = 0.3,
     ):
         if peak_flops is None:
             try:
@@ -296,6 +306,12 @@ class PlacementPlanner:
         # plan's one-time compile usually dwarfs that step-time edge).
         self.compile_index = compile_index
         self.prefer_warm_max_slowdown_pct = prefer_warm_max_slowdown_pct
+        # Heterogeneity input: a callable returning per-device relative
+        # throughputs (1.0 = nominal). The compute term is divided by the
+        # gang's mean, so a 25%-degraded host raises the predicted step
+        # time of any plan forced to gate on it. Default None keeps every
+        # existing prediction byte-identical.
+        self.throughput_fn = throughput_fn
 
         self._lock = threading.Lock()
         self.plans_evaluated_total = 0
@@ -308,6 +324,18 @@ class PlacementPlanner:
         self.last_feasible = 0
         self.last_chosen_predicted_s: Optional[float] = None
         self._observations: list[tuple[float, float]] = []  # (predicted, observed)
+
+        # Predicted-vs-observed calibration, persisted alongside the
+        # compile-index sidecar so restarts don't forget what admission
+        # learned (same atomic tmp+rename discipline as compile_index.py).
+        self.calibration_alpha = calibration_alpha
+        self.calibration_persist_errors_total = 0
+        self._calibration_path: Optional[str] = None
+        self._calib_ema_rel_error: Optional[float] = None
+        self._calib_observations_total = 0
+        self._calib_last: Optional[tuple[float, float]] = None
+        if calibration_path is not None:
+            self.attach_calibration(calibration_path)
 
     # -- enumeration ---------------------------------------------------------
 
@@ -451,6 +479,30 @@ class PlacementPlanner:
 
     # -- cost model ----------------------------------------------------------
 
+    def _gang_rel_throughput(self, gang: int) -> float:
+        """Mean relative throughput of the ``gang`` fastest known devices.
+
+        The planner places on the best available chips, so the cost model
+        charges the mean of the top-``gang`` per-device estimates; unknown
+        devices (fewer estimates than gang) count as nominal 1.0. Clamped
+        to (0, 1]: chips never beat nominal, and a dead estimate must not
+        zero the divisor. Any failure in the callable degrades to 1.0 —
+        heterogeneity awareness must never block prediction.
+        """
+        if self.throughput_fn is None:
+            return 1.0
+        try:
+            rates = [float(r) for r in self.throughput_fn()]
+        except Exception:
+            log.debug("throughput_fn consult failed", exc_info=True)
+            return 1.0
+        if not rates:
+            return 1.0
+        top = sorted(rates, reverse=True)[:gang]
+        top += [1.0] * max(gang - len(top), 0)
+        mean = sum(top) / len(top)
+        return min(max(mean, 1e-3), 1.0)
+
     def _predict(
         self, cfg: TPUTrainConfig, model_cfg: tfm.ModelConfig, gang: int
     ) -> PlacementPlan:
@@ -491,6 +543,13 @@ class PlacementPlanner:
         acct = schedule_account(schedule, pipe, accum)
         busy = acct["busy_fraction"] or 1.0
         compute_s /= busy
+        # Heterogeneity: a synchronous gang runs at its mean effective rate
+        # only if rows are rebalanced; without input (rel=1.0) nothing
+        # changes. The divide keeps ranking stable — every candidate on the
+        # same gang is scaled identically, but cross-gang comparisons (grow
+        # targets) see the slow chips.
+        rel = self._gang_rel_throughput(gang)
+        compute_s /= rel
 
         compute_b = jnp.dtype(cfg.compute_dtype()).itemsize
         grad_b = (
@@ -571,6 +630,7 @@ class PlacementPlanner:
             predicted_comm_s=comm_s,
             predicted_exposed_comm_s=exposed_s,
             predicted_step_time_s=max(compute_s, stream_s) + exposed_s,
+            assumed_rel_throughput=rel,
             config=cfg,
         )
         if self.compile_index is not None:
@@ -828,6 +888,78 @@ class PlacementPlanner:
         with self._lock:
             self._observations.append((predicted_s, observed_s))
             del self._observations[:-200]
+            rel_err = abs(predicted_s - observed_s) / observed_s
+            prev = self._calib_ema_rel_error
+            a = self.calibration_alpha
+            self._calib_ema_rel_error = (
+                rel_err if prev is None else (1 - a) * prev + a * rel_err
+            )
+            self._calib_observations_total += 1
+            self._calib_last = (predicted_s, observed_s)
+        if self._calibration_path is not None:
+            self._persist_calibration()
+
+    # -- calibration sidecar -------------------------------------------------
+
+    CALIBRATION_SIDECAR = "placement_calibration.json"
+
+    def attach_calibration(self, cache_dir: str) -> None:
+        """Persist predicted-vs-observed calibration under ``cache_dir``.
+
+        Mirrors the compile-index sidecar: load whatever a previous run
+        learned (the EMA survives restarts, fixing the silent loss of
+        in-memory-only calibration), then keep the file fresh on every
+        ``record_observation``. Attach is idempotent and failure-tolerant.
+        """
+        path = os.path.join(cache_dir, self.CALIBRATION_SIDECAR)
+        self._calibration_path = path
+        self._load_calibration()
+        self._persist_calibration()
+
+    def _load_calibration(self) -> None:
+        path = self._calibration_path
+        if path is None or not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            log.warning("placement calibration sidecar unreadable: %s", path)
+            return
+        with self._lock:
+            ema = doc.get("ema_rel_error")
+            if ema is not None and self._calib_ema_rel_error is None:
+                self._calib_ema_rel_error = float(ema)
+            self._calib_observations_total += int(
+                doc.get("observations_total", 0)
+            )
+            last = doc.get("last")
+            if self._calib_last is None and isinstance(last, (list, tuple)):
+                if len(last) == 2:
+                    self._calib_last = (float(last[0]), float(last[1]))
+
+    def _persist_calibration(self) -> None:
+        path = self._calibration_path
+        if path is None:
+            return
+        with self._lock:
+            doc = {
+                "version": 1,
+                "ema_rel_error": self._calib_ema_rel_error,
+                "alpha": self.calibration_alpha,
+                "observations_total": self._calib_observations_total,
+                "last": list(self._calib_last) if self._calib_last else None,
+            }
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)  # atomic on POSIX: readers never see a torn file
+        except OSError:
+            with self._lock:
+                self.calibration_persist_errors_total += 1
+            log.warning("placement calibration persist failed: %s", path)
 
     def stats(self) -> dict[str, Any]:
         with self._lock:
@@ -848,6 +980,14 @@ class PlacementPlanner:
                 "last_chosen_predicted_s": self.last_chosen_predicted_s,
                 "prune_reasons": top_reasons,
                 "observations_total": len(obs),
+                "throughput_fn_attached": self.throughput_fn is not None,
+                "calibration": {
+                    "attached": self._calibration_path is not None,
+                    "path": self._calibration_path,
+                    "ema_rel_error": self._calib_ema_rel_error,
+                    "observations_total": self._calib_observations_total,
+                    "persist_errors_total": self.calibration_persist_errors_total,
+                },
             }
         if obs:
             errs = [abs(p - o) / o for p, o in obs]

@@ -43,6 +43,7 @@ import jax
 
 from tpu_engine import compile_index as compile_index_mod
 from tpu_engine import goodput as goodput_mod
+from tpu_engine import hetero as hetero_mod
 from tpu_engine import tracing
 from tpu_engine.hbm_estimate import (
     HBMEstimate,
@@ -287,6 +288,11 @@ class FleetScheduler:
         precompile_before_grow: bool = True,
         precompile_deadline_s: float = 60.0,
         precompile_fn: Optional[Callable[..., None]] = None,
+        hetero_rebalance: bool = True,
+        hetero_goodput_floor: float = 0.80,
+        hetero_cooldown_s: float = 30.0,
+        hetero_imbalance_trigger: float = 1.15,
+        hetero_heal_threshold: float = 0.95,
     ):
         self.grow_back = grow_back
         # Hysteresis window: a shrunk job is not grown back until this long
@@ -331,6 +337,16 @@ class FleetScheduler:
         )
         if self.planner.compile_index is None:
             self.planner.compile_index = self.compile_index
+        # Calibration survives restarts next to the checkpoints; the cost
+        # model sees live per-process relative throughput so degraded
+        # hosts surface in every prediction (grow targets included).
+        if self.checkpoint_root and self.planner._calibration_path is None:
+            try:
+                self.planner.attach_calibration(self.checkpoint_root)
+            except Exception:
+                log.warning("placement calibration attach failed", exc_info=True)
+        if self.planner.throughput_fn is None:
+            self.planner.throughput_fn = self._fleet_rel_throughput
 
         self._lock = threading.RLock()
         self._subs: dict[str, Submission] = {}
@@ -354,6 +370,23 @@ class FleetScheduler:
         self.precompiles_started_total = 0
         self.grow_back_warm_total = 0
         self.grow_back_cold_total = 0
+        # Heterogeneity policy (tpu_engine/hetero.py): for a slow-but-
+        # HEALTHY host the scheduler prefers a throughput-weighted
+        # rebalance of the data split over throwing the host away with an
+        # elastic shrink; it shrinks only when the best rebalance cannot
+        # clear hetero_goodput_floor. Shrinks quarantine the slow host's
+        # chips out of admission until the tracker reads them healthy
+        # again (decay-to-1 heals transient stalls).
+        self.hetero_rebalance = hetero_rebalance
+        self.hetero_goodput_floor = float(hetero_goodput_floor)
+        self.hetero_cooldown_s = float(hetero_cooldown_s)
+        self.hetero_imbalance_trigger = float(hetero_imbalance_trigger)
+        self.hetero_heal_threshold = float(hetero_heal_threshold)
+        self.hetero_rebalances_total = 0
+        self.hetero_shrinks_total = 0
+        self.hetero_shrinks_avoided_total = 0
+        self._hetero_quarantined: set[int] = set()
+        self._last_hetero_action_at: Optional[float] = None
         self._wait_samples: list[float] = []  # bounded; admitted-wait seconds
         # Cumulative admission-wait histogram (Prometheus semantics: the
         # bucket counts only grow, unlike the bounded sample window the
@@ -532,6 +565,7 @@ class FleetScheduler:
             self._reap()
             if not self._draining:
                 self._admit()
+                self._maybe_rebalance()
                 self._maybe_grow()
 
     def wait(self, submission_id: str, timeout: Optional[float] = None) -> Submission:
@@ -686,6 +720,15 @@ class FleetScheduler:
             log.exception("scheduler: fleet snapshot failed — capacity-only pass")
             return None
 
+    def _eligible(self, fleet: TPUFleetStatus) -> list:
+        """Placement-eligible chips: healthy AND not hetero-quarantined —
+        a chip shed by a hetero shrink stays out of admission until its
+        throughput estimate heals (``_maybe_rebalance`` releases it)."""
+        return [
+            d for d in fleet.devices
+            if d.is_available and d.index not in self._hetero_quarantined
+        ]
+
     def _admit(self) -> None:
         queued = self._queued()
         if not queued:
@@ -719,7 +762,7 @@ class FleetScheduler:
         freed? (No fleet view → capacity-only admission → always yes.)"""
         if fleet is None or not fleet.devices:
             return True
-        eligible = [d for d in fleet.devices if d.is_available]
+        eligible = self._eligible(fleet)
         if sub.auto_place:
             # The planner re-sizes to whatever is healthy — placeable as
             # long as anything is (HBM may still refuse, like any job).
@@ -795,7 +838,7 @@ class FleetScheduler:
         t_admit0 = time.time()
         eligible = None
         if fleet is not None and fleet.devices:
-            eligible = [d for d in fleet.devices if d.is_available]
+            eligible = self._eligible(fleet)
         n_avail = len(eligible) if eligible is not None else jax.device_count()
 
         estimate_fn = sub.estimate_fn or self.estimate_fn
@@ -1034,6 +1077,160 @@ class FleetScheduler:
             return None
         return [devs[i] for i in placement]
 
+    def _fleet_rel_throughput(self) -> list[float]:
+        """Per-device relative throughput for the placement cost model.
+
+        Expands the active hetero tracker's per-process estimates across
+        each process's chip block; empty list (= assume nominal) when no
+        heterogeneity plane is live."""
+        reb = hetero_mod.get_active()
+        if reb is None:
+            for sub in list(self._subs.values()):
+                cand = getattr(sub.job, "_hetero", None)
+                if cand is not None:
+                    reb = cand
+                    break
+        if reb is None:
+            return []
+        tput = reb.tracker.relative_throughput()
+        n_proc = len(tput)
+        if n_proc == 0:
+            return []
+        fleet = self._fleet()
+        n_dev = len(fleet.devices) if fleet is not None and fleet.devices else n_proc
+        dev_per_proc = max(n_dev // n_proc, 1)
+        return [
+            tput[min(i // dev_per_proc, n_proc - 1)] for i in range(n_dev)
+        ]
+
+    def _maybe_rebalance(self) -> None:
+        """Prefer throughput-weighted rebalance over elastic shrink for
+        slow-but-HEALTHY hosts (``tpu_engine/hetero.py``).
+
+        One decision per pass, cooldown-bounded, audited on the flight
+        recorder. For each running training job with a heterogeneity
+        plane: when its tracker shows sustained imbalance, the scheduler
+        first checks what the best integer row reassignment would recover
+        — if that predicted goodput clears ``hetero_goodput_floor`` the
+        job keeps every chip and the rebalancer acts (an elastic shrink
+        *avoided*); only when rebalance cannot clear the floor does the
+        slow host's chip set get quarantined out of admission and the job
+        preempt-requeued to re-admit at the reduced (full-speed) gang.
+        Quarantined chips are released as soon as the tracker's estimate
+        decays back above ``hetero_heal_threshold`` — grow-back then
+        reclaims them through the normal precompile-gated path."""
+        if not self.hetero_rebalance or self._draining:
+            return
+        if any(s.state == SubmissionState.PREEMPTING for s in self._subs.values()):
+            return
+        now = time.time()
+        for sub in self._subs.values():
+            if sub.state != SubmissionState.RUNNING or sub.workload != "training":
+                continue
+            reb = getattr(sub.job, "_hetero", None)
+            if reb is None:
+                continue
+            tracker = reb.tracker
+            tput = tracker.relative_throughput()
+            n_proc = len(tput)
+            # Heal: release quarantined chips whose owning process's
+            # throughput estimate has decayed back to healthy.
+            if self._hetero_quarantined:
+                fleet = self._fleet()
+                n_dev = len(fleet.devices) if fleet is not None and fleet.devices else n_proc
+                dev_per_proc = max(n_dev // n_proc, 1)
+                healed = {
+                    idx for idx in self._hetero_quarantined
+                    if tput[min(idx // dev_per_proc, n_proc - 1)]
+                    >= self.hetero_heal_threshold
+                }
+                if healed:
+                    self._hetero_quarantined -= healed
+                    tracing.get_recorder().event(
+                        "hetero_quarantine_release",
+                        kind="hetero",
+                        trace_id=sub.trace_id,
+                        parent=sub._root_span,
+                        attrs={"devices": sorted(healed)},
+                    )
+            if tracker.imbalance() < self.hetero_imbalance_trigger:
+                continue
+            if (
+                self.hetero_cooldown_s > 0
+                and self._last_hetero_action_at is not None
+                and now - self._last_hetero_action_at < self.hetero_cooldown_s
+            ):
+                return
+            try:
+                proposed = hetero_mod.solve_row_assignment(
+                    tput, reb.global_micro, min_rows=reb.min_rows
+                )
+            except (hetero_mod.InfeasibleAssignment, ValueError):
+                continue
+            rebalanced = hetero_mod.predicted_goodput(proposed, tput)
+            if rebalanced >= self.hetero_goodput_floor:
+                # Slow but recoverable: rebalance instead of shedding the
+                # host. The job's own rebalancer applies its hysteresis
+                # (cooldown, sustain, min-gain) before anything moves.
+                self.hetero_shrinks_avoided_total += 1
+                plan = reb.maybe_rebalance(
+                    step=getattr(sub.job, "current_step", 0), now=now
+                )
+                if plan is not None:
+                    self.hetero_rebalances_total += 1
+                tracing.get_recorder().event(
+                    "hetero_rebalance_preferred",
+                    kind="hetero",
+                    trace_id=sub.trace_id,
+                    parent=sub._root_span,
+                    attrs={
+                        "predicted_goodput": round(rebalanced, 4),
+                        "goodput_floor": self.hetero_goodput_floor,
+                        "assignment": list(proposed),
+                        "acted": plan is not None,
+                    },
+                )
+                self._last_hetero_action_at = now
+                return
+            if not sub.preemptible:
+                continue
+            # Rebalance cannot clear the floor — shed the slow host:
+            # quarantine its chips and preempt-requeue; re-admission's
+            # elastic_shrink_plan lands the job on the full-speed rest.
+            fleet = self._fleet()
+            n_dev = len(fleet.devices) if fleet is not None and fleet.devices else n_proc
+            dev_per_proc = max(n_dev // n_proc, 1)
+            slow_proc = min(range(n_proc), key=lambda i: tput[i])
+            shed = set(
+                range(slow_proc * dev_per_proc, (slow_proc + 1) * dev_per_proc)
+            )
+            self._hetero_quarantined |= shed
+            self.hetero_shrinks_total += 1
+            self.preemptions_total += 1
+            sub.state = SubmissionState.PREEMPTING
+            sub.last_resize_at = now
+            self._last_hetero_action_at = now
+            tracing.get_recorder().event(
+                "hetero_shrink",
+                kind="hetero",
+                trace_id=sub.trace_id,
+                parent=sub._root_span,
+                attrs={
+                    "predicted_goodput": round(rebalanced, 4),
+                    "goodput_floor": self.hetero_goodput_floor,
+                    "slow_process": slow_proc,
+                    "quarantined": sorted(shed),
+                },
+            )
+            log.info(
+                "scheduler: hetero shrink of %s — best rebalance goodput "
+                "%.3f < floor %.3f; quarantining chips %s",
+                sub.submission_id, rebalanced, self.hetero_goodput_floor,
+                sorted(shed),
+            )
+            sub.job.watcher.simulate_interruption()
+            return
+
     def _maybe_grow(self) -> None:
         """Grow elastic jobs back when quarantined chips recover.
 
@@ -1055,7 +1252,9 @@ class FleetScheduler:
         from tpu_engine.tpu_manager import TPUHealthStatus
 
         healthy_devs = [
-            d for d in fleet.devices if d.health_status != TPUHealthStatus.CRITICAL
+            d for d in fleet.devices
+            if d.health_status != TPUHealthStatus.CRITICAL
+            and d.index not in self._hetero_quarantined
         ]
         healthy = len(healthy_devs)
         now = time.time()
@@ -1357,6 +1556,16 @@ class FleetScheduler:
                 "grow_back_cold_total": self.grow_back_cold_total,
                 "precompile_deadline_s": self.precompile_deadline_s,
                 "precompile_before_grow": self.precompile_before_grow,
+            },
+            "hetero": {
+                "rebalance_enabled": self.hetero_rebalance,
+                "goodput_floor": self.hetero_goodput_floor,
+                "cooldown_s": self.hetero_cooldown_s,
+                "imbalance_trigger": self.hetero_imbalance_trigger,
+                "rebalances_total": self.hetero_rebalances_total,
+                "shrinks_total": self.hetero_shrinks_total,
+                "shrinks_avoided_total": self.hetero_shrinks_avoided_total,
+                "quarantined_devices": sorted(self._hetero_quarantined),
             },
             "running_shrunk": sum(
                 1

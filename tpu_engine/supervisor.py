@@ -95,6 +95,10 @@ class TrainingJob:
         anomaly_detector: Optional[tracing.StepTimeAnomalyDetector] = None,
         anomaly_trace_session: Optional[Any] = None,
         anomaly_trace_dir: Optional[str] = None,
+        hetero_detection: bool = True,
+        hetero_rebalancer: Optional[Any] = None,
+        hetero_check_interval_steps: int = 25,
+        hetero_dry_run: bool = True,
     ):
         self.job_id = job_id
         self.config = config
@@ -162,6 +166,16 @@ class TrainingJob:
         self._prev_step_end_ts: Optional[float] = None
         self.anomalies_total = 0
         self.last_anomaly: Optional[dict[str, Any]] = None
+        # Heterogeneity plane (tpu_engine/hetero.py): per-host throughput
+        # EMA + hysteresis-guarded rebalance of the data split. Dry-run by
+        # default — the detector and audit trail run everywhere, but the
+        # live row reassignment is opt-in per job.
+        self.hetero_detection = hetero_detection
+        self._hetero = hetero_rebalancer
+        self.hetero_check_interval_steps = max(1, int(hetero_check_interval_steps))
+        self.hetero_dry_run = hetero_dry_run
+        self.hetero_rebalances_total = 0
+        self._last_slow_proc: Optional[int] = None
 
         self.status = JobStatus.PENDING
         self.error: Optional[str] = None
@@ -703,6 +717,21 @@ class TrainingJob:
                     self.config.gradient_accumulation_steps,
                 ),
             )
+            if self._hetero is None and self.hetero_detection:
+                from tpu_engine import hetero as hetero_mod
+
+                _, gm_h, _ = prog.global_batch_shape()
+                n_proc = max(jax.process_count(), 1)
+                self._hetero = hetero_mod.HeteroRebalancer(
+                    hetero_mod.ThroughputTracker(n_proc),
+                    gm_h,
+                    dry_run=self.hetero_dry_run,
+                    trace_id=self.trace_id,
+                )
+            if self._hetero is not None:
+                from tpu_engine import hetero as hetero_mod
+
+                hetero_mod.set_active(self._hetero)
             step = start_step
             while step < self.max_steps and not self._stop.is_set():
                 self.profiler.begin_step()
@@ -733,7 +762,8 @@ class TrainingJob:
                 inj = self._injector()
                 if inj is not None:
                     inj.observe_step(step)
-                    slow = inj.host_slow_penalty_s(step)
+                    slow_spec = inj.take_host_slow(step)
+                    slow = float(slow_spec.slow_s) if slow_spec is not None else 0.0
                     if slow > 0:
                         # Host-slow is a *reported* stall (step time +
                         # throughput degrade) — never an actual sleep, so
@@ -747,6 +777,20 @@ class TrainingJob:
                             parent=attempt_span,
                             attrs={"step": step, "penalty_s": slow},
                         )
+                        if self._hetero is not None:
+                            # Attribute the stall to the host the spec
+                            # names (fleet device index → owning process).
+                            n_proc = self._hetero.tracker.n_processes
+                            dev_per_proc = max(
+                                prog.runtime.n_devices // n_proc, 1
+                            )
+                            proc = (
+                                slow_spec.device_index // dev_per_proc
+                                if slow_spec.device_index is not None
+                                else None
+                            )
+                            self._last_slow_proc = proc
+                            self._hetero.tracker.note_host_slow(proc, slow, dt)
                     if inj.preempt_due(step):
                         # Synchronous injection (not via the watcher thread):
                         # the step that triggers is the step that saves.
@@ -784,6 +828,13 @@ class TrainingJob:
                         anom["cause"] = cause
                         self.anomalies_total += 1
                         self.last_anomaly = dict(anom)
+                        if self._hetero is not None:
+                            # Sustained host-slow attribution seeds the
+                            # throughput tracker even when no injector
+                            # reported a penalty (real-fleet path).
+                            self._hetero.tracker.note_attribution(
+                                cause, anom, self._last_slow_proc
+                            )
                         rec.record_anomaly(
                             cause,
                             trace_id=self.trace_id,
@@ -826,6 +877,42 @@ class TrainingJob:
                                     attrs={"error": str(e)},
                                 )
                     self._prev_step_end_ts = now_ts
+
+                # Heterogeneity plane: every step feeds the throughput EMA
+                # (decay-to-1 heals transient stalls); every
+                # hetero_check_interval_steps the rebalancer is consulted.
+                # A live (non-dry-run) plan moves the data split through
+                # data_fn.reassign — the declared global batch is preserved
+                # exactly (validated again at the data layer).
+                if self._hetero is not None:
+                    self._hetero.tracker.observe_step(
+                        self.last_step_time_s if self.last_step_time_s else dt
+                    )
+                    if step % self.hetero_check_interval_steps == 0:
+                        h_plan = self._hetero.maybe_rebalance(step)
+                        if h_plan is not None and not h_plan.dry_run:
+                            reassign_fn = getattr(self.data_fn, "reassign", None)
+                            if reassign_fn is not None:
+                                try:
+                                    reassign_fn(h_plan.assignment)
+                                    self.hetero_rebalances_total += 1
+                                    rec.event(
+                                        "hetero_reassign",
+                                        kind="hetero",
+                                        trace_id=self.trace_id,
+                                        parent=attempt_span,
+                                        attrs={
+                                            "step": step,
+                                            "assignment": list(h_plan.assignment),
+                                        },
+                                    )
+                                except ValueError as e:
+                                    rec.event(
+                                        "hetero_reassign_rejected",
+                                        kind="hetero",
+                                        trace_id=self.trace_id,
+                                        attrs={"step": step, "error": str(e)},
+                                    )
 
                 alerts = self.monitor.ingest(
                     TrainingMetrics(
@@ -919,6 +1006,13 @@ class TrainingJob:
                     anomalies=self.anomalies_total,
                 )
             telemetry.unregister_job_devices(self.job_id)
+            # Release the process-wide hetero plane only if this job owns it
+            # (a newer job may already have installed its own rebalancer).
+            if self._hetero is not None:
+                from tpu_engine import hetero as hetero_mod
+
+                if hetero_mod.get_active() is self._hetero:
+                    hetero_mod.clear_active()
             # Stop a sharded-read prefetch thread with the job (make_data_fn
             # attaches close when it owns a stream).
             close_fn = getattr(self.data_fn, "close", None)
@@ -1345,6 +1439,8 @@ class TrainingJob:
             "trace_id": self.trace_id,
             "anomalies_total": self.anomalies_total,
             "last_anomaly": self.last_anomaly,
+            "hetero": self._hetero.stats() if self._hetero is not None else None,
+            "hetero_rebalances_total": self.hetero_rebalances_total,
             "started_at": self.started_at,
             "finished_at": self.finished_at,
             "last_step_time_s": self.last_step_time_s,
