@@ -511,3 +511,137 @@ def test_make_data_fn_rejects_indivisible_process_count(token_file):
     with pytest.raises(ValueError, match="not divisible"):
         make_data_fn(prog, ds, process_count=3, process_index=0)
     ds.close()
+
+
+# ---------------------------------------------------------------------------
+# non-uniform assignments vs the sharding's fixed per-process partition
+# ---------------------------------------------------------------------------
+
+
+class _FakeDev:
+    def __init__(self, process_index):
+        self.process_index = process_index
+
+
+class _FakeSharding:
+    """Stands in for a NamedSharding on a multi-host mesh: the batch axis
+    (dim 1) is split into the fixed per-process row blocks GSPMD places,
+    optionally subdivided across each process's devices."""
+
+    def __init__(self, rows_per_proc, dev_per_proc=2):
+        self.rows_per_proc = rows_per_proc
+        self.dev_per_proc = dev_per_proc
+
+    def devices_indices_map(self, global_shape):
+        out = {}
+        start = 0
+        for p, rows in enumerate(self.rows_per_proc):
+            per_dev = rows // self.dev_per_proc
+            for _ in range(self.dev_per_proc):
+                out[_FakeDev(p)] = (
+                    slice(None), slice(start, start + per_dev), slice(None),
+                )
+                start += per_dev
+        return out
+
+
+def test_sharding_batch_partition_reads_per_process_rows():
+    from tpu_engine.data import _sharding_batch_partition
+
+    assert _sharding_batch_partition(_FakeSharding([4, 4]), (2, 8, 16)) == [4, 4]
+    assert _sharding_batch_partition(_FakeSharding([5, 3], dev_per_proc=1), (2, 8, 16)) == [5, 3]
+    # Mock shardings that cannot answer degrade to None, not an exception.
+    class _Opaque:
+        pass
+    assert _sharding_batch_partition(_Opaque(), (2, 8, 16)) is None
+
+
+def test_check_stream_assignment_feasible_multiprocess(monkeypatch):
+    import jax
+
+    from tpu_engine.data import _check_stream_assignment_feasible
+
+    sh = _FakeSharding([4, 4])
+    # Single-process runtime: anything validate() accepted is placeable.
+    _check_stream_assignment_feasible([5, 3], sh, (1, 8, 64))
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    # Matching the fixed partition is fine; deviating must fail loudly —
+    # a stream process cannot feed rows to devices on another host.
+    _check_stream_assignment_feasible([4, 4], sh, (1, 8, 64))
+    with pytest.raises(ValueError, match="per-process batch partition"):
+        _check_stream_assignment_feasible([5, 3], sh, (1, 8, 64))
+    # Unknowable partition (mock sharding): defer to jax's own size check.
+    class _Opaque:
+        pass
+    _check_stream_assignment_feasible([5, 3], _Opaque(), (1, 8, 64))
+
+
+def test_place_global_falls_back_to_full_batch_off_partition(monkeypatch):
+    import jax
+
+    from tpu_engine.data import _place_global
+
+    calls = []
+
+    def fake_make(sharding, local, global_shape=None):
+        calls.append(local.shape)
+        return local
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    monkeypatch.setattr(jax, "make_array_from_process_local_data", fake_make)
+    batch = np.zeros((2, 8, 4), dtype=np.int32)
+
+    # No assignment: the implicit equal split slices this process's block.
+    _place_global(batch, _FakeSharding([4, 4]))
+    assert calls[-1] == (2, 4, 4)
+    # Assignment equal to the partition: sliced per-process block, with
+    # the offset from the prefix sum (rows 5..8 for process 1 here).
+    _place_global(batch, _FakeSharding([5, 3], dev_per_proc=1), [5, 3])
+    assert calls[-1] == (2, 3, 4)
+    # Assignment off the partition: the per-process block cannot be
+    # assembled (jax would raise, or worse silently misplace rows when
+    # only the prefix offsets drift) — every process holds the identical
+    # synthetic batch, so the full array is placed and each device slices
+    # its own shard.
+    _place_global(batch, _FakeSharding([4, 4]), [5, 3])
+    assert calls[-1] == (2, 8, 4)
+    # ...including the silent-misplacement shape: this process's row
+    # COUNT matches its partition entry but an earlier process's does
+    # not, so the prefix offset drifts and jax's size check would pass.
+    _place_global(batch, _FakeSharding([2, 2, 2, 2], dev_per_proc=1), [1, 2, 3, 2])
+    assert calls[-1] == (2, 8, 4)
+
+
+def test_make_data_fn_rejects_partition_incompatible_stream_assignment(
+    token_file, monkeypatch
+):
+    import jax
+    from types import SimpleNamespace
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    prog = SimpleNamespace(
+        global_batch_shape=lambda: (1, 8, 64),
+        batch_sharding=_FakeSharding([4, 4]),
+    )
+    ds = TokenFileDataset(token_file, seq_len=64)
+    try:
+        # Construction rejects a vector the sharding cannot place...
+        with pytest.raises(ValueError, match="per-process batch partition"):
+            make_data_fn(
+                prog, ds, process_count=2, process_index=0,
+                row_assignment=[5, 3],
+            )
+        # ...and a live reassign() is re-checked the same way, keeping the
+        # old split (the supervisor audits this as hetero_reassign_rejected).
+        fn = make_data_fn(
+            prog, ds, process_count=2, process_index=0, row_assignment=[4, 4],
+        )
+        try:
+            with pytest.raises(ValueError, match="per-process batch partition"):
+                fn.reassign([5, 3])
+            assert fn.reassign([4, 4]) == [4, 4]
+        finally:
+            fn.close()
+    finally:
+        ds.close()

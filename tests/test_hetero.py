@@ -17,6 +17,7 @@ from tpu_engine.hetero import (
     HeteroRebalancer,
     InfeasibleAssignment,
     ThroughputTracker,
+    broadcast_agree_fn,
     clear_active,
     get_active,
     hbm_max_rows_fn,
@@ -378,6 +379,96 @@ def test_recovered_goodput_fraction():
     assert st["assignment"] == reb.assignment
     assert st["last_plan"]["step"] == 1
     assert st["tracker"]["n_processes"] == 2
+
+
+def test_consult_request_is_served_and_cleared_by_any_consult():
+    t = [0.0]
+    reb = HeteroRebalancer(
+        ThroughputTracker(2), 8, sustain_consults=1, clock=lambda: t[0],
+        recorder=FlightRecorder(clock=lambda: t[0]),
+    )
+    assert not reb.consult_pending()
+    reb.request_consult()
+    assert reb.consult_pending()
+    # A balanced gang declines the consult, but the request is still served.
+    assert reb.maybe_rebalance(1) is None
+    assert not reb.consult_pending()
+    assert reb.stats()["consult_requested"] is False
+
+
+def test_step_based_cooldown_ignores_wall_clock():
+    t = [0.0]
+    trk = _slow_tracker()
+    reb = HeteroRebalancer(
+        trk, 8, sustain_consults=1, min_gain=0.01, cooldown_s=0.0,
+        cooldown_steps=10, dry_run=False, clock=lambda: t[0],
+        recorder=FlightRecorder(clock=lambda: t[0]),
+    )
+    assert reb.maybe_rebalance(1) is not None
+    for _ in range(40):
+        trk.note_host_slow(1, 4.0, 1.0)  # degrade further -> new proposal
+    # Clock skew must not let one rank act while its peers hold: with
+    # cooldown_steps set, an enormous wall-clock jump changes nothing.
+    t[0] = 1e6
+    assert reb.maybe_rebalance(5) is None
+    assert reb.skips["cooldown"] == 1
+    assert reb.maybe_rebalance(11) is not None
+    assert reb.rebalances_total == 2
+    assert reb.stats()["last_rebalance_step"] == 11
+
+
+def test_agree_fn_aligns_ranks_with_divergent_local_estimates():
+    """Two ranks whose local trackers disagree still derive the identical
+    plan when both solve from the broadcast (agreed) estimates."""
+    t = [0.0]
+    agreed = [1.0, 0.5]
+    plans = []
+    # Rank A saw the slowdown locally; rank B's local tracker is uniform
+    # (it would have skipped as "balanced" without the agreement hook).
+    for local in (_slow_tracker(), ThroughputTracker(2)):
+        reb = HeteroRebalancer(
+            local, 8, sustain_consults=1, min_gain=0.01, dry_run=False,
+            agree_fn=lambda tput: list(agreed), clock=lambda: t[0],
+            recorder=FlightRecorder(clock=lambda: t[0]),
+        )
+        plans.append(reb.maybe_rebalance(1))
+    assert plans[0] is not None and plans[1] is not None
+    assert plans[0].assignment == plans[1].assignment
+    assert plans[0].throughputs == plans[1].throughputs == agreed
+
+
+def test_broadcast_agree_fn_is_identity_on_single_process():
+    agree = broadcast_agree_fn()
+    assert agree([1.0, 0.5, 0.25]) == [1.0, 0.5, 0.25]
+
+
+def test_revert_restores_assignment_and_audits():
+    t = [0.0]
+    rec = FlightRecorder(clock=lambda: t[0])
+    reb = HeteroRebalancer(
+        _slow_tracker(), 8, sustain_consults=1, min_gain=0.01,
+        dry_run=False, clock=lambda: t[0], recorder=rec,
+    )
+    plan = reb.maybe_rebalance(1)
+    assert plan is not None and reb.assignment == plan.assignment
+    # The data layer refused the windows: the gauge must not keep
+    # reporting a split that is not actually feeding the mesh.
+    reb.revert(plan)
+    assert reb.assignment == plan.previous == [4, 4]
+    assert reb.reverts_total == 1
+    assert reb.recovered_goodput_fraction() == 0.0
+    names = [e["name"] for e in rec.events(kind="hetero")]
+    assert "hetero_rebalance_reverted" in names
+
+    # Dry-run plans never moved anything — revert is a no-op.
+    dry = HeteroRebalancer(
+        _slow_tracker(), 8, sustain_consults=1, min_gain=0.01,
+        dry_run=True, clock=lambda: t[0], recorder=rec,
+    )
+    p2 = dry.maybe_rebalance(1)
+    assert p2 is not None and p2.dry_run
+    dry.revert(p2)
+    assert dry.reverts_total == 0
 
 
 def test_active_singleton():

@@ -443,6 +443,57 @@ def validate_row_assignment(
     return rows
 
 
+def _sharding_batch_partition(
+    sharding: Any, global_shape: Any
+) -> Optional[list[int]]:
+    """Batch-axis (dim 1) rows each process's devices address under
+    ``sharding``, ordered by process index — the fixed partition GSPMD
+    places; ``None`` when the sharding cannot tell (mock shardings in
+    tests). A non-uniform row assignment can only be placed from
+    per-process local blocks when it equals this partition exactly:
+    anything else either fails jax's per-dimension size check or — worse,
+    when only the prefix offsets drift — silently misplaces rows."""
+    try:
+        idx_map = sharding.devices_indices_map(tuple(global_shape))
+        per_proc: dict[int, set] = {}
+        for dev, idx in idx_map.items():
+            s = idx[1]
+            start = 0 if s.start is None else int(s.start)
+            stop = int(global_shape[1]) if s.stop is None else int(s.stop)
+            per_proc.setdefault(int(dev.process_index), set()).add((start, stop))
+        if not per_proc:
+            return None
+        return [
+            sum(b - a for a, b in spans)
+            for _, spans in sorted(per_proc.items())
+        ]
+    except Exception:
+        return None
+
+
+def _check_stream_assignment_feasible(
+    rows: list[int], sharding: Any, global_shape: Any
+) -> None:
+    """A sharded-stream process reads ONLY its own row window, so on a
+    real multi-process runtime a non-uniform assignment is placeable only
+    when it matches the sharding's fixed per-process batch partition —
+    rows a process read but whose devices live on another host cannot
+    cross hosts here. Reject loudly (the supervisor audits the rejection
+    as ``hetero_reassign_rejected`` and keeps the old split) instead of
+    letting the placement misplace or drop rows mid-step."""
+    if jax.process_count() <= 1:
+        return  # single-process runtime, incl. the process_count test seam
+    partition = _sharding_batch_partition(sharding, global_shape)
+    if partition is None or partition == rows:
+        return
+    raise ValueError(
+        f"row assignment {rows} does not match the sharding's per-process "
+        f"batch partition {partition}; a sharded stream cannot place rows "
+        "its own devices do not address (heterogeneous sharding is limited "
+        "to partition-compatible assignments on multi-host runtimes)"
+    )
+
+
 def _place_global(
     batch: np.ndarray, sharding: Any, row_assignment: Optional[list[int]] = None
 ) -> jax.Array:
@@ -454,13 +505,22 @@ def _place_global(
     the sequence axis, if sharded, stays process-local on one host's slice
     under the canonical (data, fsdp, sequence, model) order). A
     ``row_assignment`` replaces the implicit equal split with per-process
-    block sizes (prefix sums give the offsets). File-backed multi-process
-    reads do NOT come through here — ``make_data_fn`` shards the reads
-    themselves (``_ShardedTokenStream``).
+    block sizes (prefix sums give the offsets) — but GSPMD's batch
+    partition is fixed per process, so when the assignment deviates from
+    it the per-process block cannot be assembled; since every process
+    holds the identical batch anyway, placement then falls back to the
+    full array (each device slices its own shard directly). File-backed
+    multi-process reads do NOT come through here — ``make_data_fn``
+    shards the reads themselves (``_ShardedTokenStream``).
     """
     if jax.process_count() > 1:
         pi = jax.process_index()
         if row_assignment is not None:
+            partition = _sharding_batch_partition(sharding, batch.shape)
+            if partition != [int(r) for r in row_assignment]:
+                return jax.make_array_from_process_local_data(
+                    sharding, batch, global_shape=batch.shape
+                )
             r0 = sum(row_assignment[:pi])
             rows = row_assignment[pi]
         else:
@@ -507,8 +567,15 @@ def make_data_fn(
     sharding, ``tpu_engine/hetero.py``); it must sum to the global micro
     batch exactly. The returned ``data_fn`` additionally exposes
     ``data_fn.reassign(assignment)`` so a live rebalance can move the row
-    windows without rebuilding the stream — callers must invoke it at the
-    same step boundary on every process.
+    windows without rebuilding the stream. Cross-process agreement is the
+    rebalancer's job, not a caller convention: ``HeteroRebalancer`` runs
+    step-keyed consults from broadcast (rank-0) estimates with a
+    step-based cooldown, so every process calls ``reassign`` with the
+    identical vector at the identical step boundary. On real multi-host
+    runtimes the vector must additionally match the sharding's fixed
+    per-process batch partition (a stream process cannot feed devices on
+    another host) — incompatible vectors raise ``ValueError``, which the
+    supervisor audits as ``hetero_reassign_rejected``.
     """
     accum, global_micro, seq_len = program.global_batch_shape()
     _check_seq_len(dataset, seq_len)
@@ -520,6 +587,9 @@ def make_data_fn(
         if row_assignment is not None:
             rows_vec = validate_row_assignment(
                 row_assignment, global_micro, pc, accum
+            )
+            _check_stream_assignment_feasible(
+                rows_vec, sharding, (accum, global_micro, seq_len)
             )
         else:
             if global_micro % pc != 0:
@@ -540,6 +610,9 @@ def make_data_fn(
 
         def reassign(assignment: Any) -> list[int]:
             rv = validate_row_assignment(assignment, global_micro, pc, accum)
+            _check_stream_assignment_feasible(
+                rv, sharding, (accum, global_micro, seq_len)
+            )
             stream.reassign(sum(rv[:pi]), rv[pi])
             return rv
 

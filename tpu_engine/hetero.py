@@ -370,16 +370,69 @@ class RebalancePlan:
         }
 
 
+def broadcast_agree_fn() -> Callable[[Sequence[float]], list[float]]:
+    """Cross-process agreement hook for :class:`HeteroRebalancer`.
+
+    Every process adopts process 0's throughput estimates before solving,
+    so — together with step-keyed consults and a step-based cooldown —
+    all ranks derive the identical assignment at the identical step and
+    the per-process row windows can never overlap or gap. Identity on a
+    single-process runtime; degrades to the process-local estimates (with
+    one warning) when the collective is unavailable.
+    """
+    warned = [False]
+
+    def agree(tput: Sequence[float]) -> list[float]:
+        vals = [float(t) for t in tput]
+        try:
+            import jax
+
+            if jax.process_count() <= 1:
+                return vals
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            out = multihost_utils.broadcast_one_to_all(
+                np.asarray(vals, np.float64)
+            )
+            return [float(x) for x in out]
+        except Exception:
+            if not warned[0]:
+                warned[0] = True
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "hetero: cross-process broadcast unavailable; falling "
+                    "back to process-local throughput estimates",
+                    exc_info=True,
+                )
+            return vals
+
+    return agree
+
+
 class HeteroRebalancer:
     """Hysteresis-guarded rebalance loop over a :class:`ThroughputTracker`.
 
     ``maybe_rebalance`` is safe to call every step: it acts at most once
-    per ``cooldown_s`` window, only after ``sustain_consults`` consecutive
-    consults propose a different split (a single noisy reading never moves
-    the gang), and only when the predicted goodput gain clears
-    ``min_gain``. ``dry_run=True`` (the default) evaluates and audits the
-    decision without changing the live assignment — the supervisor flips
-    it per job. Every path lands an audit event on the flight recorder.
+    per cooldown window (``cooldown_s`` wall-clock, or ``cooldown_steps``
+    when set — the deterministic choice for multi-process gangs), only
+    after ``sustain_consults`` consecutive consults propose a different
+    split (a single noisy reading never moves the gang), and only when the
+    predicted goodput gain clears ``min_gain``. ``dry_run=True`` (the
+    default) evaluates and audits the decision without changing the live
+    assignment — the supervisor flips it per job. Every path lands an
+    audit event on the flight recorder.
+
+    Cross-process agreement is *enforced*, not assumed: on multi-process
+    runtimes the owner wires ``agree_fn`` (see :func:`broadcast_agree_fn`)
+    so every rank solves from rank 0's estimates, consults happen at the
+    same step on every rank (the supervisor's step-keyed modulo check),
+    and ``cooldown_steps`` replaces wall-clock cooldown so no rank's clock
+    skew can make it skip a consult its peers acted on. Out-of-band
+    consult requests (:meth:`request_consult`, the scheduler's
+    rebalance-over-shrink path) are therefore only honored between step
+    boundaries on single-process runtimes.
     """
 
     def __init__(
@@ -389,11 +442,13 @@ class HeteroRebalancer:
         *,
         min_rows: int = 1,
         cooldown_s: float = 60.0,
+        cooldown_steps: Optional[int] = None,
         imbalance_trigger: float = 1.15,
         min_gain: float = 0.03,
         sustain_consults: int = 2,
         dry_run: bool = True,
         max_rows_fn: Optional[Callable[[int, int], Optional[int]]] = None,
+        agree_fn: Optional[Callable[[Sequence[float]], list[float]]] = None,
         clock: Callable[[], float] = time.time,
         recorder: Optional[Any] = None,
         trace_id: Optional[str] = None,
@@ -402,21 +457,28 @@ class HeteroRebalancer:
         self.global_micro = int(global_micro)
         self.min_rows = int(min_rows)
         self.cooldown_s = float(cooldown_s)
+        self.cooldown_steps = (
+            None if cooldown_steps is None else int(cooldown_steps)
+        )
         self.imbalance_trigger = float(imbalance_trigger)
         self.min_gain = float(min_gain)
         self.sustain_consults = int(sustain_consults)
         self.dry_run = bool(dry_run)
         self.max_rows_fn = max_rows_fn
+        self.agree_fn = agree_fn
         self.clock = clock
         self._recorder = recorder
         self.trace_id = trace_id or "fleet"
         self._lock = threading.Lock()
         self.assignment = uniform_assignment(self.global_micro, tracker.n_processes)
         self.last_rebalance_at: Optional[float] = None
+        self.last_rebalance_step: Optional[int] = None
         self.last_plan: Optional[RebalancePlan] = None
         self._pending = 0  # consecutive consults proposing a change
+        self._consult_requested = False
         self.rebalances_total = 0
         self.dry_runs_total = 0
+        self.reverts_total = 0
         self.consults_total = 0
         self.skips: dict[str, int] = {
             "cooldown": 0, "balanced": 0, "sustain": 0, "gain": 0, "hbm": 0,
@@ -428,6 +490,33 @@ class HeteroRebalancer:
     def _skip(self, reason: str) -> None:
         self.skips[reason] = self.skips.get(reason, 0) + 1
 
+    def request_consult(self) -> None:
+        """Ask the owner to serve ``maybe_rebalance`` at its next step
+        boundary (the ``FleetScheduler``'s rebalance-over-shrink path).
+        The scheduler thread never moves rows itself: only the
+        supervisor's step loop is a safe reassignment point, and on
+        multi-process runtimes only a step-keyed consult keeps the ranks
+        in agreement."""
+        with self._lock:
+            self._consult_requested = True
+
+    def consult_pending(self) -> bool:
+        with self._lock:
+            return self._consult_requested
+
+    def _in_cooldown(self, step: int, now: float) -> bool:
+        # Caller holds the lock. Step-based when configured (deterministic
+        # across processes); wall-clock otherwise.
+        if self.cooldown_steps is not None:
+            return (
+                self.last_rebalance_step is not None
+                and int(step) - self.last_rebalance_step < self.cooldown_steps
+            )
+        return (
+            self.last_rebalance_at is not None
+            and now - self.last_rebalance_at < self.cooldown_s
+        )
+
     def maybe_rebalance(
         self, step: int, now: Optional[float] = None
     ) -> Optional[RebalancePlan]:
@@ -436,7 +525,12 @@ class HeteroRebalancer:
         now = self.clock() if now is None else float(now)
         with self._lock:
             self.consults_total += 1
+            self._consult_requested = False  # this consult serves any request
             tput = self.tracker.relative_throughput()
+            if self.agree_fn is not None:
+                agreed = [float(t) for t in self.agree_fn(tput)]
+                if len(agreed) == len(tput):
+                    tput = agreed
             n = len(tput)
             rows_u = max(self.global_micro // n, 1)
             caps = None
@@ -456,7 +550,10 @@ class HeteroRebalancer:
                 self._pending = 0
                 self._skip("balanced")
                 return None
-            imb = self.tracker.imbalance()
+            # Imbalance from the AGREED estimates (== the tracker's own
+            # when no agree_fn): every rank must take the same branch.
+            lo = min(tput)
+            imb = (max(tput) / lo) if lo > 0 else float("inf")
             before = predicted_goodput(self.assignment, tput)
             after = predicted_goodput(proposed, tput)
             # Healing back toward uniform is triggered by the *gain*, not
@@ -470,10 +567,7 @@ class HeteroRebalancer:
             if self._pending < self.sustain_consults:
                 self._skip("sustain")
                 return None
-            if (
-                self.last_rebalance_at is not None
-                and now - self.last_rebalance_at < self.cooldown_s
-            ):
+            if self._in_cooldown(step, now):
                 self._skip("cooldown")
                 return None
             if after - before < self.min_gain:
@@ -494,6 +588,7 @@ class HeteroRebalancer:
             )
             self.last_plan = plan
             self.last_rebalance_at = now
+            self.last_rebalance_step = int(step)
             self._pending = 0
             if self.dry_run:
                 self.dry_runs_total += 1
@@ -511,6 +606,24 @@ class HeteroRebalancer:
             )
         except Exception:
             pass  # audit must never take the step loop down
+
+    def revert(self, plan: RebalancePlan) -> None:
+        """Roll back a live plan the caller could not apply (the data
+        layer rejected the windows, or there is no ``reassign`` seam at
+        all): restore the previous assignment so
+        ``hetero_assignment_rows`` and ``recovered_goodput_fraction``
+        never report a split that is not actually feeding the mesh."""
+        if plan.dry_run:
+            return
+        with self._lock:
+            if self.assignment == list(plan.assignment):
+                self.assignment = list(plan.previous)
+            self.reverts_total += 1
+        self._audit(
+            "hetero_rebalance_reverted", plan.step, self.clock(),
+            {"assignment": list(plan.previous),
+             "rejected": list(plan.assignment)},
+        )
 
     def recovered_goodput_fraction(self) -> float:
         """Predicted goodput of the live assignment minus the uniform
@@ -531,13 +644,17 @@ class HeteroRebalancer:
                 "assignment": list(self.assignment),
                 "dry_run": self.dry_run,
                 "cooldown_s": self.cooldown_s,
+                "cooldown_steps": self.cooldown_steps,
                 "imbalance_trigger": self.imbalance_trigger,
                 "min_gain": self.min_gain,
                 "consults_total": self.consults_total,
                 "rebalances_total": self.rebalances_total,
                 "dry_runs_total": self.dry_runs_total,
+                "reverts_total": self.reverts_total,
+                "consult_requested": self._consult_requested,
                 "skips": dict(self.skips),
                 "last_rebalance_at": self.last_rebalance_at,
+                "last_rebalance_step": self.last_rebalance_step,
                 "last_plan": self.last_plan.describe() if self.last_plan else None,
                 "tracker": self.tracker.stats(),
             }

@@ -293,6 +293,7 @@ class FleetScheduler:
         hetero_cooldown_s: float = 30.0,
         hetero_imbalance_trigger: float = 1.15,
         hetero_heal_threshold: float = 0.95,
+        hetero_quarantine_ttl_s: float = 900.0,
     ):
         self.grow_back = grow_back
         # Hysteresis window: a shrunk job is not grown back until this long
@@ -374,18 +375,30 @@ class FleetScheduler:
         # HEALTHY host the scheduler prefers a throughput-weighted
         # rebalance of the data split over throwing the host away with an
         # elastic shrink; it shrinks only when the best rebalance cannot
-        # clear hetero_goodput_floor. Shrinks quarantine the slow host's
-        # chips out of admission until the tracker reads them healthy
-        # again (decay-to-1 heals transient stalls).
+        # clear hetero_goodput_floor. The scheduler never moves rows
+        # itself — it requests a consult that the job's own rebalancer
+        # serves at its next step boundary (the only safe reassignment
+        # point), and counts the shrink as avoided only once that consult
+        # actually fires a plan. Shrinks quarantine the slow host's chips
+        # out of admission; quarantine entries carry their owner + age and
+        # are released when the tracker reads the host healthy again, the
+        # owning submission leaves the scheduler, no tracker can vouch for
+        # the chip, or the TTL expires — never held forever.
         self.hetero_rebalance = hetero_rebalance
         self.hetero_goodput_floor = float(hetero_goodput_floor)
         self.hetero_cooldown_s = float(hetero_cooldown_s)
         self.hetero_imbalance_trigger = float(hetero_imbalance_trigger)
         self.hetero_heal_threshold = float(hetero_heal_threshold)
+        self.hetero_quarantine_ttl_s = float(hetero_quarantine_ttl_s)
         self.hetero_rebalances_total = 0
         self.hetero_shrinks_total = 0
         self.hetero_shrinks_avoided_total = 0
-        self._hetero_quarantined: set[int] = set()
+        self.hetero_rebalance_preferred_total = 0
+        # device index → {"owner": submission_id, "ts": quarantined-at}.
+        self._hetero_quarantined: dict[int, dict[str, Any]] = {}
+        # submission_id → (rebalances+dry_runs) baseline at consult-request
+        # time; resolved by _resolve_hetero_consults on later passes.
+        self._hetero_pending: dict[str, int] = {}
         self._last_hetero_action_at: Optional[float] = None
         self._wait_samples: list[float] = []  # bounded; admitted-wait seconds
         # Cumulative admission-wait histogram (Prometheus semantics: the
@@ -1103,6 +1116,82 @@ class FleetScheduler:
             tput[min(i // dev_per_proc, n_proc - 1)] for i in range(n_dev)
         ]
 
+    def _heal_quarantine(self, now: float) -> None:
+        """Release quarantined chips. Runs every pass, independent of the
+        job loop, so an entry can never outlive anyone able to vouch for
+        it: released when the owning submission's tracker reads the chip's
+        process healthy again (``hetero_heal_threshold``), when the owner
+        has left the scheduler or reached a terminal state, when the owner
+        is RUNNING without
+        a heterogeneity plane (no tracker will ever vouch), or when the
+        quarantine TTL expires. Grow-back then reclaims the chips through
+        the normal precompile-gated path."""
+        if not self._hetero_quarantined:
+            return
+        released: dict[str, list[int]] = {}
+        for idx, ent in list(self._hetero_quarantined.items()):
+            sub = self._subs.get(ent["owner"])
+            reason = None
+            if sub is None or sub.state in TERMINAL_STATES:
+                # Finished/failed/cancelled owners are kept in _subs as
+                # history; their quarantine must not outlive them.
+                reason = "owner-gone"
+            elif (
+                self.hetero_quarantine_ttl_s > 0
+                and now - ent["ts"] >= self.hetero_quarantine_ttl_s
+            ):
+                reason = "ttl-expired"
+            elif sub.state == SubmissionState.RUNNING:
+                reb = getattr(sub.job, "_hetero", None)
+                if reb is None:
+                    reason = "no-tracker"
+                else:
+                    tput = reb.tracker.relative_throughput()
+                    n_proc = len(tput)
+                    if n_proc:
+                        fleet = self._fleet()
+                        n_dev = (
+                            len(fleet.devices)
+                            if fleet is not None and fleet.devices else n_proc
+                        )
+                        dev_per_proc = max(n_dev // n_proc, 1)
+                        if (
+                            tput[min(idx // dev_per_proc, n_proc - 1)]
+                            >= self.hetero_heal_threshold
+                        ):
+                            reason = "healed"
+            if reason is not None:
+                del self._hetero_quarantined[idx]
+                released.setdefault(reason, []).append(idx)
+        for reason, idxs in released.items():
+            tracing.get_recorder().event(
+                "hetero_quarantine_release",
+                kind="hetero",
+                trace_id="fleet",
+                attrs={"devices": sorted(idxs), "reason": reason},
+            )
+
+    def _resolve_hetero_consults(self) -> None:
+        """Settle earlier rebalance-preferred decisions: a shrink counts
+        as *avoided* only once the job's rebalancer actually fired a plan
+        (live or dry-run) for the requested consult — a consult that
+        declined (cooldown, sustain, gain floor) is dropped without
+        inflating the headline counter."""
+        for sid, baseline in list(self._hetero_pending.items()):
+            sub = self._subs.get(sid)
+            reb = getattr(sub.job, "_hetero", None) if sub is not None else None
+            if sub is None or sub.state != SubmissionState.RUNNING or reb is None:
+                del self._hetero_pending[sid]
+                continue
+            acted = reb.rebalances_total + reb.dry_runs_total
+            if acted > baseline:
+                self.hetero_shrinks_avoided_total += 1
+                self.hetero_rebalances_total += 1
+                del self._hetero_pending[sid]
+            elif not reb.consult_pending():
+                # Consumed and declined — not a win, just forgotten.
+                del self._hetero_pending[sid]
+
     def _maybe_rebalance(self) -> None:
         """Prefer throughput-weighted rebalance over elastic shrink for
         slow-but-HEALTHY hosts (``tpu_engine/hetero.py``).
@@ -1112,18 +1201,26 @@ class FleetScheduler:
         plane: when its tracker shows sustained imbalance, the scheduler
         first checks what the best integer row reassignment would recover
         — if that predicted goodput clears ``hetero_goodput_floor`` the
-        job keeps every chip and the rebalancer acts (an elastic shrink
-        *avoided*); only when rebalance cannot clear the floor does the
-        slow host's chip set get quarantined out of admission and the job
-        preempt-requeued to re-admit at the reduced (full-speed) gang.
-        Quarantined chips are released as soon as the tracker's estimate
-        decays back above ``hetero_heal_threshold`` — grow-back then
-        reclaims them through the normal precompile-gated path."""
+        job keeps every chip and the scheduler *requests a consult* that
+        the job's rebalancer serves at its next step boundary (the only
+        safe reassignment point; the supervisor applies the plan through
+        ``data_fn.reassign``). The avoided-shrink accounting settles on a
+        later pass, once the consult actually fired. Only when rebalance
+        cannot clear the floor does the slow host's chip set get
+        quarantined out of admission and the job preempt-requeued to
+        re-admit at the reduced (full-speed) gang; ``_heal_quarantine``
+        releases the chips when the estimate heals, the owner leaves, or
+        the TTL expires."""
+        now = time.time()
+        # Heal + settle before any early return: quarantine entries and
+        # pending consults must never leak behind the feature gate or a
+        # drain.
+        self._heal_quarantine(now)
+        self._resolve_hetero_consults()
         if not self.hetero_rebalance or self._draining:
             return
         if any(s.state == SubmissionState.PREEMPTING for s in self._subs.values()):
             return
-        now = time.time()
         for sub in self._subs.values():
             if sub.state != SubmissionState.RUNNING or sub.workload != "training":
                 continue
@@ -1133,26 +1230,6 @@ class FleetScheduler:
             tracker = reb.tracker
             tput = tracker.relative_throughput()
             n_proc = len(tput)
-            # Heal: release quarantined chips whose owning process's
-            # throughput estimate has decayed back to healthy.
-            if self._hetero_quarantined:
-                fleet = self._fleet()
-                n_dev = len(fleet.devices) if fleet is not None and fleet.devices else n_proc
-                dev_per_proc = max(n_dev // n_proc, 1)
-                healed = {
-                    idx for idx in self._hetero_quarantined
-                    if tput[min(idx // dev_per_proc, n_proc - 1)]
-                    >= self.hetero_heal_threshold
-                }
-                if healed:
-                    self._hetero_quarantined -= healed
-                    tracing.get_recorder().event(
-                        "hetero_quarantine_release",
-                        kind="hetero",
-                        trace_id=sub.trace_id,
-                        parent=sub._root_span,
-                        attrs={"devices": sorted(healed)},
-                    )
             if tracker.imbalance() < self.hetero_imbalance_trigger:
                 continue
             if (
@@ -1169,15 +1246,17 @@ class FleetScheduler:
                 continue
             rebalanced = hetero_mod.predicted_goodput(proposed, tput)
             if rebalanced >= self.hetero_goodput_floor:
-                # Slow but recoverable: rebalance instead of shedding the
+                if sub.submission_id in self._hetero_pending:
+                    continue  # consult already requested; let it settle
+                # Slow but recoverable: prefer rebalance over shedding the
                 # host. The job's own rebalancer applies its hysteresis
-                # (cooldown, sustain, min-gain) before anything moves.
-                self.hetero_shrinks_avoided_total += 1
-                plan = reb.maybe_rebalance(
-                    step=getattr(sub.job, "current_step", 0), now=now
+                # (cooldown, sustain, min-gain) when the supervisor serves
+                # the consult at its next step boundary.
+                self.hetero_rebalance_preferred_total += 1
+                self._hetero_pending[sub.submission_id] = (
+                    reb.rebalances_total + reb.dry_runs_total
                 )
-                if plan is not None:
-                    self.hetero_rebalances_total += 1
+                reb.request_consult()
                 tracing.get_recorder().event(
                     "hetero_rebalance_preferred",
                     kind="hetero",
@@ -1187,7 +1266,7 @@ class FleetScheduler:
                         "predicted_goodput": round(rebalanced, 4),
                         "goodput_floor": self.hetero_goodput_floor,
                         "assignment": list(proposed),
-                        "acted": plan is not None,
+                        "consult_requested": True,
                     },
                 )
                 self._last_hetero_action_at = now
@@ -1204,7 +1283,10 @@ class FleetScheduler:
             shed = set(
                 range(slow_proc * dev_per_proc, (slow_proc + 1) * dev_per_proc)
             )
-            self._hetero_quarantined |= shed
+            for idx in shed:
+                self._hetero_quarantined[idx] = {
+                    "owner": sub.submission_id, "ts": now,
+                }
             self.hetero_shrinks_total += 1
             self.preemptions_total += 1
             sub.state = SubmissionState.PREEMPTING
@@ -1562,9 +1644,11 @@ class FleetScheduler:
                 "goodput_floor": self.hetero_goodput_floor,
                 "cooldown_s": self.hetero_cooldown_s,
                 "imbalance_trigger": self.hetero_imbalance_trigger,
+                "quarantine_ttl_s": self.hetero_quarantine_ttl_s,
                 "rebalances_total": self.hetero_rebalances_total,
                 "shrinks_total": self.hetero_shrinks_total,
                 "shrinks_avoided_total": self.hetero_shrinks_avoided_total,
+                "rebalance_preferred_total": self.hetero_rebalance_preferred_total,
                 "quarantined_devices": sorted(self._hetero_quarantined),
             },
             "running_shrunk": sum(

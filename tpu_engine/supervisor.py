@@ -722,11 +722,23 @@ class TrainingJob:
 
                 _, gm_h, _ = prog.global_batch_shape()
                 n_proc = max(jax.process_count(), 1)
+                # Multi-process: every rank consults at the same step (the
+                # modulo check below), solves from rank 0's broadcast
+                # estimates, and cools down in steps — so all ranks derive
+                # the identical plan and the row windows never overlap or
+                # gap (agreement enforced, not a caller convention).
                 self._hetero = hetero_mod.HeteroRebalancer(
                     hetero_mod.ThroughputTracker(n_proc),
                     gm_h,
                     dry_run=self.hetero_dry_run,
                     trace_id=self.trace_id,
+                    agree_fn=(
+                        hetero_mod.broadcast_agree_fn() if n_proc > 1 else None
+                    ),
+                    cooldown_steps=(
+                        4 * self.hetero_check_interval_steps
+                        if n_proc > 1 else None
+                    ),
                 )
             if self._hetero is not None:
                 from tpu_engine import hetero as hetero_mod
@@ -888,11 +900,26 @@ class TrainingJob:
                     self._hetero.tracker.observe_step(
                         self.last_step_time_s if self.last_step_time_s else dt
                     )
-                    if step % self.hetero_check_interval_steps == 0:
+                    consult = step % self.hetero_check_interval_steps == 0
+                    if not consult and jax.process_count() <= 1:
+                        # Out-of-band consult requested by the scheduler's
+                        # rebalance-over-shrink path. Honored between
+                        # modulo boundaries only single-process —
+                        # multi-process ranks must all consult at the same
+                        # step, so there the request simply rides the next
+                        # periodic consult.
+                        consult = self._hetero.consult_pending()
+                    if consult:
                         h_plan = self._hetero.maybe_rebalance(step)
                         if h_plan is not None and not h_plan.dry_run:
                             reassign_fn = getattr(self.data_fn, "reassign", None)
-                            if reassign_fn is not None:
+                            if reassign_fn is None:
+                                # No seam to move rows through (synthetic
+                                # batches): roll the plan back so the
+                                # gauges never report a split that is not
+                                # actually feeding the mesh.
+                                self._hetero.revert(h_plan)
+                            else:
                                 try:
                                     reassign_fn(h_plan.assignment)
                                     self.hetero_rebalances_total += 1
@@ -907,6 +934,7 @@ class TrainingJob:
                                         },
                                     )
                                 except ValueError as e:
+                                    self._hetero.revert(h_plan)
                                     rec.event(
                                         "hetero_reassign_rejected",
                                         kind="hetero",
