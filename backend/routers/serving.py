@@ -115,6 +115,41 @@ class FleetScaleRequest(BaseModel):
     replicas: int = Field(ge=0, le=256)
 
 
+class DisaggStartRequest(BaseModel):
+    """Launch a disaggregated serving fleet (``tpu_engine/disagg.py``):
+    a planner-placed prefill pool and decode pool with live KV handoff,
+    each pool a set of ``workload="serving"`` scheduler submissions gated
+    through ``estimate_serving_hbm(pool_role=...)``."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    model_name: str
+    max_len: int = Field(default=1024, ge=8)
+    prefill_chunk: int = Field(default=256, ge=16)
+    decode_chunk_steps: int = Field(default=8, ge=1, le=256)
+    eos_id: Optional[int] = Field(default=None, ge=0)
+    seed: int = 0
+    quantize: Optional[str] = Field(default=None, pattern="^int8$")
+    kv_cache: Optional[str] = Field(default=None, pattern="^int8$")
+    # int8-quantize KV payloads on the handoff wire (codes + per-(lane,
+    # kv-head) scales): half the handoff bytes.
+    wire_quant: bool = False
+    # Prefill pool: slots == the in-flight handoff window.
+    prefill_tensor_parallel: int = Field(default=1, ge=1)
+    inflight_handoffs: int = Field(default=4, ge=1, le=64)
+    prefill_min_replicas: int = Field(default=1, ge=0)
+    prefill_max_replicas: int = Field(default=4, ge=1)
+    ttft_slo_ms: Optional[float] = Field(default=None, gt=0)
+    # Decode pool.
+    decode_tensor_parallel: int = Field(default=1, ge=1)
+    decode_max_slots: int = Field(default=8, ge=1, le=256)
+    decode_min_replicas: int = Field(default=1, ge=0)
+    decode_max_replicas: int = Field(default=4, ge=1)
+    p99_slo_ms: float = Field(default=2000.0, gt=0)
+    priority: str = Field(default="normal", pattern="^(low|normal|high|critical)$")
+    submitter: str = "disagg-serving"
+
+
 _server: Any = None
 _stop: Optional[threading.Event] = None
 _thread: Optional[threading.Thread] = None
@@ -559,6 +594,126 @@ async def fleet_result(request: web.Request) -> web.Response:
         raise ApiError(404, f"request '{rid}' not found")
 
 
+# ---------------------------------------------------------------------------
+# Disaggregated serving: prefill pool + decode pool + KV handoff plane
+# (tpu_engine/disagg.py). One per process, mutually exclusive with nothing —
+# it lives beside the unified fleet but shares the scheduler's HBM ledger.
+# ---------------------------------------------------------------------------
+
+_disagg: Any = None
+
+
+@body(DisaggStartRequest)
+async def disagg_start(request: web.Request) -> web.Response:
+    req = await parse_body(request, DisaggStartRequest)
+
+    def _start():
+        from tpu_engine.disagg import DisaggServingFleet
+        from tpu_engine.scheduler import JobPriority
+        from tpu_engine.serving_fleet import (
+            AutoscalerConfig, ReplicaAutoscaler, ServingReplicaSpec,
+        )
+
+        global _disagg
+        with _lock:
+            if _disagg is not None:
+                raise ApiError(
+                    409, "a disaggregated fleet is already running; stop it first"
+                )
+            common = dict(
+                model_name=req.model_name, max_len=req.max_len,
+                weight_quant=req.quantize, kv_quant=req.kv_cache == "int8",
+                prefill_chunk=req.prefill_chunk,
+                decode_chunk_steps=req.decode_chunk_steps,
+                eos_id=req.eos_id, seed=req.seed,
+            )
+            prefill_spec = ServingReplicaSpec(
+                max_slots=req.inflight_handoffs,
+                inflight_handoffs=req.inflight_handoffs,
+                tensor_parallel=req.prefill_tensor_parallel, **common,
+            )
+            decode_spec = ServingReplicaSpec(
+                max_slots=req.decode_max_slots,
+                tensor_parallel=req.decode_tensor_parallel, **common,
+            )
+            if prefill_spec.estimate() is None:
+                raise ApiError(404, f"unknown model '{req.model_name}'")
+            fleet = DisaggServingFleet(
+                state.scheduler, prefill_spec, decode_spec,
+                prefill_autoscaler=ReplicaAutoscaler(AutoscalerConfig(
+                    min_replicas=req.prefill_min_replicas,
+                    max_replicas=req.prefill_max_replicas,
+                    ttft_slo_ms=req.ttft_slo_ms,
+                )),
+                decode_autoscaler=ReplicaAutoscaler(AutoscalerConfig(
+                    min_replicas=req.decode_min_replicas,
+                    max_replicas=req.decode_max_replicas,
+                    p99_slo_ms=req.p99_slo_ms,
+                )),
+                wire_quant=req.wire_quant,
+                priority=JobPriority[req.priority.upper()],
+                submitter=req.submitter,
+            )
+            fleet.start()
+            _disagg = fleet
+        return req.model_name
+
+    model = await asyncio.to_thread(_start)
+    return json_response({
+        "started": True, "model": model, "wire_quant": req.wire_quant,
+        "inflight_handoffs": req.inflight_handoffs,
+        "decode_max_slots": req.decode_max_slots,
+    })
+
+
+def _require_disagg():
+    if _disagg is None:
+        raise ApiError(
+            409, "no disaggregated fleet is running; POST /serving/disagg/start"
+        )
+    return _disagg
+
+
+async def disagg_stop(request: web.Request) -> web.Response:
+    def _stop_sync():
+        global _disagg
+        with _lock:
+            fleet = _require_disagg()
+            fleet.stop()
+            _disagg = None
+
+    await asyncio.to_thread(_stop_sync)
+    return json_response({"stopped": True})
+
+
+@body(ServingSubmitRequest)
+async def disagg_submit(request: web.Request) -> web.Response:
+    fleet = _require_disagg()
+    req = await parse_body(request, ServingSubmitRequest)
+    fid = await asyncio.to_thread(
+        fleet.submit_request, req.prompt,
+        req.max_new_tokens, req.temperature,
+    )
+    return json_response({"request_id": fid})
+
+
+@pathparams({"request_id": "string"})
+async def disagg_result(request: web.Request) -> web.Response:
+    fleet = _require_disagg()
+    rid = request.match_info["request_id"]
+    try:
+        return json_response(await asyncio.to_thread(fleet.result, rid))
+    except KeyError:
+        raise ApiError(404, f"request '{rid}' not found")
+
+
+async def disagg_status(request: web.Request) -> web.Response:
+    fleet = _require_disagg()
+    # Like the unified fleet, a status read IS a control-loop tick: pump
+    # the handoff phase machine and drive both pools' autoscalers.
+    return json_response(await asyncio.to_thread(fleet.tick))
+
+
 def setup(app: web.Application, prefix: str = "/api/v1/serving") -> None:
     app.router.add_post(f"{prefix}/start", start_server)
     app.router.add_post(f"{prefix}/stop", stop_server)
@@ -572,3 +727,8 @@ def setup(app: web.Application, prefix: str = "/api/v1/serving") -> None:
     app.router.add_post(f"{prefix}/fleet/submit", fleet_submit)
     app.router.add_get(f"{prefix}/fleet/result/{{request_id}}", fleet_result)
     app.router.add_get(f"{prefix}/fleet/status", fleet_status)
+    app.router.add_post(f"{prefix}/disagg/start", disagg_start)
+    app.router.add_post(f"{prefix}/disagg/stop", disagg_stop)
+    app.router.add_post(f"{prefix}/disagg/submit", disagg_submit)
+    app.router.add_get(f"{prefix}/disagg/result/{{request_id}}", disagg_result)
+    app.router.add_get(f"{prefix}/disagg/status", disagg_status)

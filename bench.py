@@ -189,6 +189,9 @@ def main() -> None:
     serving_line = _serving_fleet_metric()
     if serving_line is not None:
         print(json.dumps(serving_line))
+    disagg_line = _serving_disagg_metric()
+    if disagg_line is not None:
+        print(json.dumps(disagg_line))
     placement_line = _placement_metric()
     if placement_line is not None:
         print(json.dumps(placement_line))
@@ -498,6 +501,32 @@ def _serving_fleet_metric() -> dict | None:
             "router_weights": auto["router"]["weights"],
             "prefix_hit_rate": auto["prefix_hit_rate"],
             "static_p99_ms": trace["static_1_replica"]["p99_ms"],
+        }
+    except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
+        return None
+
+
+def _serving_disagg_metric() -> dict | None:
+    """JSON line: symmetric vs disaggregated prefill/decode serving at
+    equal total chips on the long-prefill bursty trace
+    (benchmarks/serving_fleet_sim.py §A/B, pool layouts chosen by
+    tpu_engine.placement.plan_serving_pool). Never fails the bench: any
+    error degrades to None."""
+    try:
+        from benchmarks.serving_fleet_sim import run_disagg_ab
+
+        ab = run_disagg_ab(seed=0)
+        return {
+            "metric": "serving_disagg_ttft_p99_vs_symmetric",
+            "value": ab["ttft_p99_improvement"],
+            "unit": "x p99 TTFT (symmetric fleet = 1.0, equal chips)",
+            "total_chips": ab["total_chips"],
+            "layouts": ab["layouts"],
+            "symmetric_ttft_p99_ms": ab["symmetric"]["ttft_p99_ms"],
+            "disagg_ttft_p99_ms": ab["disagg"]["ttft_p99_ms"],
+            "symmetric_tokens_per_sec": ab["symmetric"]["tokens_per_sec"],
+            "disagg_tokens_per_sec": ab["disagg"]["tokens_per_sec"],
+            "gates_pass": ab["gates_pass"],
         }
     except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
         return None

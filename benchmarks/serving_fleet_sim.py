@@ -28,6 +28,20 @@ don't get their throughput for free), p50/p99 latency vs the SLO, the
 replica-count trace, router weights and affinity hit rate;
 ``bench.py`` reuses :func:`run_trace` for its serving-fleet line.
 
+A second experiment (PR 12) A/Bs **symmetric vs disaggregated** serving
+at EQUAL total chips on a long-prefill-heavy bursty trace. The symmetric
+fleet models the real ``ContinuousBatcher`` interference: a chunked
+prefill monopolizes the MXU, so co-resident decode slots crawl while any
+prefill is in flight — slots stay occupied longer, admission stalls, and
+p99 TTFT compounds. The disaggregated fleet (``tpu_engine/disagg.py``)
+runs planner-placed pools — prefill layout ranked by the compute
+roofline, decode by KV-pool capacity, both from the REAL
+:func:`tpu_engine.placement.plan_serving_pool` — with a host-side KV
+handoff between them; decode never stalls and TTFT is the prefill-pool
+latency. ``main()`` exit-gates the A/B: disaggregated must beat
+symmetric p99 TTFT with tokens/sec no worse, and the JSON records both
+configurations' planner-chosen layouts.
+
 Run: ``python -m benchmarks.serving_fleet_sim [--seed N]``.
 """
 
@@ -311,11 +325,304 @@ def run_trace(seed: int = 0) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# Symmetric vs disaggregated A/B (PR 12) — equal chips, long-prefill trace
+# ---------------------------------------------------------------------------
+
+TOTAL_CHIPS = 8              # equal-chips budget for BOTH configurations
+PREFILL_CHIPS = 6            # disagg split: prefill-heavy trace → prefill-heavy pool
+DECODE_CHIPS = TOTAL_CHIPS - PREFILL_CHIPS
+LONG_PREFILL_MEAN_S = 1.5    # one prompt's prefill seconds on ONE chip (tp=1)
+LONG_PREFILL_MIN_S = 0.3
+LONG_MEAN_NEW = 96
+LONG_BASE_RPS = 0.4
+LONG_BURST_RPS = 3.0
+HANDOFF_S = 0.05             # host-side KV wire latency (not on the TTFT path)
+# Chunked-prefill interference in a SYMMETRIC replica: while a prefill
+# chunk owns the MXU, co-resident decode steps run at this fraction of
+# their clean cadence (a decode step is ~an order of magnitude shorter
+# than a prefill chunk), and the prefill itself loses the decode share.
+INTERFERENCE_DECODE = 0.15
+INTERFERENCE_PREFILL = 0.85
+PLAN_MODEL = "llama-7b"
+PLAN_MAX_LEN = 2048
+PLAN_HBM_GIB = 24.0
+PLAN_INFLIGHT = 4            # prefill pool's in-flight handoff window
+
+
+def long_prefill_trace(seed: int) -> list[dict]:
+    """Seeded bursty arrivals with heavy, variable prefill cost:
+    [{t, prompt, prefill_units, n_new}] — ``prefill_units`` is seconds of
+    prefill work at tp=1."""
+    rng = random.Random(seed + 7919)
+    out, t = [], 0.0
+    while t < SIM_DURATION_S:
+        in_burst = (t % BURST_EVERY_S) < BURST_LEN_S
+        t += rng.expovariate(LONG_BURST_RPS if in_burst else LONG_BASE_RPS)
+        if t >= SIM_DURATION_S:
+            break
+        pid = rng.randrange(N_PREFIXES)
+        prompt = [pid * PREFIX_LEN + i for i in range(PREFIX_LEN)]
+        prompt.append(10_000 + len(out))
+        out.append({
+            "t": t,
+            "prompt": prompt,
+            "prefill_units": max(
+                LONG_PREFILL_MIN_S, rng.expovariate(1.0 / LONG_PREFILL_MEAN_S)
+            ),
+            "n_new": max(8, int(rng.expovariate(1.0 / LONG_MEAN_NEW))),
+        })
+    return out
+
+
+class SymReplica:
+    """One chip, both phases. Prefills serialize (one chunked prefill at a
+    time owns the MXU); while one is in flight every decoding slot crawls
+    at the interference rate — the slot-starvation feedback that kills
+    symmetric p99 TTFT under prefill bursts."""
+
+    def __init__(self, rid: str):
+        self.rid = rid
+        self.active: list[dict] = []
+
+    def free_slots(self) -> int:
+        return SLOTS - len(self.active)
+
+    def admit(self, req: dict, now: float) -> None:
+        self.active.append({
+            "req": req, "prefill_left": req["prefill_units"],
+            "tokens_left": float(req["n_new"]),
+        })
+
+    def step(self, now: float, dt: float, done: list[dict],
+             ttfts: list[float]) -> None:
+        pre = next((s for s in self.active if s["prefill_left"] > 0), None)
+        decode_rate = TOKENS_PER_SLOT_S
+        if pre is not None:
+            pre["prefill_left"] -= dt * INTERFERENCE_PREFILL
+            if pre["prefill_left"] <= 0:
+                pre["req"]["first_token_at"] = now + dt
+                ttfts.append((now + dt - pre["req"]["t"]) * 1000.0)
+            decode_rate *= INTERFERENCE_DECODE
+        for sl in list(self.active):
+            if sl["prefill_left"] > 0 or sl is pre:
+                continue
+            sl["tokens_left"] -= decode_rate * dt
+            if sl["tokens_left"] <= 0:
+                sl["req"]["done_at"] = now + dt
+                done.append(sl["req"])
+                self.active.remove(sl)
+
+    def router_stats(self) -> dict:
+        busy = sum(1 for s in self.active if s["prefill_left"] <= 0)
+        return {
+            "tokens_per_sec": TOKENS_PER_SLOT_S * max(busy, 0.2),
+            "free_slots": self.free_slots(),
+            "slots": SLOTS,
+        }
+
+
+def _simulate_symmetric_long(trace: list[dict]) -> dict:
+    router = FleetRouter(affinity_tokens=PREFIX_LEN)
+    replicas = [SymReplica(f"s{i}") for i in range(TOTAL_CHIPS)]
+    by_id = {r.rid: r for r in replicas}
+    queue: list[dict] = []
+    done: list[dict] = []
+    ttfts: list[float] = []
+    idx, t, next_control = 0, 0.0, 0.0
+    while t < SIM_DURATION_S or queue or any(r.active for r in replicas):
+        if t > SIM_DURATION_S * 6:
+            break
+        while idx < len(trace) and trace[idx]["t"] <= t:
+            queue.append(trace[idx])
+            idx += 1
+        if t >= next_control:
+            next_control = t + CONTROL_PERIOD_S
+            router.update({r.rid: r.router_stats() for r in replicas})
+        while queue and any(r.free_slots() > 0 for r in replicas):
+            rid = router.route(queue[0]["prompt"])
+            rep = by_id.get(rid) if rid else None
+            if rep is None or rep.free_slots() <= 0:
+                break  # router picked a full replica; weights refresh next tick
+            rep.admit(queue.pop(0), t)
+        for r in replicas:
+            r.step(t, DT_S, done, ttfts)
+        t += DT_S
+    return _ab_metrics(done, ttfts, t)
+
+
+def _simulate_disagg(trace: list[dict], prefill_plan, decode_plan,
+                     prefill_speedup: float) -> dict:
+    """Planner-placed pools: ``prefill_plan.replicas`` serial prefill
+    servers (each ``prefill_speedup`` × one chip, the roofline ratio the
+    planner predicted for its tensor-parallel choice) feeding
+    ``decode_plan.replicas`` decode-only replicas through a ``HANDOFF_S``
+    KV wire. Decode never shares the MXU with a prefill."""
+    # Per-slot decode rate: the pool's chips stream the same aggregate
+    # HBM bandwidth as the symmetric fleet's per-chip 8×30 tok/s; more
+    # slots trade per-slot speed for concurrency (the KV-capacity axis).
+    dec_rate = (TOKENS_PER_SLOT_S * SLOTS * decode_plan.tensor_parallel
+                / decode_plan.max_slots)
+    prefill_router = FleetRouter(affinity_tokens=PREFIX_LEN)
+    decode_router = FleetRouter(affinity_tokens=PREFIX_LEN)
+    pre = [{"rid": f"p{i}", "job": None} for i in range(prefill_plan.replicas)]
+    dec = [{"rid": f"d{i}", "active": []} for i in range(decode_plan.replicas)]
+    queue: list[dict] = []          # awaiting a prefill server
+    handoff: list[dict] = []        # KV on the wire / awaiting a decode slot
+    done: list[dict] = []
+    ttfts: list[float] = []
+    idx, t, next_control = 0, 0.0, 0.0
+    while (t < SIM_DURATION_S or queue or handoff
+           or any(p["job"] for p in pre) or any(d["active"] for d in dec)):
+        if t > SIM_DURATION_S * 6:
+            break
+        while idx < len(trace) and trace[idx]["t"] <= t:
+            queue.append(trace[idx])
+            idx += 1
+        if t >= next_control:
+            next_control = t + CONTROL_PERIOD_S
+            prefill_router.update({
+                p["rid"]: {
+                    "tokens_per_sec": prefill_speedup * TOKENS_PER_SLOT_S,
+                    "free_slots": 0 if p["job"] else 1, "slots": 1,
+                } for p in pre
+            })
+            decode_router.update({
+                d["rid"]: {
+                    "tokens_per_sec": dec_rate * max(len(d["active"]), 0.2),
+                    "free_slots": decode_plan.max_slots - len(d["active"]),
+                    "slots": decode_plan.max_slots,
+                } for d in dec
+            })
+        # Route waiting prompts onto idle prefill servers.
+        while queue and any(p["job"] is None for p in pre):
+            rid = prefill_router.route(queue[0]["prompt"])
+            srv = next((p for p in pre if p["rid"] == rid), None)
+            if srv is None or srv["job"] is not None:
+                break
+            req = queue.pop(0)
+            srv["job"] = {
+                "req": req,
+                "left": req["prefill_units"] / prefill_speedup,
+            }
+        # Advance prefills; completion IS the first token (prefill logits).
+        for p in pre:
+            job = p["job"]
+            if job is None:
+                continue
+            job["left"] -= DT_S
+            if job["left"] <= 0:
+                req = job["req"]
+                req["first_token_at"] = t + DT_S
+                ttfts.append((t + DT_S - req["t"]) * 1000.0)
+                req["handoff_ready"] = t + DT_S + HANDOFF_S
+                handoff.append(req)
+                p["job"] = None
+        # Deliver arrived handoffs into reserved decode slots.
+        for req in list(handoff):
+            if req["handoff_ready"] > t:
+                continue
+            rid = decode_router.route(req["prompt"])
+            rep = next((d for d in dec if d["rid"] == rid), None)
+            if rep is None or len(rep["active"]) >= decode_plan.max_slots:
+                break
+            handoff.remove(req)
+            rep["active"].append({"req": req, "tokens_left": float(req["n_new"])})
+        for d in dec:
+            for sl in list(d["active"]):
+                sl["tokens_left"] -= dec_rate * DT_S
+                if sl["tokens_left"] <= 0:
+                    sl["req"]["done_at"] = t + DT_S
+                    done.append(sl["req"])
+                    d["active"].remove(sl)
+        t += DT_S
+    return _ab_metrics(done, ttfts, t)
+
+
+def _ab_metrics(done: list[dict], ttfts: list[float], t_end: float) -> dict:
+    lat_ms = [(r["done_at"] - r["t"]) * 1000.0 for r in done
+              if r["t"] >= WARMUP_S]
+    steady_ttfts = [
+        (r["first_token_at"] - r["t"]) * 1000.0 for r in done
+        if r["t"] >= WARMUP_S and "first_token_at" in r
+    ]
+    total_tokens = float(sum(r["n_new"] for r in done))
+    makespan = max((r["done_at"] for r in done), default=DT_S)
+    return {
+        "completed": len(done),
+        "total_tokens": total_tokens,
+        "tokens_per_sec": round(total_tokens / makespan, 2),
+        "tokens_per_sec_per_chip": round(
+            total_tokens / (makespan * TOTAL_CHIPS), 2),
+        "ttft_p50_ms": round(_percentile(steady_ttfts, 0.50), 1),
+        "ttft_p99_ms": round(_percentile(steady_ttfts, 0.99), 1),
+        "p50_ms": round(_percentile(lat_ms, 0.50), 1),
+        "p99_ms": round(_percentile(lat_ms, 0.99), 1),
+        "makespan_s": round(makespan, 1),
+    }
+
+
+def run_disagg_ab(seed: int = 0) -> dict:
+    """Symmetric vs disaggregated at TOTAL_CHIPS on the long-prefill
+    trace; layouts chosen by the real planner and recorded in the output."""
+    from tpu_engine.placement import plan_serving_pool
+
+    pre_plans = plan_serving_pool(
+        PLAN_MODEL, "prefill", PREFILL_CHIPS, hbm_free_gib=PLAN_HBM_GIB,
+        max_len=PLAN_MAX_LEN, inflight_handoffs=PLAN_INFLIGHT)
+    dec_plans = plan_serving_pool(
+        PLAN_MODEL, "decode", DECODE_CHIPS, hbm_free_gib=PLAN_HBM_GIB,
+        max_len=PLAN_MAX_LEN)
+    sym_plans = plan_serving_pool(
+        PLAN_MODEL, "decode", TOTAL_CHIPS, hbm_free_gib=PLAN_HBM_GIB,
+        max_len=PLAN_MAX_LEN)
+    pre_plan = next(p for p in pre_plans if p.feasible)
+    dec_plan = next(p for p in dec_plans if p.feasible)
+    sym_plan = next(p for p in sym_plans if p.feasible)
+    # The planner's own roofline ratio: how much faster the chosen prefill
+    # layout runs one prompt than a single tp=1 chip would.
+    tp1 = next(p for p in pre_plans if p.tensor_parallel == 1)
+    prefill_speedup = tp1.predicted_prefill_s / pre_plan.predicted_prefill_s
+
+    trace = long_prefill_trace(seed)
+    sym = _simulate_symmetric_long(trace)
+    dis = _simulate_disagg(trace, pre_plan, dec_plan, prefill_speedup)
+    gates = {
+        "disagg_beats_symmetric_p99_ttft": dis["ttft_p99_ms"] < sym["ttft_p99_ms"],
+        # "No worse" with a 1% deterministic-sim tolerance.
+        "disagg_tokens_per_sec_no_worse": (
+            dis["tokens_per_sec"] >= 0.99 * sym["tokens_per_sec"]),
+    }
+    return {
+        "seed": seed,
+        "total_chips": TOTAL_CHIPS,
+        "n_requests": len(trace),
+        "layouts": {
+            "symmetric": sym_plan.label,
+            "disagg_prefill": pre_plan.label,
+            "disagg_decode": dec_plan.label,
+            "prefill_speedup": round(prefill_speedup, 2),
+        },
+        "symmetric": sym,
+        "disagg": dis,
+        "ttft_p99_improvement": round(
+            sym["ttft_p99_ms"] / max(dis["ttft_p99_ms"], 1e-9), 2),
+        "gates": gates,
+        "gates_pass": all(gates.values()),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
-    print(json.dumps(run_trace(args.seed), indent=2))
+    out = {
+        "autoscale_vs_static": run_trace(args.seed),
+        "disagg_ab": run_disagg_ab(args.seed),
+    }
+    print(json.dumps(out, indent=2))
+    if not out["disagg_ab"]["gates_pass"]:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

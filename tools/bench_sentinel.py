@@ -85,9 +85,11 @@ def collect_quick() -> list[dict]:
     from benchmarks.chaos import run_hetero_lane
     from benchmarks.chaos import run_trace as chaos_trace
     from benchmarks.scheduler_sim import run_warm_admission
+    from benchmarks.serving_fleet_sim import run_disagg_ab
     from tpu_engine.parallel.pipeline_zb import schedule_account
 
     trace = chaos_trace(seed=0)
+    ab = run_disagg_ab(seed=0)
     gp = trace["goodput"]
     cc = trace["compile_cache"]
     warm = run_warm_admission(seed=0)
@@ -144,6 +146,15 @@ def collect_quick() -> list[dict]:
             "burned_cost_vs_1f1b": round(
                 zb["burned_cost"] / f1b["burned_cost"], 3
             ),
+        },
+        {
+            "metric": "serving_disagg_ttft_p99_vs_symmetric",
+            "value": ab["ttft_p99_improvement"],
+            "symmetric_ttft_p99_ms": ab["symmetric"]["ttft_p99_ms"],
+            "disagg_ttft_p99_ms": ab["disagg"]["ttft_p99_ms"],
+            "symmetric_tokens_per_sec": ab["symmetric"]["tokens_per_sec"],
+            "disagg_tokens_per_sec": ab["disagg"]["tokens_per_sec"],
+            "gates_pass": ab["gates_pass"],
         },
     ]
 

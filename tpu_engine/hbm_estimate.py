@@ -313,6 +313,8 @@ def estimate_serving_hbm(
     weight_quant: Optional[str] = None,
     prefill_chunk: int = 256,
     prefix_cache_tokens: int = 0,
+    pool_role: str = "unified",
+    inflight_handoffs: Optional[int] = None,
 ) -> Optional[HBMEstimate]:
     """Per-device HBM projection for one decode replica.
 
@@ -333,6 +335,15 @@ def estimate_serving_hbm(
     - the shared-prefix cache's budgeted lanes, plus a rounded-up decode /
       prefill workspace (one chunk's activations and the fp32 logits rows).
 
+    ``pool_role`` selects the disaggregated-serving admission mode
+    (``tpu_engine/disagg.py``): a ``"prefill"`` pool's slots exist only to
+    hold requests between prefill completion and KV extraction, so its KV
+    term is sized to ``inflight_handoffs`` slots (not the full
+    ``max_slots``) and its prefill workspace is doubled (the chunk forward
+    is the pool's steady-state occupant, not an admission transient).
+    ``"decode"`` estimates like ``"unified"`` — the full slot pool is the
+    honest cost either way.
+
     Returns None for unknown model names — the scheduler then degrades the
     serving submission to capacity-only admission, same as training.
     """
@@ -342,9 +353,18 @@ def estimate_serving_hbm(
     cfg = tfm.MODEL_CONFIGS.get(model_name)
     if cfg is None:
         return None
+    if pool_role not in ("unified", "prefill", "decode"):
+        raise ValueError(
+            f"pool_role must be unified|prefill|decode, got {pool_role!r}"
+        )
 
     tp = max(int(tensor_parallel), 1)
     slots = max(int(max_slots), 1)
+    if pool_role == "prefill":
+        # The physical pool allocates min(max_slots, inflight) slots —
+        # disagg.py builds prefill engines with max_slots == inflight, so
+        # the estimate and the allocation agree.
+        slots = min(slots, max(int(inflight_handoffs or slots), 1))
     compute_b = _itemsize(compute_dtype)
     notes: list[str] = []
 
@@ -380,9 +400,18 @@ def estimate_serving_hbm(
         kv_pool += prefix_cache_tokens * per_tok / kv_shard
 
     # Decode/prefill workspace: one prefill chunk's layer activations for
-    # the widest dispatch plus every slot's fp32 logits row.
+    # the widest dispatch plus every slot's fp32 logits row. A prefill
+    # pool runs chunk forwards back-to-back — double-buffer the workspace
+    # (current dispatch + the next chunk's staged operands) since it, not
+    # the KV pool, is the pool's dominant transient.
     chunk = max(int(prefill_chunk), 1)
     working = chunk * (4 * cfg.d_model + 2 * cfg.d_ff) * compute_b / tp
+    if pool_role == "prefill":
+        working *= 2
+        notes.append(
+            f"prefill pool: KV sized to {slots} in-flight handoff slots, "
+            "workspace double-buffered"
+        )
     logits = slots * cfg.vocab_size * 4 / tp
 
     total = params_dev + kv_pool + working + logits

@@ -996,3 +996,167 @@ class PlacementPlanner:
         else:
             out["step_time_abs_rel_error"] = None
         return out
+
+
+# ---------------------------------------------------------------------------
+# Serving-pool planning (disaggregated prefill/decode — tpu_engine/disagg.py)
+# ---------------------------------------------------------------------------
+
+
+class ServingPoolPlan(BaseModel):
+    """One candidate layout for a disaggregated serving pool, with the
+    role-specific cost-model verdict. Prefill pools rank by the compute
+    roofline (per-request prefill latency at ``max_len``); decode pools by
+    aggregate KV-pool decode throughput (slots served per HBM-bound step,
+    summed over replicas)."""
+
+    model_config = ConfigDict(arbitrary_types_allowed=True)
+
+    role: str  # "prefill" | "decode"
+    tensor_parallel: int
+    replicas: int
+    max_slots: int
+    max_len: int
+    kv_quant: bool = False
+    weight_quant: Optional[str] = None
+    predicted_prefill_s: float = 0.0  # one max_len prompt through one replica
+    predicted_decode_tok_s: float = 0.0  # pool-aggregate steady-state tokens/s
+    hbm_estimate: Optional[HBMEstimate] = None
+    feasible: bool = True
+    skip_reason: Optional[str] = None
+
+    @property
+    def label(self) -> str:
+        tags = []
+        if self.kv_quant:
+            tags.append("kvq")
+        if self.weight_quant:
+            tags.append(self.weight_quant)
+        return "·".join(
+            [f"{self.role}", f"tp{self.tensor_parallel}x{self.replicas}",
+             f"slots{self.max_slots}", *tags]
+        )
+
+
+# HBM stream bandwidth closes the decode roofline the same way
+# NOMINAL_PEAK_FLOPS closes the prefill one: absolute values are nominal
+# (v5e HBM2E), ranking depends only on the ratios.
+NOMINAL_HBM_BYTES_S = 8.1e11
+
+
+def plan_serving_pool(
+    model_name: str,
+    role: str,
+    n_devices: int,
+    *,
+    hbm_free_gib: float = 16.0,
+    max_len: int = 1024,
+    candidate_slots: Sequence[int] = (4, 8, 16, 32),
+    inflight_handoffs: int = 4,
+    compute_dtype: Precision = Precision.BF16,
+    kv_quant: bool = False,
+    weight_quant: Optional[str] = None,
+    prefill_chunk: int = 256,
+) -> list[ServingPoolPlan]:
+    """Enumerate → HBM-filter → rank layouts for ONE disaggregated serving
+    pool over ``n_devices`` chips. The same enumerate/filter/rank recipe as
+    the training planner, with the serving cost model:
+
+    - every ``tensor_parallel`` that divides ``n_devices`` (and the model's
+      kv/q heads), each yielding ``n_devices // tp`` replicas;
+    - per-device HBM through :func:`estimate_serving_hbm` with the pool's
+      ``pool_role`` — the SAME admission gate the scheduler enforces, so a
+      plan this function ranks first is a plan the ledger will admit;
+    - **prefill** rank: roofline latency of one ``max_len`` prompt,
+      ``2·P·T / (tp·peak·MFU)`` plus per-chunk dispatch overhead — more
+      tensor parallelism is better until chunk dispatch dominates; slots
+      are pinned to ``inflight_handoffs`` (the pool's only job is holding
+      finished requests for extraction);
+    - **decode** rank: aggregate tokens/sec with every slot busy — each
+      step streams the weight shard once for the whole batch plus one
+      resident KV row per slot, so bigger pools amortize the weight read
+      until the KV term (or HBM) bites. This is exactly the
+      "decode ranked by KV-pool capacity" axis.
+
+    Returns ALL candidates, feasible first in rank order (infeasible tail
+    carries ``skip_reason``) — callers record ``plans[0].label`` as the
+    planner-chosen layout. Empty list for unknown models.
+    """
+    from tpu_engine.hbm_estimate import estimate_serving_hbm
+
+    if role not in ("prefill", "decode"):
+        raise ValueError(f"role must be prefill|decode, got {role!r}")
+    model_cfg = tfm.MODEL_CONFIGS.get(model_name)
+    if model_cfg is None:
+        return []
+
+    n_devices = max(int(n_devices), 1)
+    n_params = tfm.param_count(model_cfg)
+    compute_b = 1.02 if weight_quant == "int8" else (
+        2 if compute_dtype != Precision.FP32 else 4)
+    kv_row_bytes = (  # one token's K+V across all layers, as stored
+        2 * model_cfg.n_layers * model_cfg.n_kv_heads * model_cfg.head_dim
+        * (1 if kv_quant else (2 if compute_dtype != Precision.FP32 else 4))
+    )
+
+    plans: list[ServingPoolPlan] = []
+    slot_choices = (
+        [max(int(inflight_handoffs), 1)] if role == "prefill"
+        else sorted({max(int(s), 1) for s in candidate_slots})
+    )
+    for tp in _divisors(n_devices):
+        if model_cfg.n_heads % tp or model_cfg.n_kv_heads % tp:
+            continue  # serving.py would replicate heads — not a real layout
+        replicas = n_devices // tp
+        for slots in slot_choices:
+            est = estimate_serving_hbm(
+                model_name, slots, max_len,
+                tensor_parallel=tp, compute_dtype=compute_dtype,
+                kv_quant=kv_quant, weight_quant=weight_quant,
+                prefill_chunk=prefill_chunk, pool_role=role,
+                inflight_handoffs=(
+                    inflight_handoffs if role == "prefill" else None),
+            )
+            # Prefill: compute roofline over the tp shard + one dispatch
+            # latency per chunk (why tp→∞ is not free).
+            n_chunks = -(-int(max_len) // max(int(prefill_chunk), 1))
+            prefill_s = (
+                2.0 * n_params * max_len
+                / (tp * NOMINAL_PEAK_FLOPS * ASSUMED_MFU)
+                + n_chunks * 2e-3
+            )
+            # Decode: per step, stream the weight shard once + every
+            # resident KV row (half-full on average); all slots emit one
+            # token per step, replicas are independent.
+            kv_shard = tp if model_cfg.n_kv_heads % tp == 0 else 1
+            step_bytes = (
+                n_params * compute_b / tp
+                + slots * (max_len / 2) * kv_row_bytes / kv_shard
+            )
+            tok_s = replicas * slots / (step_bytes / NOMINAL_HBM_BYTES_S)
+            plan = ServingPoolPlan(
+                role=role, tensor_parallel=tp, replicas=replicas,
+                max_slots=slots, max_len=int(max_len), kv_quant=kv_quant,
+                weight_quant=weight_quant,
+                predicted_prefill_s=prefill_s,
+                predicted_decode_tok_s=tok_s,
+                hbm_estimate=est,
+            )
+            if est is not None and est.device_total_gib > hbm_free_gib:
+                plan.feasible = False
+                plan.skip_reason = (
+                    f"needs {est.device_total_gib:.2f} GiB/device, "
+                    f"{hbm_free_gib:.2f} free"
+                )
+            plans.append(plan)
+
+    def rank_key(p: ServingPoolPlan) -> tuple:
+        if role == "prefill":
+            # Fastest single-prompt prefill; tie-break toward more
+            # parallel lanes (replicas) for burst absorption.
+            return (p.predicted_prefill_s, -p.replicas, p.tensor_parallel)
+        return (-p.predicted_decode_tok_s, p.tensor_parallel, -p.max_slots)
+
+    feasible = sorted([p for p in plans if p.feasible], key=rank_key)
+    infeasible = sorted([p for p in plans if not p.feasible], key=rank_key)
+    return feasible + infeasible

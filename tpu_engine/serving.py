@@ -549,6 +549,10 @@ class Request:
     submitted_at: float = field(default_factory=time.time)
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
+    # Disaggregated serving: a finished ``hold_kv`` request keeps its slot
+    # (and the prompt K/V in it) resident until the handoff plane extracts
+    # or releases it — see :mod:`tpu_engine.disagg`.
+    hold_kv: bool = False
 
 
 @dataclass
@@ -760,6 +764,18 @@ class ContinuousBatcher:
         self._prefilling: "collections.OrderedDict[int, _PrefillState]" = \
             collections.OrderedDict()
         self._pending_first_logits: dict[int, np.ndarray] = {}
+        # -- disaggregated-serving handoff plane (see tpu_engine/disagg.py).
+        # _held maps a finished hold_kv request to the slot still pinning
+        # its K/V; _handoff_requests queues (req_id, quantize|None) orders
+        # for the ENGINE thread (None = discard); _handoffs holds extracted
+        # wire payloads until the caller collects them; _prefilled_queue
+        # holds incoming KVHandoff payloads awaiting a free slot.
+        self._held: dict[int, int] = {}
+        self._handoff_requests: list[tuple[int, Optional[bool]]] = []
+        self._handoffs: dict[int, Any] = {}
+        self._prefilled_queue: list[tuple[Request, Any]] = []
+        self.handoffs_out = 0
+        self.handoffs_in = 0
         if cfg.arch == "gpt2" and max_len > cfg.max_seq_len:
             raise ValueError(
                 f"max_len {max_len} exceeds the learned position table "
@@ -778,7 +794,7 @@ class ContinuousBatcher:
     # -- client side ---------------------------------------------------------
 
     def submit(self, prompt: list[int], max_new_tokens: int = 64,
-               temperature: float = 0.0) -> int:
+               temperature: float = 0.0, hold_kv: bool = False) -> int:
         if self.last_error is not None:
             raise RuntimeError(f"serving loop failed: {self.last_error}")
         if not prompt:
@@ -790,6 +806,17 @@ class ContinuousBatcher:
                 "for argmax streams); start a non-speculative server for "
                 "sampling"
             )
+        if hold_kv and self._cache.ring:
+            raise ValueError(
+                "hold_kv does not support sliding-window models (ring lanes "
+                "wrap — the held slot's lanes are not position-stable for "
+                "extraction)"
+            )
+        if hold_kv and self._draft_params is not None:
+            raise ValueError(
+                "hold_kv with speculative serving is not supported (the "
+                "draft cache cannot travel on the handoff wire)"
+            )
         if len(prompt) + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
@@ -797,7 +824,7 @@ class ContinuousBatcher:
             )
         req = Request(id=next(self._ids), prompt=list(prompt),
                       max_new_tokens=int(max_new_tokens),
-                      temperature=float(temperature))
+                      temperature=float(temperature), hold_kv=bool(hold_kv))
         with self._lock:
             # Re-check under the lock: the failure handler drains the queue
             # holding it, so a submit racing the shutdown cannot strand a
@@ -808,6 +835,119 @@ class ContinuousBatcher:
             self._queue.append(req)
         return req.id
 
+    # -- disaggregated-serving handoff surface (see tpu_engine/disagg.py) ----
+
+    def submit_prefilled(self, handoff: Any, max_new_tokens: int = 64,
+                         temperature: float = 0.0) -> int:
+        """Admit a request whose prompt K/V arrives on the handoff wire
+        (a :class:`tpu_engine.disagg.KVHandoff` extracted from a prefill
+        pool) instead of being prefilled here. The engine inserts the wire
+        K/V into a free slot via the ordinary ``_insert_prefill`` path and
+        the request goes straight to decode — no prompt forward runs on
+        this engine. Token history (prompt + tokens the prefill engine
+        already emitted) counts against ``max_len``; ``max_new_tokens``
+        bounds the tokens THIS engine adds."""
+        if self.last_error is not None:
+            raise RuntimeError(f"serving loop failed: {self.last_error}")
+        if self._cache.ring:
+            raise ValueError(
+                "submit_prefilled does not support sliding-window pools"
+            )
+        if self._draft_params is not None:
+            raise ValueError(
+                "submit_prefilled with speculative serving is not supported "
+                "(the draft cache has no wire form)"
+            )
+        history = list(handoff.prompt) + list(handoff.emitted)
+        if handoff.length != len(history) - 1:
+            raise ValueError(
+                f"handoff length {handoff.length} != resident invariant "
+                f"(history {len(history)} - 1): wire payload is inconsistent"
+            )
+        if handoff.n_layers != self.cfg.n_layers or \
+                handoff.n_kv_heads != self.cfg.n_kv_heads or \
+                handoff.head_dim != self.cfg.head_dim:
+            raise ValueError(
+                "handoff KV geometry does not match this engine's model "
+                f"({handoff.n_layers}L/{handoff.n_kv_heads}KV/"
+                f"{handoff.head_dim}HD vs {self.cfg.n_layers}L/"
+                f"{self.cfg.n_kv_heads}KV/{self.cfg.head_dim}HD)"
+            )
+        if len(history) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"handoff history ({len(history)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the server's max_len "
+                f"{self.max_len}"
+            )
+        # ``prompt`` holds the FULL token history so _emit's max_len guard
+        # and attention-length bookkeeping see the true context size; the
+        # last history token is the decode input (resident K/V = everything
+        # except it — exactly the pool's steady-state invariant).
+        req = Request(id=next(self._ids), prompt=history,
+                      max_new_tokens=int(max_new_tokens),
+                      temperature=float(temperature))
+        with self._lock:
+            if self.last_error is not None:
+                raise RuntimeError(f"serving loop failed: {self.last_error}")
+            self._requests[req.id] = req
+            self._prefilled_queue.append((req, handoff))
+        return req.id
+
+    def request_handoff(self, req_id: int, quantize: bool = False) -> None:
+        """Order the ENGINE thread to extract the held slot's K/V into a
+        wire payload (collect with :meth:`take_handoff`/:meth:`wait_handoff`)
+        and free the slot. Only valid for a finished ``hold_kv`` request."""
+        with self._lock:
+            req = self._requests.get(req_id)
+            if req is None:
+                raise KeyError(req_id)
+            if not req.hold_kv:
+                raise ValueError(f"request {req_id} was not submitted hold_kv")
+            self._handoff_requests.append((req_id, bool(quantize)))
+
+    def release_held(self, req_id: int) -> None:
+        """Discard a held slot's K/V without extracting (the fleet gave up
+        on the handoff — e.g. the request was cancelled)."""
+        with self._lock:
+            self._handoff_requests.append((req_id, None))
+
+    def take_handoff(self, req_id: int) -> Any:
+        """Non-blocking collect: the extracted :class:`KVHandoff`, or None
+        if the engine has not processed the order yet. Raises RuntimeError
+        if extraction failed (slot no longer held — e.g. engine drained)."""
+        with self._lock:
+            if req_id not in self._handoffs:
+                return None
+            out = self._handoffs.pop(req_id)
+        if out is None:
+            raise RuntimeError(
+                f"handoff extraction failed for request {req_id}: slot no "
+                "longer held"
+            )
+        return out
+
+    def wait_handoff(self, req_id: int, timeout: float = 30.0) -> Any:
+        """Block until the engine extracts the payload ordered by
+        :meth:`request_handoff`."""
+        deadline = time.time() + timeout
+        with self._done:
+            while req_id not in self._handoffs:
+                if self.last_error is not None:
+                    raise RuntimeError(
+                        f"serving loop failed: {self.last_error}")
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"handoff {req_id} not extracted in {timeout}s")
+                self._done.wait(remaining)
+            out = self._handoffs.pop(req_id)
+        if out is None:
+            raise RuntimeError(
+                f"handoff extraction failed for request {req_id}: slot no "
+                "longer held"
+            )
+        return out
+
     def _result_locked(self, req: Request) -> dict[str, Any]:
         out = {
             "id": req.id, "status": req.status, "tokens": list(req.tokens),
@@ -817,6 +957,9 @@ class ContinuousBatcher:
             out["ttft_ms"] = round(
                 (req.first_token_at - req.submitted_at) * 1e3, 2
             )
+            # Absolute stamp too: fleet-level TTFT measures from FLEET
+            # submission (queue + route + prefill), not engine admission.
+            out["first_token_at"] = req.first_token_at
         if req.error:
             out["error"] = req.error
         return out
@@ -885,6 +1028,14 @@ class ContinuousBatcher:
                 "sharded": self.mesh is not None,
                 "speculative": self._draft_params is not None,
                 "kv_quant": self.kv_quant,
+                # Disaggregated-serving surface: held = finished prefills
+                # pinning K/V for extraction; queued_handoffs = wire
+                # payloads awaiting a decode slot (the fleet router counts
+                # both against this engine's free capacity).
+                "held_slots": len(self._held),
+                "queued_handoffs": len(self._prefilled_queue),
+                "handoffs_out": self.handoffs_out,
+                "handoffs_in": self.handoffs_in,
             }
             if self._prefix_cache is not None:
                 out["prefix_cache"] = self._prefix_cache.stats()
@@ -1017,15 +1168,34 @@ class ContinuousBatcher:
         ``submit``/``result``/``stats`` from serving threads never wait on
         device work. The engine thread is the sole mutator of the KV pool
         and slot arrays, so they need no lock at all."""
-        # ---- admission (bookkeeping under the lock) ----
+        # ---- handoff orders first: extraction frees held slots, so the
+        # admission pass below can reuse them in the SAME step ----
+        with self._lock:
+            orders, self._handoff_requests = self._handoff_requests, []
+        for rid, quantize in orders:
+            self._service_handoff(rid, quantize)
+
+        # ---- admission (bookkeeping under the lock): wire-prefilled
+        # requests win free slots (their prompt K/V is already paid for —
+        # they only need a lane to decode in), then queued prompts ----
+        admitted_handoffs: list[tuple[int, Request, Any]] = []
         admitted: list[tuple[int, Request]] = []
         with self._lock:
             for slot in range(self.max_slots):
-                if self._slots[slot] is None and self._queue:
+                if self._slots[slot] is not None:
+                    continue
+                if self._prefilled_queue:
+                    req, handoff = self._prefilled_queue.pop(0)
+                    req.status, req.slot = "running", slot
+                    self._slots[slot] = req
+                    admitted_handoffs.append((slot, req, handoff))
+                elif self._queue:
                     req = self._queue.pop(0)
                     req.status, req.slot = "running", slot
                     self._slots[slot] = req
                     admitted.append((slot, req))
+        for slot, req, handoff in admitted_handoffs:  # device insert, no lock
+            self._insert_handoff(handoff, slot)
         for slot, req in admitted:  # host-side alloc only — cheap
             self._prefilling[slot] = self._begin_prefill(req, slot)
 
@@ -1061,9 +1231,14 @@ class ContinuousBatcher:
                 self._emit(req, slot, tok)
                 produced += 1
             self._note_tokens(produced)
+            # Status filter matters for held slots: a finished hold_kv
+            # request still occupies its slot (pinning the K/V for the
+            # handoff plane) but must NOT keep decoding — advancing its
+            # length would scribble garbage past the extraction frontier.
             active_reqs = [
                 (i, r) for i, r in enumerate(self._slots)
-                if r is not None and i not in self._prefilling
+                if r is not None and r.status == "running"
+                and i not in self._prefilling
             ]
         if not active_reqs:
             return produced
@@ -1126,6 +1301,64 @@ class ContinuousBatcher:
             self._note_tokens(emitted)
         return produced + emitted
 
+    def _service_handoff(self, rid: int, quantize: Optional[bool]) -> None:
+        """ENGINE thread: extract a held slot's K/V into a wire payload
+        (``quantize`` True/False) or discard it (``quantize`` None), then
+        free the slot. The engine thread is the pool's sole mutator, so the
+        device slice here can never race a donated dispatch."""
+        from tpu_engine import disagg  # local: disagg imports this module
+
+        with self._lock:
+            slot = self._held.get(rid)
+            req = self._requests.get(rid)
+        if slot is None or req is None or self._slots[slot] is not req:
+            if quantize is not None:
+                with self._lock:
+                    self._handoffs[rid] = None  # extraction failed marker
+                    self._done.notify_all()
+            return
+        payload = None
+        if quantize is not None:
+            # Resident K/V = full history minus the last emitted token
+            # (decode writes its INPUT token — steady-state invariant).
+            length = len(req.prompt) + len(req.tokens) - 1
+            payload = disagg.extract_slot_kv(
+                self._cache, slot, length, cfg=self.cfg,
+                prompt=req.prompt, emitted=req.tokens, quantize=quantize,
+            )
+        self._cache = self._reset(self._cache, slot)
+        with self._lock:
+            self._held.pop(rid, None)
+            if self._slots[slot] is req:
+                self._slots[slot] = None
+            if quantize is not None:
+                self._handoffs[rid] = payload
+                self.handoffs_out += 1
+            self._done.notify_all()
+
+    def _insert_handoff(self, handoff: Any, slot: int) -> None:
+        """ENGINE thread: materialise a wire payload as a single-row
+        ingestion cache (converted to this pool's dtype/quant mode) and
+        copy it into ``slot`` via the ordinary ``_insert_prefill`` path."""
+        from tpu_engine import disagg  # local: disagg imports this module
+
+        c1 = disagg.handoff_to_cache(
+            handoff, dtype=self._compute_dtype, kv_quant=self.kv_quant,
+            chunk=self.prefill_chunk, max_lanes=self._cache.n_lanes,
+        )
+        if self._kv_sh is not None:
+            c1_sh = KVCache(k=self._kv_sh, v=self._kv_sh, pos=self._rep,
+                            length=self._rep, ring=False,
+                            k_scale=self._kv_sh if self.kv_quant else None,
+                            v_scale=self._kv_sh if self.kv_quant else None)
+            c1 = jax.device_put(c1, c1_sh)
+        self._cache = self._insert(
+            self._cache, c1, jnp.asarray(slot),
+            jnp.asarray(handoff.length, jnp.int32), self._cache.ring,
+        )
+        self._last_tokens[slot] = handoff.last_token
+        self.handoffs_in += 1
+
     def _note_tokens(self, n: int) -> None:
         """Caller holds the lock."""
         if n:
@@ -1164,6 +1397,14 @@ class ContinuousBatcher:
         if finished:
             req.status = "done"
             req.finished_at = time.time()
+            if req.hold_kv:
+                # Disaggregated prefill: keep the slot (and the K/V in it)
+                # pinned for the handoff plane — _slots[slot] stays set so
+                # admission skips it, and step()'s status filter keeps it
+                # out of decode. request_handoff/release_held free it.
+                self._held[req.id] = slot
+                self._done.notify_all()
+                return
             self._slots[slot] = None
             # Free slot: zero its length (and ring positions) so admission
             # reuses it cleanly; overshoot lanes from a mid-chunk finish
@@ -1194,7 +1435,9 @@ class ContinuousBatcher:
                 # but advanced a prefill chunk (or left admissions waiting)
                 # must loop immediately — sleeping between every chunk of a
                 # long prompt would add ~idle_sleep × n_chunks to its TTFT.
-                if produced == 0 and not self._prefilling and not self._queue:
+                if produced == 0 and not self._prefilling and not self._queue \
+                        and not self._handoff_requests \
+                        and not self._prefilled_queue:
                     time.sleep(idle_sleep)
         finally:
             if self.last_error is None:
@@ -1206,13 +1449,17 @@ class ContinuousBatcher:
         sit 'queued' forever), and wake every waiter."""
         self.last_error = msg  # reject new submits first
         with self._lock:
-            for req in list(self._slots) + list(self._queue):
+            pending_prefilled = [req for req, _ in self._prefilled_queue]
+            for req in list(self._slots) + list(self._queue) + pending_prefilled:
                 if req is not None and req.status in ("queued", "running"):
                     req.status, req.error = "failed", msg
                     req.finished_at = time.time()
             self._slots = [None] * self.max_slots
             self._queue.clear()
             self._prefilling.clear()
+            self._held.clear()
+            self._handoff_requests.clear()
+            self._prefilled_queue.clear()
             self._done.notify_all()
 
 

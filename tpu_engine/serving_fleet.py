@@ -90,6 +90,12 @@ class ServingReplicaSpec(BaseModel):
     decode_chunk_steps: int = Field(default=8, ge=1)
     eos_id: Optional[int] = Field(default=None, ge=0)
     seed: int = 0
+    # Disaggregated serving (tpu_engine/disagg.py): a "prefill" pool's
+    # replicas hold KV only for in-flight handoffs (its admission estimate
+    # sizes the pool to ``inflight_handoffs`` slots with the prefill
+    # workspace dominant); "decode" pools estimate like "unified" ones.
+    pool_role: str = Field(default="unified", pattern="^(unified|prefill|decode)$")
+    inflight_handoffs: Optional[int] = Field(default=None, ge=1)
 
     def placement_config(self) -> TPUTrainConfig:
         """The config the scheduler queues for one replica: its mesh IS the
@@ -121,6 +127,8 @@ class ServingReplicaSpec(BaseModel):
             ),
             prefill_chunk=self.prefill_chunk,
             prefix_cache_tokens=self.prefix_cache_tokens,
+            pool_role=self.pool_role,
+            inflight_handoffs=self.inflight_handoffs,
         )
 
 
@@ -231,10 +239,15 @@ class ServingReplicaJob:
         spec: ServingReplicaSpec,
         engine_factory: Callable[[ServingReplicaSpec], Any] = build_replica_engine,
         idle_sleep_s: float = 0.005,
+        fault_injector: Optional[Any] = None,
     ):
         self.job_id = sub.job_id
         self.config = sub.config
         self.spec = spec
+        # Chaos seam: an armed tpu_engine.faults.FaultInjector whose
+        # preemption-signal faults fire against THIS replica's token
+        # counter — same consumable contract as the training supervisor.
+        self._faults = fault_injector
         self.status = JobStatus.PENDING
         self.error: Optional[str] = None
         self.current_step = 0  # tokens generated — the replica's "progress"
@@ -282,6 +295,10 @@ class ServingReplicaJob:
         self.status = JobStatus.RUNNING
         try:
             while True:
+                if self._faults is not None and self._faults.preempt_due(
+                    self.current_step
+                ):
+                    self.watcher.fired.set()
                 if self.watcher.fired.is_set():
                     self.status = JobStatus.PREEMPTED
                     return
@@ -398,6 +415,11 @@ class AutoscalerConfig(BaseModel):
     target_queue_per_replica: float = Field(default=4.0, gt=0)
     low_water_queue_per_replica: float = Field(default=0.5, ge=0)
     p99_slo_ms: float = Field(default=2000.0, gt=0)
+    # Optional TTFT SLO: breaching it scales up even while end-to-end p99
+    # is healthy (long-prefill bursts hurt time-to-first-token long before
+    # they hurt completion latency — the disaggregated prefill pool scales
+    # on this signal).
+    ttft_slo_ms: Optional[float] = Field(default=None, gt=0)
     window_s: float = Field(default=30.0, gt=0)
     scale_up_cooldown_s: float = Field(default=5.0, ge=0)
     # Hysteresis: scaling down waits this long after ANY scale event, so a
@@ -429,8 +451,11 @@ class ReplicaAutoscaler:
         queue_depth: float,
         p99_ms: Optional[float],
         n_replicas: int,
+        ttft_p99_ms: Optional[float] = None,
     ) -> int:
-        """Record one observation, return the desired replica count."""
+        """Record one observation, return the desired replica count.
+        ``ttft_p99_ms`` only matters when the config sets ``ttft_slo_ms``
+        (the disaggregated prefill pool's scale signal)."""
         c = self.cfg
         self._samples.append((now, float(queue_depth)))
         while self._samples and now - self._samples[0][0] > c.window_s:
@@ -447,19 +472,32 @@ class ReplicaAutoscaler:
             default=None,
         )
         slo_breach = p99_ms is not None and p99_ms > c.p99_slo_ms
+        ttft_breach = (
+            c.ttft_slo_ms is not None
+            and ttft_p99_ms is not None
+            and ttft_p99_ms > c.ttft_slo_ms
+        )
         if (
-            (per_rep > c.target_queue_per_replica or slo_breach)
+            (per_rep > c.target_queue_per_replica or slo_breach or ttft_breach)
             and n_replicas < c.max_replicas
             and (self._last_up is None or now - self._last_up >= c.scale_up_cooldown_s)
         ):
             self._last_up = now
             self.scale_ups += 1
-            self.last_reason = (
-                f"scale up: p99 {p99_ms:.0f}ms > SLO {c.p99_slo_ms:.0f}ms"
-                if slo_breach
-                else f"scale up: queue/replica {per_rep:.2f} > "
-                     f"{c.target_queue_per_replica}"
-            )
+            if slo_breach:
+                self.last_reason = (
+                    f"scale up: p99 {p99_ms:.0f}ms > SLO {c.p99_slo_ms:.0f}ms"
+                )
+            elif ttft_breach:
+                self.last_reason = (
+                    f"scale up: ttft p99 {ttft_p99_ms:.0f}ms > TTFT SLO "
+                    f"{c.ttft_slo_ms:.0f}ms"
+                )
+            else:
+                self.last_reason = (
+                    f"scale up: queue/replica {per_rep:.2f} > "
+                    f"{c.target_queue_per_replica}"
+                )
             return n_replicas + 1
 
         window_full = (
@@ -470,6 +508,7 @@ class ReplicaAutoscaler:
             and window_full
             and per_rep < c.low_water_queue_per_replica
             and not slo_breach
+            and not ttft_breach
             and (last_event is None or now - last_event >= c.scale_down_cooldown_s)
         ):
             self._last_down = now
@@ -514,6 +553,7 @@ class ServingFleet:
         submitter: str = "serving-fleet",
         engine_factory: Callable[[ServingReplicaSpec], Any] = build_replica_engine,
         latency_window: int = 512,
+        fault_injector: Optional[Any] = None,
     ):
         self.scheduler = scheduler
         self.spec = spec
@@ -522,6 +562,7 @@ class ServingFleet:
         self.priority = priority
         self.submitter = submitter
         self.engine_factory = engine_factory
+        self.fault_injector = fault_injector
 
         self._lock = threading.RLock()
         self._replicas: dict[str, Submission] = {}  # submission_id → sub
@@ -532,6 +573,12 @@ class ServingFleet:
         self._requests: dict[str, dict[str, Any]] = {}
         self._req_seq = 0
         self._latencies: collections.deque[tuple[float, float]] = (
+            collections.deque(maxlen=latency_window)
+        )
+        # Fleet-level TTFT: first_token_at (engine stamp) minus FLEET
+        # submission time — includes fleet queueing and routing, which the
+        # engine's own ttft_ms cannot see.
+        self._ttfts: collections.deque[float] = (
             collections.deque(maxlen=latency_window)
         )
         self.requests_total = 0
@@ -574,7 +621,8 @@ class ServingFleet:
             workload="serving",
             estimate_fn=spec.estimate,
             job_factory=lambda s: ServingReplicaJob(
-                s, spec, engine_factory=self.engine_factory
+                s, spec, engine_factory=self.engine_factory,
+                fault_injector=self.fault_injector,
             ),
         )
         self._replicas[sub.submission_id] = sub
@@ -713,9 +761,16 @@ class ServingFleet:
 
     @staticmethod
     def _engine_router_stats(engine: Any) -> dict[str, Any]:
+        # Busy accounting is pool-aware: active_slots already counts held
+        # (finished-but-pinned) prefill slots, and queued_handoffs are wire
+        # payloads that will claim a slot before any new route lands.
         st = engine.stats()
         slots = int(st.get("slots", 1))
-        busy = int(st.get("active_slots", 0)) + int(st.get("prefilling", 0))
+        busy = (
+            int(st.get("active_slots", 0))
+            + int(st.get("prefilling", 0))
+            + int(st.get("queued_handoffs", 0))
+        )
         return {
             "tokens_per_sec": float(st.get("tokens_per_sec_recent", 0.0)),
             "free_slots": max(slots - busy, 0),
@@ -774,6 +829,12 @@ class ServingFleet:
                 self.tokens_total += n_new
                 latency_ms = (time.time() - req["submitted_at"]) * 1000.0
                 self._latencies.append((time.time(), latency_ms))
+                first_at = out.get("first_token_at")
+                if first_at is not None:
+                    ttft = (float(first_at) - req["submitted_at"]) * 1000.0
+                    if ttft >= 0:
+                        self._ttfts.append(ttft)
+                        out["fleet_ttft_ms"] = round(ttft, 2)
                 span = req.get("_span")
                 if span is not None and span.t1 is None:
                     span.end(
@@ -793,6 +854,17 @@ class ServingFleet:
                 return None
             vals = sorted(ms for _, ms in self._latencies)
             return vals[min(int(0.99 * (len(vals) - 1)), len(vals) - 1)]
+
+    def ttft_percentiles(self) -> dict[str, Optional[float]]:
+        """p50/p99 of fleet-level TTFT (fleet submit → engine first token)
+        over the latency window; None until a completion reports one."""
+        with self._lock:
+            if not self._ttfts:
+                return {"p50": None, "p99": None}
+            vals = sorted(self._ttfts)
+            def pct(q: float) -> float:
+                return vals[min(int(q * (len(vals) - 1)), len(vals) - 1)]
+            return {"p50": round(pct(0.50), 2), "p99": round(pct(0.99), 2)}
 
     def queue_depth(self) -> int:
         engines = self.running_replicas()
@@ -818,8 +890,10 @@ class ServingFleet:
             })
             n_running = len(engines)
             p99 = self.p99_latency_ms()
+            ttft_p99 = self.ttft_percentiles()["p99"]
             desired = self.autoscaler.observe(
-                now, self.queue_depth(), p99, n_running
+                now, self.queue_depth(), p99, n_running,
+                ttft_p99_ms=ttft_p99,
             )
             # Feed the fleet SLO alerter's serving-p99 window (burn-rate
             # evaluation happens on the read path, not here).
@@ -896,6 +970,8 @@ class ServingFleet:
                 "completed_total": self.completed_total,
                 "tokens_total": self.tokens_total,
                 "p99_latency_ms": self.p99_latency_ms(),
+                "ttft_p50_ms": self.ttft_percentiles()["p50"],
+                "ttft_p99_ms": self.ttft_percentiles()["p99"],
                 "scale_ups_total": self.scale_ups_total,
                 "scale_downs_total": self.scale_downs_total,
                 "router": self.router.stats(),
