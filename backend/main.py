@@ -33,6 +33,7 @@ from backend.routers import (
     tpu,
     tracing,
     training,
+    twin,
 )
 
 VERSION = "0.1.0"
@@ -97,6 +98,11 @@ async def root(request: web.Request) -> web.Response:
                 "continuous-batching serving with SSE token streaming, "
                 "prompt-prefix KV reuse, int8 weights/KV, and speculative "
                 "decoding",
+                "trace-replay digital twin: flight-recorder JSONL "
+                "ingestion (rotation/torn-tail hardened, schema-"
+                "versioned) replayed against the real control-plane "
+                "components under one virtual clock, with synthetic "
+                "traffic generators and A/B policy scorecards",
                 "OpenAPI 3.1 schema (/openapi.json) and self-contained "
                 "/docs page",
             ],
@@ -113,6 +119,7 @@ async def root(request: web.Request) -> web.Response:
                 "goodput": "/api/v1/goodput",
                 "hetero": "/api/v1/hetero",
                 "compile_cache": "/api/v1/compile-cache",
+                "twin": "/api/v1/twin",
                 "metrics": "/metrics",
                 "openapi": "/openapi.json",
                 "docs": "/docs",
@@ -153,6 +160,7 @@ def create_app() -> web.Application:
     goodput.setup(app)
     hetero.setup(app)
     compile_cache.setup(app)
+    twin.setup(app)
     serving.setup(app)
     metrics.setup(app)
     app.router.add_get("/", root)

@@ -1,0 +1,87 @@
+"""Digital-twin routes — the replay surface for ``tpu_engine/twin.py``:
+
+- ``GET /api/v1/twin``          — twin health counters (the same numbers
+  the ``tpu_engine_twin_*`` Prometheus families export) + route index;
+- ``POST /api/v1/twin/replay``  — dry-run replay of a flight-recorder
+  JSONL file against the real control-plane components under a virtual
+  clock. Body: ``{"path": "...", "bucket_s": 60.0}``. Nothing in the
+  live process is touched — the replay records onto a fresh recorder and
+  returns the per-trace goodput decompositions, ingest skip counts, and
+  the fleet-seconds-per-CPU-second throughput of the run.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+from aiohttp import web
+
+from backend.http import ApiError, json_response
+from tpu_engine import twin as twin_mod
+
+# A dry run is a diagnostic, not a data export: cap the per-trace table
+# so replaying a week of recorder output cannot balloon one response.
+_MAX_TRACES_IN_RESPONSE = 100
+
+
+async def twin_status(request: web.Request) -> web.Response:
+    return json_response({
+        "stats": twin_mod.twin_stats(),
+        "schema_version": twin_mod.SCHEMA_VERSION,
+        "skip_reasons": list(twin_mod.SKIP_REASONS),
+        "endpoints": {
+            "replay": "POST /api/v1/twin/replay {path, bucket_s?}",
+        },
+    })
+
+
+def _replay_file(path: str, bucket_s: float) -> dict:
+    workload = twin_mod.ReplayWorkload.from_jsonl(path)
+    engine = twin_mod.TwinEngine()
+    result = engine.replay(workload, bucket_s=bucket_s)
+    traces = result["traces"]
+    out_traces = dict(list(traces.items())[:_MAX_TRACES_IN_RESPONSE])
+    return {
+        "path": path,
+        "dry_run": True,
+        "ingest": result["ingest"],
+        "spans_replayed": result["spans_replayed"],
+        "events_replayed": result["events_replayed"],
+        "jobs": len(workload.jobs),
+        "faults": len(workload.faults),
+        "requests": len(workload.requests),
+        "t_range": workload.t_range,
+        "fleet_seconds": result["fleet_seconds"],
+        "cpu_seconds": result["cpu_seconds"],
+        "fleet_seconds_per_cpu_second":
+            result["fleet_seconds_per_cpu_second"],
+        "traces": out_traces,
+        "traces_truncated": max(0, len(traces) - _MAX_TRACES_IN_RESPONSE),
+    }
+
+
+async def twin_replay(request: web.Request) -> web.Response:
+    try:
+        body = await request.json()
+    except Exception:
+        raise ApiError(400, "body must be JSON: {\"path\": \"...\"}")
+    if not isinstance(body, dict) or not isinstance(body.get("path"), str):
+        raise ApiError(400, "body must carry a string 'path' to recorder JSONL")
+    path = body["path"]
+    bucket_s = body.get("bucket_s", 60.0)
+    if not isinstance(bucket_s, (int, float)) or bucket_s <= 0:
+        raise ApiError(400, "'bucket_s' must be a positive number")
+    if not (os.path.exists(path) or os.path.exists(path + ".1")):
+        raise ApiError(404, f"no recorder JSONL at '{path}'")
+    # CPU-bound and filesystem-bound: keep it off the event loop.
+    loop = asyncio.get_running_loop()
+    result = await loop.run_in_executor(
+        None, _replay_file, path, float(bucket_s)
+    )
+    return json_response(result)
+
+
+def setup(app: web.Application, prefix: str = "/api/v1") -> None:
+    app.router.add_get(f"{prefix}/twin", twin_status)
+    app.router.add_post(f"{prefix}/twin/replay", twin_replay)

@@ -198,6 +198,9 @@ def main() -> None:
     hetero_line = _hetero_metric()
     if hetero_line is not None:
         print(json.dumps(hetero_line))
+    twin_line = _twin_metric()
+    if twin_line is not None:
+        print(json.dumps(twin_line))
 
 
 def _comm_compress_metric(n_dev: int) -> dict | None:
@@ -584,6 +587,21 @@ def _hetero_metric() -> dict | None:
                 == het["params"]["global_micro"]
             ),
         }
+    except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
+        return None
+
+
+def _twin_metric() -> dict | None:
+    """Tenth JSON line: digital-twin replay fidelity + policy A/B — the
+    twin records the seeded chaos run, re-ingests its JSONL, replays it
+    against the real goodput ledger (per-category error must be <1%),
+    and scores checkpoint-interval / compile-index policy variants over
+    the same fault trace (tpu_engine/twin.py). Never fails the bench:
+    any error degrades to None."""
+    try:
+        from tpu_engine.twin import twin_bench_line
+
+        return twin_bench_line(seed=0)
     except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
         return None
 

@@ -1,39 +1,33 @@
-"""Chaos trace: MTTR + goodput under chip faults, self-heal vs die-and-restart.
+"""Chaos scenario: MTTR + goodput under chip faults, self-heal vs die-restart.
+
+Thin scenario definition over the digital twin (``tpu_engine/twin.py``):
+the seeded chip-fault timeline, the self-heal / die-and-restart / hetero
+policy lanes, the goodput + SLO accounting, and this file's CLI flags,
+exit gates and JSON metric lines are unchanged from the pre-twin
+benchmark — but the virtual-clock engine, the recovery-chain recording,
+and the goodput lane now live in the twin, shared with the other sims
+and with trace replay.
 
 Deterministic discrete-event comparison (virtual clock — no threads, no
-sleeps, identical numbers every run) of the two recovery policies on the
+sleeps, identical numbers every run) of two recovery policies on the
 same seeded chip-fault trace drawn from :meth:`FaultPlan.random`:
 
 - **die-and-restart** — what the reference amounts to: an external monitor
   notices the dead job (poll latency), the gang waits for the failed chip
-  to be replaced (a full mesh is required to restart), the job restarts
-  from the last *periodic* checkpoint, re-running every step since it.
-- **self-heal** — this repo's supervisor path: detection is in-band (the
-  per-step health check), a synchronous emergency save persists the
-  *current* step, the scheduler re-admits on an elastically shrunk mesh
-  (throughput degrades ∝ chips while degraded, zero steps lost), and a
-  grow-back preempt-resume restores the full mesh once the chip recovers.
+  to be replaced, the job restarts from the last *periodic* checkpoint.
+- **self-heal** — this repo's supervisor path: in-band detection, a
+  synchronous emergency save, re-admission on an elastically shrunk mesh
+  (zero steps lost), and a grow-back once the chip recovers.
 
-Both policies pay the same per-event chip-recovery time; the difference is
-what training does meanwhile. Reports per-fault MTTR (time from fault to
-the next useful step) and goodput (useful full-mesh step-seconds per
-wall-second); ``bench.py`` reuses :func:`run_trace` for its chaos line.
+The self-heal compile leg is priced through a real (in-memory)
+``CompileCacheIndex`` — the on/off MTTR delta is the fleet compile
+cache's headline number. A second lane replays a seeded HOST_SLOW plan
+under rebalance-on / rebalance-off / shrink (``tpu_engine/hetero.py``).
 
-The self-heal resume overhead is split into admit + compile, and the
-compile leg is priced through a real (in-memory) ``CompileCacheIndex``:
-the first resume onto a given shrunk layout compiles cold, later resumes
-onto a layout the index has seen are warm cache hits, and grow-backs pay
-only the warm relink because the scheduler's background precompile runs
-the cold compile off the critical path. The same trace is replayed with
-the index off (every resume cold) — the on/off MTTR delta is the fleet
-compile cache's headline number. Compile spans carry ``cache_hit`` so the
-goodput lane's ``compile`` category splits warm vs cold.
-
-With ``--trace-out PATH`` the self-heal run also records its lifecycle in
-a ``FlightRecorder`` on the virtual clock — each fault's
-detect → emergency-save → requeue → shrink-admit → resume (→ grow-back)
-chain as causally-linked spans under one job trace — and writes it as
-Chrome-trace/Perfetto JSON (load in ``ui.perfetto.dev``).
+With ``--trace-out PATH`` the self-heal run also records its lifecycle
+(detect → emergency-save → requeue → shrink-admit → resume chains) as
+Chrome-trace/Perfetto JSON; with ``--trace-jsonl PATH`` the recorder
+persists JSONL the twin can re-ingest (``POST /api/v1/twin/replay``).
 
 Run: ``JAX_PLATFORMS=cpu python -m benchmarks.chaos [--seed N]
 [--trace-out /tmp/chaos_trace.json]``.
@@ -49,111 +43,52 @@ from typing import Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from tpu_engine import hetero as hetero_mod  # noqa: E402
+from tpu_engine import twin as twin_mod  # noqa: E402
 from tpu_engine.compile_index import CompileCacheIndex  # noqa: E402
-from tpu_engine.faults import (  # noqa: E402
-    FaultInjector,
-    FaultKind,
-    FaultPlan,
-    FaultSpec,
-)
-from tpu_engine.goodput import (  # noqa: E402
-    CATEGORIES,
-    GoodputLedger,
-    SLOBurnRateAlerter,
-)
+from tpu_engine.faults import FaultPlan  # noqa: E402
 from tpu_engine.tracing import FlightRecorder  # noqa: E402
+from tpu_engine.twin import (  # noqa: E402
+    HeteroTwinParams,
+    TrainTwinParams,
+)
 
-# Model: 8-chip gang, fsdp=2 inner axis — a shrunk mesh must keep the
-# model axis intact, so usable chips come in multiples of 2.
-N_CHIPS = 8
-MODEL_AXIS = 2
-MIN_CHIPS = 2
-TOTAL_STEPS = 1_000
-STEP_TIME_S = 0.5          # full-mesh step time
-CKPT_INTERVAL_STEPS = 100  # periodic checkpoint cadence (both policies)
-CKPT_SAVE_S = 5.0          # synchronous save cost (periodic and emergency)
-RESUME_ADMIT_S = 5.0       # requeue + re-admit on a live plane
-COLD_COMPILE_S = 15.0      # XLA compile of a layout the cache has not seen
-WARM_COMPILE_S = 1.5       # persistent-cache hit: deserialize + relink only
-DIE_DETECT_S = 30.0        # external monitor poll latency (die-and-restart)
-DIE_RESTART_S = 120.0      # cold restart: reschedule + init + compile
-CHIP_RECOVERY_BASE_S = 60.0
-CHIP_RECOVERY_PER_DURATION_S = 30.0
+# The shipped scenario parameters; the twin's dataclasses carry them, the
+# module-level constants remain the stable public surface tests import.
+PARAMS = TrainTwinParams()
+HET_PARAMS = HeteroTwinParams()
+
+N_CHIPS = PARAMS.n_chips
+MODEL_AXIS = PARAMS.model_axis
+MIN_CHIPS = PARAMS.min_chips
+TOTAL_STEPS = PARAMS.total_steps
+STEP_TIME_S = PARAMS.step_time_s
+CKPT_INTERVAL_STEPS = PARAMS.ckpt_interval_steps
+CKPT_SAVE_S = PARAMS.ckpt_save_s
+RESUME_ADMIT_S = PARAMS.resume_admit_s
+COLD_COMPILE_S = PARAMS.cold_compile_s
+WARM_COMPILE_S = PARAMS.warm_compile_s
+DIE_DETECT_S = PARAMS.die_detect_s
+DIE_RESTART_S = PARAMS.die_restart_s
+CHIP_RECOVERY_BASE_S = PARAMS.chip_recovery_base_s
+CHIP_RECOVERY_PER_DURATION_S = PARAMS.chip_recovery_per_duration_s
+
+HET_HOSTS = HET_PARAMS.hosts
+HET_GLOBAL_MICRO = HET_PARAMS.global_micro
+HET_STEPS = HET_PARAMS.steps
+HET_TAIL_STEPS = HET_PARAMS.tail_steps
+HET_CHECK_EVERY = HET_PARAMS.check_every
+HET_SHRINK_AT_STEP = HET_PARAMS.shrink_at_step
+HET_SLOW_S = HET_PARAMS.slow_s
 
 
 def chip_fault_trace(seed: int, n_faults: int = 12) -> list[dict]:
-    """Chip-unhealthy events from a seeded plan: (step, device, recovery_s).
-
-    Draws a larger random plan and keeps the chip faults — same seed,
-    same trace, both policies replay it identically."""
-    plan = FaultPlan.random(
-        seed, n_faults=n_faults * 3, max_step=TOTAL_STEPS, n_devices=N_CHIPS
-    )
-    events, seen_steps = [], set()
-    for s in plan.specs:
-        if s.kind is not FaultKind.CHIP_UNHEALTHY or s.at_step is None:
-            continue
-        if s.at_step in seen_steps:  # one fault per step keeps both sims simple
-            continue
-        seen_steps.add(s.at_step)
-        events.append({
-            "step": int(s.at_step),
-            "device": int(s.device_index or 0),
-            "recovery_s": CHIP_RECOVERY_BASE_S
-            + CHIP_RECOVERY_PER_DURATION_S * float(s.duration_steps or 1),
-        })
-    events.sort(key=lambda e: e["step"])
-    return events[:n_faults]
-
-
-def _usable(healthy: int) -> int:
-    return max(MIN_CHIPS, (healthy // MODEL_AXIS) * MODEL_AXIS)
-
-
-def _layout_key(use: int) -> str:
-    """Index key for the shrunk-mesh layout running on ``use`` chips."""
-    return f"chaos|data{use // MODEL_AXIS}xfsdp{MODEL_AXIS}"
+    """Chip-unhealthy events from a seeded plan: (step, device, recovery_s)."""
+    return twin_mod.chip_fault_timeline(seed, n_faults=n_faults, params=PARAMS)
 
 
 def seed_initial_compile(index: CompileCacheIndex) -> None:
     """The job's own startup compile put the full-mesh layout in the cache."""
-    index.record(
-        _layout_key(N_CHIPS), COLD_COMPILE_S, cache_hit=False,
-        label=_layout_key(N_CHIPS).split("|", 1)[1], model="chaos", via="chaos",
-    )
-
-
-def _resume_compile(index: Optional[CompileCacheIndex], use: int) -> tuple[float, bool]:
-    """Compile cost of a shrink-resume onto ``use`` chips: (seconds, warm)."""
-    if index is None:  # index off: a fresh process always compiles cold
-        return COLD_COMPILE_S, False
-    key = _layout_key(use)
-    if index.is_warm(key):
-        index.record(key, WARM_COMPILE_S, cache_hit=True, via="chaos")
-        return WARM_COMPILE_S, True
-    index.record(key, COLD_COMPILE_S, cache_hit=False,
-                 label=key.split("|", 1)[1], model="chaos", via="chaos")
-    return COLD_COMPILE_S, False
-
-
-def _grow_compile(index: Optional[CompileCacheIndex], use: int) -> tuple[float, bool]:
-    """Compile cost of a grow-back preempt-resume onto ``use`` chips.
-
-    With the index on, the scheduler precompiles the target layout in the
-    background *before* preempting (``precompile_before_grow``), so the
-    cold compile never lands on the critical path — the resume pays only
-    the warm relink either way; a never-seen layout is recorded as a
-    background precompile."""
-    if index is None:
-        return COLD_COMPILE_S, False
-    key = _layout_key(use)
-    if not index.is_warm(key):
-        index.record(key, COLD_COMPILE_S, cache_hit=False,
-                     label=key.split("|", 1)[1], model="chaos",
-                     via="precompile")
-    index.record(key, WARM_COMPILE_S, cache_hit=True, via="chaos")
-    return WARM_COMPILE_S, True
+    twin_mod.seed_initial_compile(index, PARAMS)
 
 
 def simulate_self_heal(
@@ -162,212 +97,19 @@ def simulate_self_heal(
     trace_id: Optional[str] = None,
     compile_index: Optional[CompileCacheIndex] = None,
 ) -> dict:
-    clock = 0.0
-    healthy = N_CHIPS
-    pending: list[float] = []  # clocks at which a failed chip becomes healthy
-    mttrs: list[float] = []
-    grow_backs = 0
-    degraded_s = 0.0
-    warm_resumes = 0
-    cold_resumes = 0
-    compile_s_total = 0.0
-    i = 0
-    # Flight-recorder lane (virtual-clock timestamps — the recorder takes
-    # explicit t0/t1 everywhere for exactly this). Each fault's recovery
-    # chain links causally: detect -> emergency_save -> requeue ->
-    # shrink_admit -> resume; a later grow_back chains off the resume.
-    root = chain_tail = None
-    if recorder is not None:
-        trace_id = trace_id or recorder.new_trace_id()
-        root = recorder.start_span(
-            "job:chaos-self-heal", kind="job", trace_id=trace_id, t0=0.0,
-            attrs={"n_chips": N_CHIPS, "total_steps": TOTAL_STEPS},
-        )
-    for step in range(1, TOTAL_STEPS + 1):
-        # Grow back as soon as a chip has recovered: preempt-save-resume at
-        # the larger mesh (the scheduler's _maybe_grow pass).
-        while pending and pending[0] <= clock and healthy < N_CHIPS:
-            pending.pop(0)
-            healthy += 1
-            if _usable(healthy) > _usable(healthy - 1):
-                g_compile_s, g_warm = _grow_compile(compile_index, _usable(healthy))
-                g_admit_end = clock + CKPT_SAVE_S + RESUME_ADMIT_S
-                if recorder is not None:
-                    recorder.record_span(
-                        "grow_back", kind="admission", trace_id=trace_id,
-                        parent=chain_tail or root, t0=clock, t1=g_admit_end,
-                        attrs={"step": step, "mesh": _usable(healthy)},
-                    )
-                    recorder.record_span(
-                        "compile", kind="compile", trace_id=trace_id,
-                        parent=chain_tail or root, t0=g_admit_end,
-                        t1=g_admit_end + g_compile_s,
-                        attrs={"cache_hit": g_warm,
-                               "compile_s": g_compile_s,
-                               "layout": _layout_key(_usable(healthy))},
-                    )
-                clock = g_admit_end + g_compile_s
-                compile_s_total += g_compile_s
-                warm_resumes += 1 if g_warm else 0
-                cold_resumes += 0 if g_warm else 1
-                grow_backs += 1
-        use = _usable(healthy)
-        step_t = STEP_TIME_S * N_CHIPS / use
-        clock += step_t
-        if use < N_CHIPS:
-            degraded_s += step_t
-        if step % CKPT_INTERVAL_STEPS == 0:
-            if recorder is not None:
-                recorder.record_span(
-                    "checkpoint_save", kind="checkpoint_save",
-                    trace_id=trace_id, parent=root, t0=clock,
-                    t1=clock + CKPT_SAVE_S, attrs={"step": step},
-                )
-            clock += CKPT_SAVE_S
-        if i < len(events) and step >= events[i]["step"]:
-            ev = events[i]
-            i += 1
-            healthy -= 1
-            # Detection is the in-band health check on this very step;
-            # emergency save persists `step`, shrink-resume follows. The
-            # compile leg is warm iff the index has seen this layout.
-            compile_s, warm = _resume_compile(compile_index, _usable(healthy))
-            down = CKPT_SAVE_S + RESUME_ADMIT_S + compile_s
-            admit_end = clock + CKPT_SAVE_S + RESUME_ADMIT_S
-            if recorder is not None:
-                detect = recorder.record_span(
-                    "detect", kind="fault", trace_id=trace_id, parent=root,
-                    t0=clock, t1=clock,
-                    attrs={"step": step, "device": ev["device"]},
-                )
-                save = recorder.record_span(
-                    "emergency_save", kind="emergency_save",
-                    trace_id=trace_id, parent=detect, t0=clock,
-                    t1=clock + CKPT_SAVE_S, attrs={"step": step},
-                )
-                requeue = recorder.record_span(
-                    "requeue", kind="scheduler", trace_id=trace_id,
-                    parent=save, t0=clock + CKPT_SAVE_S,
-                    t1=clock + CKPT_SAVE_S, attrs={"step": step},
-                )
-                admit = recorder.record_span(
-                    "shrink_admit", kind="admission", trace_id=trace_id,
-                    parent=requeue, t0=clock + CKPT_SAVE_S, t1=admit_end,
-                    attrs={"step": step, "mesh": _usable(healthy)},
-                )
-                comp = recorder.record_span(
-                    "compile", kind="compile", trace_id=trace_id,
-                    parent=admit, t0=admit_end, t1=admit_end + compile_s,
-                    attrs={"cache_hit": warm, "compile_s": compile_s,
-                           "layout": _layout_key(_usable(healthy))},
-                )
-                chain_tail = recorder.record_span(
-                    "resume", kind="supervisor", trace_id=trace_id,
-                    parent=comp, t0=clock + down, t1=clock + down,
-                    attrs={"from_step": step},
-                )
-            clock += down
-            compile_s_total += compile_s
-            warm_resumes += 1 if warm else 0
-            cold_resumes += 0 if warm else 1
-            mttrs.append(step_t + down)
-            pending.append(clock + ev["recovery_s"])
-            pending.sort()
-    wall = clock
-    if root is not None:
-        root.end(t1=wall, faults=len(mttrs), grow_backs=grow_backs)
-    return {
-        "policy": "self-heal",
-        "compile_index": compile_index is not None,
-        "wall_s": round(wall, 1),
-        "steps_run": TOTAL_STEPS,
-        "lost_steps": 0,
-        "faults": len(mttrs),
-        "grow_backs": grow_backs,
-        "degraded_step_s": round(degraded_s, 1),
-        "warm_resumes": warm_resumes,
-        "cold_resumes": cold_resumes,
-        "compile_s_total": round(compile_s_total, 1),
-        "mttr_mean_s": round(sum(mttrs) / len(mttrs), 2) if mttrs else 0.0,
-        "mttr_max_s": round(max(mttrs), 2) if mttrs else 0.0,
-        "goodput": round(TOTAL_STEPS * STEP_TIME_S / wall, 4),
-    }
+    return twin_mod.replay_self_heal(
+        events, PARAMS, recorder=recorder, trace_id=trace_id,
+        compile_index=compile_index,
+    )
 
 
 def simulate_die_and_restart(events: list[dict]) -> dict:
-    clock = 0.0
-    step = 0
-    last_ckpt = 0
-    lost_steps = 0
-    steps_run = 0
-    mttrs: list[float] = []
-    i = 0
-    while step < TOTAL_STEPS:
-        clock += STEP_TIME_S
-        step += 1
-        steps_run += 1
-        if step % CKPT_INTERVAL_STEPS == 0:
-            last_ckpt = step
-            clock += CKPT_SAVE_S
-        if i < len(events) and step >= events[i]["step"]:
-            ev = events[i]
-            i += 1  # each fault fires once, even though step rolls back
-            lost = step - last_ckpt
-            lost_steps += lost
-            # Nothing runs until the chip is replaced (full mesh required),
-            # then a cold restart replays everything since the checkpoint.
-            down = DIE_DETECT_S + ev["recovery_s"] + DIE_RESTART_S
-            clock += down
-            mttrs.append(down + lost * STEP_TIME_S)
-            step = last_ckpt
-    wall = clock
-    return {
-        "policy": "die-and-restart",
-        "wall_s": round(wall, 1),
-        "steps_run": steps_run,
-        "lost_steps": lost_steps,
-        "faults": len(mttrs),
-        "grow_backs": 0,
-        "degraded_step_s": 0.0,
-        "mttr_mean_s": round(sum(mttrs) / len(mttrs), 2) if mttrs else 0.0,
-        "mttr_max_s": round(max(mttrs), 2) if mttrs else 0.0,
-        "goodput": round(TOTAL_STEPS * STEP_TIME_S / wall, 4),
-    }
-
-
-# -- heterogeneous sharding lane ----------------------------------------------
-# A second, independent trace: no chips die, but one host runs sustained-slow
-# (a seeded faults.py HOST_SLOW plan). The synchronous gang gates every step
-# on that host unless the heterogeneity plane (tpu_engine/hetero.py) reweights
-# the per-process row assignment. Three policies replay the identical plan on
-# the same virtual clock: rebalance-off (uniform rows forever), rebalance-on
-# (a live HeteroRebalancer fed by the injector's host-slow signals), and
-# shrink (evict the slow host, 7-chip uniform gang). Goodput here is measured
-# against the *heterogeneous* ideal — every host contributing exactly its
-# capacity — so rebalance can approach 1.0 while shrink, which throws the
-# slow host's remaining 75% away, cannot.
-HET_HOSTS = 8
-HET_GLOBAL_MICRO = 128
-HET_STEPS = 400
-HET_TAIL_STEPS = 100       # steady-state window: the last N steps
-HET_CHECK_EVERY = 10       # rebalance consult cadence (steps)
-HET_SHRINK_AT_STEP = 25    # when the shrink policy evicts the slow host
-# Reported per-step stall while uniformly loaded; the slow host's true rate
-# is STEP/(STEP+stall) = 0.75 — the headline 25%-degraded host.
-HET_SLOW_S = STEP_TIME_S / 3.0
+    return twin_mod.replay_die_and_restart(events, PARAMS)
 
 
 def host_slow_plan(seed: int) -> FaultPlan:
     """Sustained host-slow on one seeded host: fires every step."""
-    import random as _random
-
-    host = _random.Random(seed).randrange(HET_HOSTS)
-    return FaultPlan(seed=seed, specs=[
-        FaultSpec(
-            kind=FaultKind.HOST_SLOW, at_step=1, device_index=host,
-            slow_s=round(HET_SLOW_S, 6), count=HET_STEPS,
-        )
-    ])
+    return twin_mod.host_slow_plan(seed, HET_PARAMS)
 
 
 def simulate_hetero(
@@ -376,185 +118,24 @@ def simulate_hetero(
     recorder: Optional[FlightRecorder] = None,
     trace_id: Optional[str] = None,
 ) -> dict:
-    """Replay ``plan`` under one policy on the virtual clock.
-
-    The injector is the only degradation source: a consumed HOST_SLOW spec
-    both slows the simulated host (truth) and feeds the ThroughputTracker
-    (signal) — exactly the supervisor's ``take_host_slow`` seam."""
-    inj = FaultInjector(plan)
-    inj.arm()
-    rate = [1.0] * HET_HOSTS           # ground-truth relative rates
-    rows_u = HET_GLOBAL_MICRO // HET_HOSTS
-    vclock = 0.0
-    tracker = hetero_mod.ThroughputTracker(HET_HOSTS)
-    reb = hetero_mod.HeteroRebalancer(
-        tracker, HET_GLOBAL_MICRO, dry_run=False, cooldown_s=30.0,
-        min_gain=0.01, clock=lambda: vclock,
-        recorder=recorder, trace_id=trace_id,
+    return twin_mod.replay_hetero(
+        policy, plan, HET_PARAMS, recorder=recorder, trace_id=trace_id
     )
-    assignment = list(reb.assignment)
-    active = list(range(HET_HOSTS))
-    shrunk = False
-    downtime_s = 0.0
-    rebalance_step: Optional[int] = None
-    ideal_wall = 0.0
-    tail_wall = tail_ideal = 0.0
-    for step in range(1, HET_STEPS + 1):
-        spec = inj.take_host_slow(step)
-        if spec is not None:
-            idx = int(spec.device_index or 0)
-            rate[idx] = STEP_TIME_S / (STEP_TIME_S + float(spec.slow_s))
-            tracker.note_host_slow(idx, float(spec.slow_s), STEP_TIME_S)
-        if policy == "shrink" and not shrunk and step >= HET_SHRINK_AT_STEP:
-            # Evict the slow host: emergency save + re-admit + cold compile,
-            # then a 7-host uniform gang carries the full global batch.
-            shrunk = True
-            slow_host = min(range(HET_HOSTS), key=lambda h: rate[h])
-            active = [h for h in range(HET_HOSTS) if h != slow_host]
-            assignment = hetero_mod.uniform_assignment(
-                HET_GLOBAL_MICRO, len(active)
-            )
-            downtime_s = CKPT_SAVE_S + RESUME_ADMIT_S + COLD_COMPILE_S
-            vclock += downtime_s
-        # Synchronous gang: the step ends when the slowest member finishes
-        # its rows; a host's nominal pace is rows_u rows per STEP_TIME_S.
-        step_s = max(
-            assignment[j] * STEP_TIME_S / (rows_u * rate[h])
-            for j, h in enumerate(active)
-        )
-        ideal_s = HET_GLOBAL_MICRO * STEP_TIME_S / (rows_u * sum(rate))
-        vclock += step_s
-        ideal_wall += ideal_s
-        tracker.observe_step(step_s)
-        if policy == "rebalance-on" and step % HET_CHECK_EVERY == 0:
-            r_plan = reb.maybe_rebalance(step)
-            if r_plan is not None:
-                assignment = list(r_plan.assignment)
-                if rebalance_step is None:
-                    rebalance_step = step
-        if step > HET_STEPS - HET_TAIL_STEPS:
-            tail_wall += step_s
-            tail_ideal += ideal_s
-    return {
-        "policy": policy,
-        "wall_s": round(vclock, 1),
-        "ideal_wall_s": round(ideal_wall, 1),
-        "downtime_s": round(downtime_s, 1),
-        "goodput": round(ideal_wall / vclock, 4),
-        "steady_goodput": round(tail_ideal / tail_wall, 4),
-        "assignment": list(assignment),
-        "active_hosts": len(active),
-        "rebalance_step": rebalance_step,
-        "rebalancer": reb.stats() if policy == "rebalance-on" else None,
-    }
 
 
 def run_hetero_lane(
     seed: int = 0, recorder: Optional[FlightRecorder] = None
 ) -> dict:
     """Rebalance-on vs rebalance-off vs shrink on one seeded slow-host plan."""
-    plan = host_slow_plan(seed)
-    trace_id = recorder.new_trace_id() if recorder is not None else None
-    on = simulate_hetero("rebalance-on", plan, recorder=recorder,
-                         trace_id=trace_id)
-    off = simulate_hetero("rebalance-off", plan)
-    shrink = simulate_hetero("shrink", plan)
-    return {
-        "seed": seed,
-        "params": {
-            "n_hosts": HET_HOSTS,
-            "global_micro": HET_GLOBAL_MICRO,
-            "steps": HET_STEPS,
-            "slow_host_rate": round(
-                STEP_TIME_S / (STEP_TIME_S + HET_SLOW_S), 4
-            ),
-            "slow_host": int(plan.specs[0].device_index or 0),
-            "check_every_steps": HET_CHECK_EVERY,
-        },
-        "rebalance_on": on,
-        "rebalance_off": off,
-        "shrink": shrink,
-        "steady_goodput_on": on["steady_goodput"],
-        "steady_goodput_off": off["steady_goodput"],
-        "steady_goodput_shrink": shrink["steady_goodput"],
-        "goodput_recovered": round(
-            on["steady_goodput"] - off["steady_goodput"], 4
-        ),
-    }
+    return twin_mod.run_hetero_ab(seed, HET_PARAMS, recorder=recorder)
 
 
 def goodput_lane(
     recorder: FlightRecorder, trace_id: str, wall: float
 ) -> dict:
-    """Account the self-heal trace through the REAL goodput ledger (the
-    same decomposition live submissions get), then replay the SLO
-    burn-rate alerter over the run's virtual clock.
-
-    The fault plan is deterministic, so the alert progression is too:
-    the clean head of the run evaluates ok, the first fault cluster
-    burns the short+long windows past ``warning_burn``, and the
-    sustained degraded tail past ``page_burn``. Alert transitions land
-    as ``slo_alert`` events on the recorder's ``fleet`` timeline and
-    per-window counter samples as a Perfetto counter track — both ride
-    the same Chrome-trace export as the recovery chains they explain."""
-    ledger = GoodputLedger(clock=lambda: wall, bucket_s=60.0,
-                           history_buckets=256)
-    ledger.track(trace_id, tenant="chaos", workload="training",
-                 full_gang=N_CHIPS)
-    d = ledger.finalize(recorder, trace_id, now=wall)
-    assert d is not None
-    cats = d["categories"]
-    sum_error_pct = abs(sum(cats.values()) - d["wall_s"]) / d["wall_s"] * 100
-    alerter = SLOBurnRateAlerter(
-        ledger,
-        goodput_target=0.88,
-        short_window_s=120.0,
-        long_window_s=600.0,
-        warning_burn=1.5,
-        page_burn=3.0,
-        recorder=recorder,
-        clock=lambda: wall,
-    )
-    progression = ["ok"]
-    t = 0.0
-    while t <= wall + 60.0:
-        out = alerter.evaluate(now=t)
-        g = out["goodput"]
-        if g["state"] != progression[-1]:
-            progression.append(g["state"])
-        recorder.counter(
-            "goodput_burn",
-            {
-                "goodput_fraction_short": g["short_fraction"] or 1.0,
-                "burn_short": g["short_burn"] or 0.0,
-                "burn_long": g["long_burn"] or 0.0,
-            },
-            trace_id=trace_id,
-            ts=t,
-        )
-        t += 60.0
-    split = d.get("compile_split") or {}
-    return {
-        "breakdown_s": {c: round(cats[c], 2) for c in CATEGORIES},
-        "breakdown_pct": {
-            c: round(100.0 * cats[c] / d["wall_s"], 2) for c in CATEGORIES
-        },
-        "compile_split_s": {
-            "warm_s": round(float(split.get("warm_s", 0.0)), 2),
-            "cold_s": round(float(split.get("cold_s", 0.0)), 2),
-        },
-        "wall_s": round(d["wall_s"], 1),
-        "goodput_fraction": round(d["goodput_fraction"], 4),
-        "sum_error_pct": round(sum_error_pct, 6),
-        "slo": {
-            "target": alerter.goodput_target,
-            "warning_burn": alerter.warning_burn,
-            "page_burn": alerter.page_burn,
-            "progression": progression,
-            "alert_count": len(alerter.alerts),
-            "alerts": list(alerter.alerts),
-        },
-    }
+    """Account the self-heal trace through the REAL goodput ledger + SLO
+    burn-rate alerter (see :func:`tpu_engine.twin.goodput_lane`)."""
+    return twin_mod.goodput_lane(recorder, trace_id, wall, full_gang=N_CHIPS)
 
 
 def run_trace(
@@ -624,11 +205,17 @@ def main() -> None:
         "--trace-out", default=None, metavar="PATH",
         help="write the self-heal run as Chrome-trace/Perfetto JSON",
     )
+    parser.add_argument(
+        "--trace-jsonl", default=None, metavar="PATH",
+        help="persist the recorder as JSONL the twin can re-ingest",
+    )
     args = parser.parse_args()
-    recorder = FlightRecorder() if args.trace_out else None
+    recorder = None
+    if args.trace_out or args.trace_jsonl:
+        recorder = FlightRecorder(persist_path=args.trace_jsonl or None)
     trace = run_trace(args.seed, n_faults=args.faults, recorder=recorder)
     trace["hetero"] = run_hetero_lane(args.seed, recorder=recorder)
-    if recorder is not None:
+    if recorder is not None and args.trace_out:
         doc = recorder.export_chrome_trace()
         with open(args.trace_out, "w", encoding="utf-8") as f:
             json.dump(doc, f)

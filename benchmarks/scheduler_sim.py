@@ -54,7 +54,6 @@ from typing import Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from tpu_engine.compile_index import CompileCacheIndex  # noqa: E402
 from tpu_engine.goodput import GoodputLedger, set_ledger  # noqa: E402
 from tpu_engine.hbm_estimate import HBMEstimate, gang_size  # noqa: E402
 from tpu_engine.mesh_runtime import MeshConfig  # noqa: E402
@@ -66,6 +65,7 @@ from tpu_engine.scheduler import (  # noqa: E402
 from tpu_engine.sharding import TPUTrainConfig  # noqa: E402
 from tpu_engine.supervisor import JobStatus  # noqa: E402
 from tpu_engine.tpu_manager import TPUManager  # noqa: E402
+from tpu_engine.twin import warm_admission_lane  # noqa: E402
 
 # ---------------------------------------------------------------------------
 # Phase A: FakeJob trace on the mock fleet.
@@ -324,8 +324,9 @@ def run_trace(max_concurrent_jobs: int = 3) -> dict:
 
 
 # ---------------------------------------------------------------------------
-# Phase C: warm-admission virtual lane (no threads, no sleeps — a virtual
-# clock over a seeded job list, priced through a real CompileCacheIndex).
+# Phase C: warm-admission virtual lane (no threads, no sleeps — the twin's
+# single-slot queue over a seeded job list, priced through a real
+# CompileCacheIndex).
 # ---------------------------------------------------------------------------
 
 SIM_COLD_COMPILE_S = 15.0  # first compile of a layout (virtual seconds)
@@ -335,44 +336,13 @@ SIM_WARM_COMPILE_S = 1.5   # persistent-cache hit on a layout already seen
 def _admission_lane(
     jobs: list[tuple[str, float]], prefer_warm: bool
 ) -> dict:
-    """Serve ``jobs`` (layout key, work seconds) through one slot.
-
-    Every job's service time is compile + work; the compile leg consults a
-    fresh :class:`CompileCacheIndex` — cold the first time a layout is
-    seen, warm after. ``prefer_warm`` is the cache-aware admission policy:
-    among queued jobs, the first whose layout the index says is warm is
-    admitted ahead of the FIFO head (ties broken FIFO)."""
-    index = CompileCacheIndex(path=None, default_cold_s=SIM_COLD_COMPILE_S)
-    queue = list(range(len(jobs)))
-    clock = 0.0
-    waits: list[float] = []
-    cold_compiles = 0
-    while queue:
-        pick = 0
-        if prefer_warm:
-            pick = next(
-                (qi for qi, j in enumerate(queue)
-                 if index.is_warm(jobs[j][0])),
-                0,
-            )
-        j = queue.pop(pick)
-        layout, work_s = jobs[j]
-        waits.append(clock)
-        if index.is_warm(layout):
-            compile_s = SIM_WARM_COMPILE_S
-            index.record(layout, compile_s, cache_hit=True, via="sim")
-        else:
-            compile_s = SIM_COLD_COMPILE_S
-            cold_compiles += 1
-            index.record(layout, compile_s, cache_hit=False,
-                         label=layout.split("|", 1)[1], model="sim", via="sim")
-        clock += compile_s + work_s
-    return {
-        "mean_wait_s": round(sum(waits) / len(waits), 2),
-        "makespan_s": round(clock, 2),
-        "cold_compiles": cold_compiles,
-        "warm_hits": len(jobs) - cold_compiles,
-    }
+    """Cache-aware admission A/B leg — one slot, compile + work per job;
+    the lane itself lives in :func:`tpu_engine.twin.warm_admission_lane`."""
+    return warm_admission_lane(
+        jobs, prefer_warm,
+        cold_compile_s=SIM_COLD_COMPILE_S,
+        warm_compile_s=SIM_WARM_COMPILE_S,
+    )
 
 
 def run_warm_admission(seed: int = 0, n_jobs: int = 16) -> dict:

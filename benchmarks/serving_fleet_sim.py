@@ -1,5 +1,11 @@
 """Serving-fleet sim: autoscaled replicas vs a static single replica.
 
+Thin scenario definition over the digital twin (``tpu_engine/twin.py``):
+the seeded traces come from the twin's synthetic traffic generators, the
+fleet loop is the twin's open-loop tick driver, and the autoscaled lane
+is :func:`tpu_engine.twin.replay_serving_fleet` — CLI flags, exit gates
+and JSON metric lines are unchanged from the pre-twin benchmark.
+
 Deterministic discrete-event comparison (virtual clock — no threads, no
 JAX, identical numbers every run) of two fleet policies on the same
 seeded bursty open-loop request trace:
@@ -50,7 +56,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import random
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -58,31 +63,42 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from tpu_engine.serving_fleet import (  # noqa: E402
     AutoscalerConfig,
     FleetRouter,
-    ReplicaAutoscaler,
+)
+from tpu_engine.twin import (  # noqa: E402
+    ServingTwinParams,
+    SlotReplica,
+    bursty_arrivals,
+    replay_serving_fleet,
+    run_open_loop,
+    serving_metrics,
 )
 
-SIM_DURATION_S = 600.0
-DT_S = 0.05                  # sim tick
-CONTROL_PERIOD_S = 1.0       # autoscaler / router refresh cadence
-SLOTS = 8                    # decode slots per replica
-TOKENS_PER_SLOT_S = 30.0     # healthy per-slot decode rate
-DEGRADED_FRACTION = 0.4      # replica 0 runs on a slow host at this rate
-PREFILL_S = 1.2              # full prefill latency (cold prefix)
-PREFILL_HIT_S = 0.15         # prefix-cache hit: decode-only prefill remainder
-STARTUP_DELAY_S = 25.0       # admission + weight load + compile for a new replica
-CHIPS_PER_REPLICA = 1
+# The shipped scenario parameters; the twin's dataclass carries them, the
+# module-level constants remain the stable public surface tests import.
+SERVING = ServingTwinParams()
+
+SIM_DURATION_S = SERVING.duration_s
+DT_S = SERVING.dt_s               # sim tick
+CONTROL_PERIOD_S = SERVING.control_period_s  # autoscaler / router cadence
+SLOTS = SERVING.slots             # decode slots per replica
+TOKENS_PER_SLOT_S = SERVING.tokens_per_slot_s  # healthy per-slot decode rate
+DEGRADED_FRACTION = SERVING.degraded_fraction  # replica 0's slow-host rate
+PREFILL_S = SERVING.prefill_s     # full prefill latency (cold prefix)
+PREFILL_HIT_S = SERVING.prefill_hit_s  # prefix-cache hit remainder
+STARTUP_DELAY_S = SERVING.startup_delay_s  # admission + load + compile
+CHIPS_PER_REPLICA = SERVING.chips_per_replica
 BASE_RATE_RPS = 1.0          # open-loop arrivals outside bursts
 BURST_RATE_RPS = 14.0        # arrivals inside a burst window
 BURST_EVERY_S = 120.0
 BURST_LEN_S = 35.0
 N_PREFIXES = 4               # shared system prompts
-PREFIX_LEN = 32
+PREFIX_LEN = SERVING.prefix_len
 MEAN_NEW_TOKENS = 96
-P99_SLO_MS = 25_000.0
+P99_SLO_MS = SERVING.p99_slo_ms
 # Latency percentiles are steady-state: the first burst cycle is warmup
 # (it lands on the min fleet by construction — what it measures is the
 # startup delay, not the policy). Throughput counts everything.
-WARMUP_S = BURST_EVERY_S
+WARMUP_S = SERVING.warmup_s
 
 AUTOSCALER = AutoscalerConfig(
     min_replicas=1,
@@ -95,215 +111,27 @@ AUTOSCALER = AutoscalerConfig(
     scale_down_cooldown_s=90.0,
 )
 
+# Back-compat alias: the capacity replica model now lives in the twin.
+SimReplica = SlotReplica
+
 
 def request_trace(seed: int) -> list[dict]:
     """Seeded bursty open-loop arrivals: [{t, prefix_id, prompt, n_new}]."""
-    rng = random.Random(seed)
-    out, t = [], 0.0
-    while t < SIM_DURATION_S:
-        in_burst = (t % BURST_EVERY_S) < BURST_LEN_S
-        rate = BURST_RATE_RPS if in_burst else BASE_RATE_RPS
-        t += rng.expovariate(rate)
-        if t >= SIM_DURATION_S:
-            break
-        pid = rng.randrange(N_PREFIXES)
-        # Prompt = shared prefix tokens + a unique tail (router affinity
-        # keys on the first tokens; the tail keeps requests distinct).
-        prompt = [pid * PREFIX_LEN + i for i in range(PREFIX_LEN)]
-        prompt.append(10_000 + len(out))
-        out.append({
-            "t": t,
-            "prefix_id": pid,
-            "prompt": prompt,
-            "n_new": max(8, int(rng.expovariate(1.0 / MEAN_NEW_TOKENS))),
-        })
-    return out
-
-
-class SimReplica:
-    """Capacity model of one decode replica: a slot pool, a per-slot decode
-    rate, and a prefix cache that skips prefill for resident prefixes."""
-
-    def __init__(self, rid: str, rate_fraction: float, ready_at: float):
-        self.rid = rid
-        self.rate = TOKENS_PER_SLOT_S * rate_fraction
-        self.ready_at = ready_at
-        self.active: list[dict] = []      # {req, prefill_left, tokens_left}
-        self.prefix_cache: set[int] = set()
-        self.tokens_out = 0.0
-        self.draining = False
-
-    def ready(self, now: float) -> bool:
-        return now >= self.ready_at
-
-    def free_slots(self, now: float) -> int:
-        if not self.ready(now) or self.draining:
-            return 0
-        return SLOTS - len(self.active)
-
-    def admit(self, req: dict) -> None:
-        hit = req["prefix_id"] in self.prefix_cache
-        self.prefix_cache.add(req["prefix_id"])
-        self.active.append({
-            "req": req,
-            "prefill_left": PREFILL_HIT_S if hit else PREFILL_S,
-            "tokens_left": float(req["n_new"]),
-            "hit": hit,
-        })
-
-    def step(self, now: float, dt: float, done: list[dict]) -> None:
-        if not self.ready(now):
-            return
-        for sl in list(self.active):
-            if sl["prefill_left"] > 0:
-                sl["prefill_left"] -= dt
-                continue
-            produced = min(self.rate * dt, sl["tokens_left"])
-            sl["tokens_left"] -= produced
-            self.tokens_out += produced
-            if sl["tokens_left"] <= 0:
-                sl["req"]["done_at"] = now
-                sl["req"]["replica"] = self.rid
-                sl["req"]["prefix_hit"] = sl["hit"]
-                done.append(sl["req"])
-                self.active.remove(sl)
-
-    def router_stats(self, now: float) -> dict:
-        # tokens/sec the router would measure: rate × busy slots (plus a
-        # trickle when idle so a fresh replica is not weight-zero).
-        busy = sum(1 for s in self.active if s["prefill_left"] <= 0)
-        return {
-            "tokens_per_sec": self.rate * max(busy, 0.2),
-            "free_slots": self.free_slots(now),
-            "slots": SLOTS,
-        }
-
-
-def _percentile(vals: list[float], q: float) -> float:
-    if not vals:
-        return 0.0
-    vals = sorted(vals)
-    return vals[min(int(q * (len(vals) - 1)), len(vals) - 1)]
+    return bursty_arrivals(
+        seed,
+        duration_s=SIM_DURATION_S,
+        base_rps=BASE_RATE_RPS,
+        burst_rps=BURST_RATE_RPS,
+        burst_every_s=BURST_EVERY_S,
+        burst_len_s=BURST_LEN_S,
+        n_prefixes=N_PREFIXES,
+        prefix_len=PREFIX_LEN,
+        mean_new_tokens=MEAN_NEW_TOKENS,
+    )
 
 
 def _simulate(trace: list[dict], autoscale: bool) -> dict:
-    router = FleetRouter(affinity_tokens=PREFIX_LEN)
-    scaler = ReplicaAutoscaler(AUTOSCALER)
-    replicas: dict[str, SimReplica] = {
-        # Replica 0 is the degraded host — present from t=0 in both modes;
-        # in static mode it is the whole fleet.
-        "r0": SimReplica("r0", DEGRADED_FRACTION, ready_at=0.0)
-    }
-    next_rid = 1
-    queue: list[dict] = []
-    done: list[dict] = []
-    idx = 0
-    next_control = 0.0
-    replica_trace: list[tuple[float, int]] = []
-    chip_seconds = 0.0
-    t = 0.0
-    while t < SIM_DURATION_S or queue or any(r.active for r in replicas.values()):
-        if t > SIM_DURATION_S * 3:  # safety: a sim bug must not spin forever
-            break
-        while idx < len(trace) and trace[idx]["t"] <= t:
-            queue.append(trace[idx])
-            idx += 1
-
-        if t >= next_control:
-            next_control = t + CONTROL_PERIOD_S
-            up = {
-                r.rid: r.router_stats(t)
-                for r in replicas.values()
-                if r.ready(t) and not r.draining
-            }
-            router.update(up)
-            ready_n = len(up)
-            # Change-point trace: one entry per replica-count transition
-            # keeps the bench JSON line readable.
-            if not replica_trace or replica_trace[-1][1] != ready_n:
-                replica_trace.append((round(t, 1), ready_n))
-            if autoscale and ready_n > 0:
-                lat = [
-                    (r["done_at"] - r["t"]) * 1000.0
-                    for r in done[-256:]
-                ]
-                desired = scaler.observe(
-                    t, len(queue), _percentile(lat, 0.99) if lat else None, ready_n
-                )
-                booting = sum(
-                    1 for r in replicas.values()
-                    if not r.ready(t) and not r.draining
-                )
-                while desired > ready_n + booting:
-                    replicas[f"r{next_rid}"] = SimReplica(
-                        f"r{next_rid}", 1.0, ready_at=t + STARTUP_DELAY_S
-                    )
-                    next_rid += 1
-                    booting += 1
-                if desired < ready_n:
-                    # Drain the emptiest ready replica (never the last one).
-                    cands = sorted(
-                        (r for r in replicas.values()
-                         if r.ready(t) and not r.draining and r.rid != "r0"),
-                        key=lambda r: len(r.active),
-                    )
-                    for r in cands[: ready_n - desired]:
-                        r.draining = True
-
-        # Dispatch through the real router (affinity keys on the prefix).
-        # Route only while the fleet has a free slot — an overloaded fleet
-        # must queue, not spin the router on unplaceable requests.
-        free_total = sum(r.free_slots(t) for r in replicas.values())
-        placed = 0
-        while queue and free_total > 0:
-            req = queue[0]
-            rid = router.route(req["prompt"])
-            rep = replicas.get(rid) if rid else None
-            if rep is not None and rep.free_slots(t) > 0:
-                rep.admit(queue.pop(0))
-                free_total -= 1
-                placed += 1
-            else:
-                # Router picked a full/draining replica: stop this tick,
-                # weights refresh at the next control period.
-                break
-            if placed > SLOTS * len(replicas):
-                break
-
-        for r in list(replicas.values()):
-            r.step(t, DT_S, done)
-            if r.draining and not r.active:
-                del replicas[r.rid]
-        chip_seconds += DT_S * CHIPS_PER_REPLICA * sum(
-            1 for r in replicas.values() if r.ready(t)
-        )
-        t += DT_S
-
-    lat_ms = [
-        (r["done_at"] - r["t"]) * 1000.0 for r in done if r["t"] >= WARMUP_S
-    ]
-    # Count tokens from completed requests, not replica counters — drained
-    # replicas leave the dict and would take their counters with them.
-    total_tokens = float(sum(req["n_new"] for req in done))
-    makespan = max((r["done_at"] for r in done), default=DT_S)
-    p99 = _percentile(lat_ms, 0.99)
-    return {
-        "completed": len(done),
-        "total_tokens": total_tokens,
-        "tokens_per_sec": total_tokens / makespan,
-        "tokens_per_sec_per_chip": total_tokens / max(chip_seconds, DT_S),
-        "p50_ms": round(_percentile(lat_ms, 0.50), 1),
-        "p99_ms": round(p99, 1),
-        "p99_within_slo": p99 <= P99_SLO_MS,
-        "makespan_s": round(makespan, 1),
-        "replica_trace": replica_trace,
-        "max_replicas_used": max(n for _, n in replica_trace),
-        "prefix_hit_rate": round(
-            sum(1 for r in done if r.get("prefix_hit")) / max(len(done), 1), 3
-        ),
-        "router": router.stats(),
-        "autoscaler": scaler.stats(),
-    }
+    return replay_serving_fleet(trace, autoscale, AUTOSCALER, SERVING)
 
 
 def run_trace(seed: int = 0) -> dict:
@@ -354,25 +182,20 @@ def long_prefill_trace(seed: int) -> list[dict]:
     """Seeded bursty arrivals with heavy, variable prefill cost:
     [{t, prompt, prefill_units, n_new}] — ``prefill_units`` is seconds of
     prefill work at tp=1."""
-    rng = random.Random(seed + 7919)
-    out, t = [], 0.0
-    while t < SIM_DURATION_S:
-        in_burst = (t % BURST_EVERY_S) < BURST_LEN_S
-        t += rng.expovariate(LONG_BURST_RPS if in_burst else LONG_BASE_RPS)
-        if t >= SIM_DURATION_S:
-            break
-        pid = rng.randrange(N_PREFIXES)
-        prompt = [pid * PREFIX_LEN + i for i in range(PREFIX_LEN)]
-        prompt.append(10_000 + len(out))
-        out.append({
-            "t": t,
-            "prompt": prompt,
-            "prefill_units": max(
-                LONG_PREFILL_MIN_S, rng.expovariate(1.0 / LONG_PREFILL_MEAN_S)
-            ),
-            "n_new": max(8, int(rng.expovariate(1.0 / LONG_MEAN_NEW))),
-        })
-    return out
+    return bursty_arrivals(
+        seed,
+        duration_s=SIM_DURATION_S,
+        base_rps=LONG_BASE_RPS,
+        burst_rps=LONG_BURST_RPS,
+        burst_every_s=BURST_EVERY_S,
+        burst_len_s=BURST_LEN_S,
+        n_prefixes=N_PREFIXES,
+        prefix_len=PREFIX_LEN,
+        mean_new_tokens=LONG_MEAN_NEW,
+        prefill_mean_s=LONG_PREFILL_MEAN_S,
+        prefill_min_s=LONG_PREFILL_MIN_S,
+        seed_offset=7919,
+    )
 
 
 class SymReplica:
@@ -429,16 +252,11 @@ def _simulate_symmetric_long(trace: list[dict]) -> dict:
     queue: list[dict] = []
     done: list[dict] = []
     ttfts: list[float] = []
-    idx, t, next_control = 0, 0.0, 0.0
-    while t < SIM_DURATION_S or queue or any(r.active for r in replicas):
-        if t > SIM_DURATION_S * 6:
-            break
-        while idx < len(trace) and trace[idx]["t"] <= t:
-            queue.append(trace[idx])
-            idx += 1
-        if t >= next_control:
-            next_control = t + CONTROL_PERIOD_S
-            router.update({r.rid: r.router_stats() for r in replicas})
+
+    def control(t: float) -> None:
+        router.update({r.rid: r.router_stats() for r in replicas})
+
+    def tick(t: float) -> None:
         while queue and any(r.free_slots() > 0 for r in replicas):
             rid = router.route(queue[0]["prompt"])
             rep = by_id.get(rid) if rid else None
@@ -447,8 +265,14 @@ def _simulate_symmetric_long(trace: list[dict]) -> dict:
             rep.admit(queue.pop(0), t)
         for r in replicas:
             r.step(t, DT_S, done, ttfts)
-        t += DT_S
-    return _ab_metrics(done, ttfts, t)
+
+    run_open_loop(
+        trace, dt=DT_S, duration_s=SIM_DURATION_S,
+        pending=lambda: queue or any(r.active for r in replicas),
+        arrive=queue.append, tick=tick, control=control,
+        control_period_s=CONTROL_PERIOD_S, safety_factor=6.0,
+    )
+    return _ab_metrics(done, ttfts)
 
 
 def _simulate_disagg(trace: list[dict], prefill_plan, decode_plan,
@@ -471,29 +295,23 @@ def _simulate_disagg(trace: list[dict], prefill_plan, decode_plan,
     handoff: list[dict] = []        # KV on the wire / awaiting a decode slot
     done: list[dict] = []
     ttfts: list[float] = []
-    idx, t, next_control = 0, 0.0, 0.0
-    while (t < SIM_DURATION_S or queue or handoff
-           or any(p["job"] for p in pre) or any(d["active"] for d in dec)):
-        if t > SIM_DURATION_S * 6:
-            break
-        while idx < len(trace) and trace[idx]["t"] <= t:
-            queue.append(trace[idx])
-            idx += 1
-        if t >= next_control:
-            next_control = t + CONTROL_PERIOD_S
-            prefill_router.update({
-                p["rid"]: {
-                    "tokens_per_sec": prefill_speedup * TOKENS_PER_SLOT_S,
-                    "free_slots": 0 if p["job"] else 1, "slots": 1,
-                } for p in pre
-            })
-            decode_router.update({
-                d["rid"]: {
-                    "tokens_per_sec": dec_rate * max(len(d["active"]), 0.2),
-                    "free_slots": decode_plan.max_slots - len(d["active"]),
-                    "slots": decode_plan.max_slots,
-                } for d in dec
-            })
+
+    def control(t: float) -> None:
+        prefill_router.update({
+            p["rid"]: {
+                "tokens_per_sec": prefill_speedup * TOKENS_PER_SLOT_S,
+                "free_slots": 0 if p["job"] else 1, "slots": 1,
+            } for p in pre
+        })
+        decode_router.update({
+            d["rid"]: {
+                "tokens_per_sec": dec_rate * max(len(d["active"]), 0.2),
+                "free_slots": decode_plan.max_slots - len(d["active"]),
+                "slots": decode_plan.max_slots,
+            } for d in dec
+        })
+
+    def tick(t: float) -> None:
         # Route waiting prompts onto idle prefill servers.
         while queue and any(p["job"] is None for p in pre):
             rid = prefill_router.route(queue[0]["prompt"])
@@ -535,31 +353,22 @@ def _simulate_disagg(trace: list[dict], prefill_plan, decode_plan,
                     sl["req"]["done_at"] = t + DT_S
                     done.append(sl["req"])
                     d["active"].remove(sl)
-        t += DT_S
-    return _ab_metrics(done, ttfts, t)
+
+    run_open_loop(
+        trace, dt=DT_S, duration_s=SIM_DURATION_S,
+        pending=lambda: (queue or handoff or any(p["job"] for p in pre)
+                         or any(d["active"] for d in dec)),
+        arrive=queue.append, tick=tick, control=control,
+        control_period_s=CONTROL_PERIOD_S, safety_factor=6.0,
+    )
+    return _ab_metrics(done, ttfts)
 
 
-def _ab_metrics(done: list[dict], ttfts: list[float], t_end: float) -> dict:
-    lat_ms = [(r["done_at"] - r["t"]) * 1000.0 for r in done
-              if r["t"] >= WARMUP_S]
-    steady_ttfts = [
-        (r["first_token_at"] - r["t"]) * 1000.0 for r in done
-        if r["t"] >= WARMUP_S and "first_token_at" in r
-    ]
-    total_tokens = float(sum(r["n_new"] for r in done))
-    makespan = max((r["done_at"] for r in done), default=DT_S)
-    return {
-        "completed": len(done),
-        "total_tokens": total_tokens,
-        "tokens_per_sec": round(total_tokens / makespan, 2),
-        "tokens_per_sec_per_chip": round(
-            total_tokens / (makespan * TOTAL_CHIPS), 2),
-        "ttft_p50_ms": round(_percentile(steady_ttfts, 0.50), 1),
-        "ttft_p99_ms": round(_percentile(steady_ttfts, 0.99), 1),
-        "p50_ms": round(_percentile(lat_ms, 0.50), 1),
-        "p99_ms": round(_percentile(lat_ms, 0.99), 1),
-        "makespan_s": round(makespan, 1),
-    }
+def _ab_metrics(done: list[dict], ttfts: list[float],
+                t_end: float = 0.0) -> dict:
+    return serving_metrics(
+        done, ttfts, warmup_s=WARMUP_S, total_chips=TOTAL_CHIPS, dt_s=DT_S
+    )
 
 
 def run_disagg_ab(seed: int = 0) -> dict:

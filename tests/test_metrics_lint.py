@@ -199,3 +199,29 @@ def test_trace_families_always_present(client):
         "tpu_engine_trace_traces_total",
     ):
         assert re.search(rf"^{family} ", text, re.M), family
+
+
+def test_twin_families_always_present(client):
+    """The digital-twin plane exports even before any replay ran — an
+    alerting rule on ingest skips must never go 'no data', and every
+    skip reason is a labelled series from the first scrape."""
+    text = _scrape(client)
+    for family in (
+        "tpu_engine_twin_replays_total",
+        "tpu_engine_twin_ab_runs_total",
+        "tpu_engine_twin_ingest_files_total",
+        "tpu_engine_twin_ingest_lines_total",
+        "tpu_engine_twin_replayed_spans_total",
+        "tpu_engine_twin_replayed_events_total",
+        "tpu_engine_twin_fleet_seconds_total",
+        "tpu_engine_twin_cpu_seconds_total",
+        "tpu_engine_twin_replay_speedup",
+    ):
+        assert re.search(rf"^{family}[ {{]", text, re.M), family
+    from tpu_engine.twin import SKIP_REASONS
+
+    for reason in SKIP_REASONS:
+        assert re.search(
+            rf'^tpu_engine_twin_ingest_skipped_lines_total\{{reason="{reason}"\}} ',
+            text, re.M,
+        ), reason

@@ -60,6 +60,7 @@ NOISY_KEYS = {
     "speedup_vs_serial",
     "goodput_work_s_per_wall_s",
     "loss_delta_final",
+    "fleet_seconds_per_cpu_second",
 }
 
 
@@ -87,6 +88,7 @@ def collect_quick() -> list[dict]:
     from benchmarks.scheduler_sim import run_warm_admission
     from benchmarks.serving_fleet_sim import run_disagg_ab
     from tpu_engine.parallel.pipeline_zb import schedule_account
+    from tpu_engine.twin import twin_bench_line
 
     trace = chaos_trace(seed=0)
     ab = run_disagg_ab(seed=0)
@@ -156,6 +158,7 @@ def collect_quick() -> list[dict]:
             "disagg_tokens_per_sec": ab["disagg"]["tokens_per_sec"],
             "gates_pass": ab["gates_pass"],
         },
+        twin_bench_line(seed=0),
     ]
 
 

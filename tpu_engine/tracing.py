@@ -43,12 +43,19 @@ from statistics import median
 from typing import Any, Callable, Dict, List, Optional, Union
 
 __all__ = [
+    "SCHEMA_VERSION",
     "Span",
     "FlightRecorder",
     "StepTimeAnomalyDetector",
     "get_recorder",
     "set_recorder",
 ]
+
+# Version stamped onto every persisted JSONL line. Bump on any change to
+# the persisted record shape; the twin's ingester (``tpu_engine/twin.py``)
+# accepts lines at or below its own version and skips newer ones, so old
+# traces stay replayable across recorder changes.
+SCHEMA_VERSION = 1
 
 # Attribution causes, highest priority first: a host-slow fault explains
 # a slow step better than a checkpoint save that also overlapped it.
@@ -95,7 +102,7 @@ class Span:
         attrs: Optional[Dict[str, Any]] = None,
     ):
         self._recorder = recorder
-        self.span_id = _new_id()
+        self.span_id = recorder._make_id()
         self.trace_id = trace_id
         self.parent_id = parent_id
         self.name = name
@@ -162,9 +169,13 @@ class FlightRecorder:
         clock: Callable[[], float] = time.time,
         persist_path: Optional[str] = None,
         persist_max_bytes: int = 16 * 1024 * 1024,
+        id_factory: Optional[Callable[[], str]] = None,
     ):
         self._lock = threading.RLock()
         self.clock = clock
+        # Injectable so the digital twin can replay with deterministic,
+        # byte-stable span/event ids (uuid4 otherwise).
+        self._id_factory = id_factory
         self.max_spans = int(max_spans)
         self.max_events = int(max_events)
         self._closed: deque = deque()  # Span dicts, oldest first
@@ -188,10 +199,13 @@ class FlightRecorder:
 
     # -- ids / traces --------------------------------------------------------
 
+    def _make_id(self) -> str:
+        return self._id_factory() if self._id_factory is not None else _new_id()
+
     def new_trace_id(self) -> str:
         with self._lock:
             self.traces_total += 1
-        return _new_id()
+        return self._make_id()
 
     def trace_root(self, trace_id: Optional[str]) -> Optional[str]:
         """span_id of the first span recorded on ``trace_id`` (the causal
@@ -253,7 +267,7 @@ class FlightRecorder:
         parent_id = parent.span_id if isinstance(parent, Span) else parent
         ts = self.clock() if ts is None else float(ts)
         ev = {
-            "event_id": _new_id(),
+            "event_id": self._make_id(),
             "trace_id": trace_id,
             "parent_id": parent_id,
             "name": name,
@@ -326,6 +340,7 @@ class FlightRecorder:
         if not self.persist_path:
             return
         try:
+            record = dict(record, schema_version=SCHEMA_VERSION)
             line = json.dumps(record, default=str) + "\n"
             with self._lock:
                 if self.persist_bytes + len(line) > self.persist_max_bytes:

@@ -1,0 +1,1711 @@
+"""Trace-replay digital twin: one virtual-clock fleet engine.
+
+Every fleet policy in this repo used to be evaluated on one of three
+bespoke virtual-clock harnesses (``benchmarks/scheduler_sim.py``,
+``benchmarks/serving_fleet_sim.py``, ``benchmarks/chaos.py``) that could
+not ingest what the flight recorder actually captured. This module is the
+shared engine those sims are now thin scenario definitions over, plus the
+piece none of them had: replaying a *recorded* run.
+
+Three layers:
+
+- **Trace ingestion** (:func:`read_recorder_jsonl`,
+  :class:`ReplayWorkload`): parse flight-recorder JSONL (spans, events,
+  explicit timestamps, parent links) into a replayable workload — job
+  submissions with their observed priorities/durations, serving request
+  arrivals, fault timelines — tolerating rotated files, a torn partial
+  last line, and unknown ``schema_version`` lines (skipped and counted,
+  never raised mid-replay). Composable synthetic generators
+  (:func:`bursty_arrivals`, :func:`diurnal_arrivals`,
+  :func:`heavy_tail_prefill_arrivals`) cover scenarios never yet
+  observed; the bursty generator reproduces the legacy sims' seeded
+  traces draw-for-draw.
+
+- **Replay core** (:class:`TwinEngine` + the scenario lanes): drives the
+  real control-plane components through their existing
+  explicit-timestamp APIs under one :class:`VirtualClock` —
+  ``HeteroRebalancer``, ``ReplicaAutoscaler``/``FleetRouter``,
+  ``CompileCacheIndex``, ``GoodputLedger``/``SLOBurnRateAlerter`` — and
+  records the replayed run back onto a fresh :class:`FlightRecorder`
+  with deterministic span ids, so every twin run is itself
+  Perfetto-exportable and byte-for-byte diffable against the source
+  trace (or a previous replay).
+
+- **A/B scorecard** (:func:`ab_scorecard`,
+  :func:`default_policy_scorecard`): N policy variants over the same
+  ingested trace, one JSON artifact with per-variant goodput
+  decomposition, queue-wait, MTTR and SLO-burn deltas against the first
+  (baseline) variant.
+
+Health counters for the ``tpu_engine_twin_*`` Prometheus families live
+in module state (:func:`twin_stats`); ``POST /api/v1/twin/replay`` is
+the dry-run HTTP entry (``backend/routers/twin.py``); ``bench.py`` and
+``tools/bench_sentinel.py`` share :func:`twin_bench_line`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import random
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from tpu_engine import hetero as hetero_mod
+from tpu_engine.compile_index import CompileCacheIndex
+from tpu_engine.faults import FaultInjector, FaultKind, FaultPlan, FaultSpec
+from tpu_engine.goodput import CATEGORIES, GoodputLedger, SLOBurnRateAlerter
+from tpu_engine.tracing import SCHEMA_VERSION, FlightRecorder
+
+__all__ = [
+    "VirtualClock",
+    "deterministic_ids",
+    "read_recorder_jsonl",
+    "ReplayWorkload",
+    "TwinEngine",
+    "decomposition_diff",
+    "bursty_arrivals",
+    "diurnal_arrivals",
+    "heavy_tail_prefill_arrivals",
+    "TrainTwinParams",
+    "HeteroTwinParams",
+    "ServingTwinParams",
+    "chip_fault_timeline",
+    "replay_self_heal",
+    "replay_die_and_restart",
+    "goodput_lane",
+    "host_slow_plan",
+    "replay_hetero",
+    "run_hetero_ab",
+    "SlotReplica",
+    "run_open_loop",
+    "replay_serving_fleet",
+    "serving_metrics",
+    "percentile",
+    "warm_admission_lane",
+    "ab_scorecard",
+    "default_policy_scorecard",
+    "admission_policy_scorecard",
+    "replay_fidelity",
+    "twin_bench_line",
+    "twin_stats",
+]
+
+
+# -- virtual clock / deterministic ids ----------------------------------------
+
+
+class VirtualClock:
+    """A callable simulated clock: pass as any component's ``clock=``."""
+
+    __slots__ = ("t",)
+
+    def __init__(self, t: float = 0.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def now(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += float(dt)
+        return self.t
+
+    def set(self, t: float) -> float:
+        self.t = float(t)
+        return self.t
+
+
+def deterministic_ids(prefix: str = "twin") -> Callable[[], str]:
+    """A counter-based id factory for :class:`FlightRecorder` — replays
+    get byte-stable span/event ids instead of uuid4."""
+    n = 0
+
+    def _next() -> str:
+        nonlocal n
+        n += 1
+        return f"{prefix}-{n:08d}"
+
+    return _next
+
+
+# -- module health counters (tpu_engine_twin_* Prometheus families) -----------
+
+SKIP_REASONS = ("torn_tail", "parse_error", "unknown_schema", "unknown_record")
+
+_STATS_LOCK = threading.Lock()
+_STATS: Dict[str, Any] = {
+    "replays_total": 0,
+    "ab_runs_total": 0,
+    "ingest_files_total": 0,
+    "ingest_lines_total": 0,
+    "ingest_skipped_lines_total": 0,
+    "ingest_skipped_by_reason": {r: 0 for r in SKIP_REASONS},
+    "replayed_spans_total": 0,
+    "replayed_events_total": 0,
+    "fleet_seconds_total": 0.0,
+    "cpu_seconds_total": 0.0,
+    "last_fleet_seconds_per_cpu_second": 0.0,
+}
+
+
+def twin_stats() -> Dict[str, Any]:
+    """Snapshot of the twin's monotonic health counters."""
+    with _STATS_LOCK:
+        out = dict(_STATS)
+        out["ingest_skipped_by_reason"] = dict(_STATS["ingest_skipped_by_reason"])
+    return out
+
+
+def _reset_stats_for_tests() -> None:
+    with _STATS_LOCK:
+        for k, v in list(_STATS.items()):
+            if isinstance(v, dict):
+                _STATS[k] = {r: 0 for r in SKIP_REASONS}
+            else:
+                _STATS[k] = 0 if isinstance(v, int) else 0.0
+
+
+def _bump(**deltas: float) -> None:
+    with _STATS_LOCK:
+        for k, v in deltas.items():
+            _STATS[k] += v
+
+
+# -- trace ingestion ----------------------------------------------------------
+
+
+def read_recorder_jsonl(path: str) -> Tuple[List[dict], Dict[str, Any]]:
+    """Read flight-recorder JSONL at ``path`` (plus its rotated ``.1``
+    generation, oldest first) into record dicts.
+
+    Hardened for mid-write capture: an undecodable *final* line of the
+    live file is a torn tail (the recorder was mid-append), any other bad
+    line is a parse error, a ``schema_version`` above this build's
+    :data:`SCHEMA_VERSION` is an unknown future format — all are skipped
+    and counted (``twin_ingest_skipped_lines_total``), never raised."""
+    files = [p for p in (path + ".1", path) if os.path.exists(p)]
+    records: List[dict] = []
+    stats: Dict[str, Any] = {
+        "files": len(files),
+        "lines": 0,
+        "accepted": 0,
+        "skipped": 0,
+        "skipped_by_reason": {},
+        "legacy_lines": 0,
+        "schema_version": SCHEMA_VERSION,
+    }
+
+    def _skip(reason: str) -> None:
+        stats["skipped"] += 1
+        by = stats["skipped_by_reason"]
+        by[reason] = by.get(reason, 0) + 1
+
+    for fi, fp in enumerate(files):
+        with open(fp, encoding="utf-8", errors="replace") as f:
+            lines = f.read().split("\n")
+        if lines and lines[-1] == "":
+            lines.pop()
+        for li, line in enumerate(lines):
+            if not line.strip():
+                continue
+            stats["lines"] += 1
+            # Only the live file's final line can be a torn partial write;
+            # rotation happens on line boundaries.
+            torn_candidate = fi == len(files) - 1 and li == len(lines) - 1
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                _skip("torn_tail" if torn_candidate else "parse_error")
+                continue
+            if not isinstance(rec, dict):
+                _skip("parse_error")
+                continue
+            sv = rec.get("schema_version")
+            if sv is None:
+                stats["legacy_lines"] += 1  # pre-versioning trace: accepted
+            elif not isinstance(sv, int) or sv < 1 or sv > SCHEMA_VERSION:
+                _skip("unknown_schema")
+                continue
+            if rec.get("record") not in ("span", "event"):
+                _skip("unknown_record")
+                continue
+            records.append(rec)
+            stats["accepted"] += 1
+
+    with _STATS_LOCK:
+        _STATS["ingest_files_total"] += stats["files"]
+        _STATS["ingest_lines_total"] += stats["lines"]
+        _STATS["ingest_skipped_lines_total"] += stats["skipped"]
+        for r, n in stats["skipped_by_reason"].items():
+            by = _STATS["ingest_skipped_by_reason"]
+            by[r] = by.get(r, 0) + n
+    return records, stats
+
+
+class ReplayWorkload:
+    """Ingested recorder records plus the reconstructed fleet views:
+    job submissions (kind ``job`` roots + their ``submit`` events),
+    serving request arrivals (kind ``request``), and the fault timeline
+    (kind ``fault`` spans/events)."""
+
+    def __init__(self, records: List[dict], ingest_stats: Optional[dict] = None):
+        self.records = list(records)
+        self.ingest = dict(ingest_stats or {})
+        self.spans = [r for r in self.records if r.get("record") == "span"]
+        self.events = [r for r in self.records if r.get("record") == "event"]
+        submit_by_trace: Dict[Any, dict] = {}
+        self.faults: List[dict] = []
+        self.requests: List[dict] = []
+        self.jobs: List[dict] = []
+        for e in self.events:
+            if e.get("name") == "submit" and e.get("kind") == "scheduler":
+                submit_by_trace.setdefault(e.get("trace_id"), e)
+            elif e.get("kind") == "fault":
+                self.faults.append({
+                    "t": float(e.get("ts") or 0.0),
+                    "name": e.get("name"),
+                    "trace_id": e.get("trace_id"),
+                    "attrs": dict(e.get("attrs") or {}),
+                })
+        for s in self.spans:
+            kind = s.get("kind")
+            attrs = dict(s.get("attrs") or {})
+            if kind == "job":
+                sub = submit_by_trace.get(s.get("trace_id"))
+                sub_attrs = dict((sub or {}).get("attrs") or {})
+                self.jobs.append({
+                    "trace_id": s.get("trace_id"),
+                    "name": s.get("name"),
+                    "t0": float(s.get("t0") or 0.0),
+                    "t1": s.get("t1"),
+                    "duration_s": s.get("duration_s"),
+                    "priority": attrs.get("priority") or sub_attrs.get("priority"),
+                    "workload": attrs.get("workload") or sub_attrs.get("workload"),
+                    "gang": attrs.get("n_chips") or attrs.get("gang")
+                    or attrs.get("full_gang"),
+                    "attrs": attrs,
+                })
+            elif kind == "fault":
+                self.faults.append({
+                    "t": float(s.get("t0") or 0.0),
+                    "name": s.get("name"),
+                    "trace_id": s.get("trace_id"),
+                    "attrs": attrs,
+                })
+            elif kind == "request":
+                self.requests.append({
+                    "t": float(s.get("t0") or 0.0),
+                    "name": s.get("name"),
+                    "trace_id": s.get("trace_id"),
+                    "duration_s": s.get("duration_s"),
+                    "attrs": attrs,
+                })
+        self.faults.sort(key=lambda f: f["t"])
+        self.requests.sort(key=lambda r: r["t"])
+        self.jobs.sort(key=lambda j: (j["t0"], str(j["name"])))
+
+    @classmethod
+    def from_jsonl(cls, path: str) -> "ReplayWorkload":
+        records, stats = read_recorder_jsonl(path)
+        return cls(records, stats)
+
+    @property
+    def t_range(self) -> Tuple[float, float]:
+        lo, hi = math.inf, -math.inf
+        for s in self.spans:
+            t0 = float(s.get("t0") or 0.0)
+            t1 = float(s.get("t1") if s.get("t1") is not None else t0)
+            lo, hi = min(lo, t0), max(hi, t1)
+        for e in self.events:
+            ts = float(e.get("ts") or 0.0)
+            lo, hi = min(lo, ts), max(hi, ts)
+        if lo is math.inf:
+            return 0.0, 0.0
+        return lo, hi
+
+
+# -- replay core --------------------------------------------------------------
+
+
+class TwinEngine:
+    """Replays a :class:`ReplayWorkload` onto a fresh deterministic-id
+    :class:`FlightRecorder` under one :class:`VirtualClock`, then accounts
+    every job trace through the real :class:`GoodputLedger`.
+
+    The replayed recorder (``self.recorder``) carries the same spans,
+    events, timestamps and parent links as the source run, so it exports
+    the same Perfetto document and decomposes to the same goodput
+    categories — the diffability contract the determinism tests gate."""
+
+    def __init__(
+        self,
+        max_spans: int = 65536,
+        max_events: int = 65536,
+        id_prefix: str = "twin",
+    ):
+        self.max_spans = int(max_spans)
+        self.max_events = int(max_events)
+        self.id_prefix = id_prefix
+        self.clock = VirtualClock(0.0)
+        self.recorder: Optional[FlightRecorder] = None
+
+    def replay(
+        self,
+        workload: ReplayWorkload,
+        bucket_s: float = 60.0,
+        history_buckets: int = 256,
+    ) -> Dict[str, Any]:
+        t_cpu0 = time.perf_counter()
+        self.clock = VirtualClock(0.0)
+        # Stream-order ids: record i gets "<prefix>-<i+1>". Every replayed
+        # record consumes exactly one factory call (span records always
+        # pass an explicit trace_id below, so new_trace_id never fires),
+        # which lets parent links be remapped without a dry run.
+        n = len(workload.records)
+        new_ids = {
+            r["span_id"]: f"{self.id_prefix}-{i + 1:08d}"
+            for i, r in enumerate(workload.records)
+            if r.get("record") == "span" and r.get("span_id")
+        }
+        counter = {"n": 0}
+
+        def _factory() -> str:
+            counter["n"] += 1
+            return f"{self.id_prefix}-{counter['n']:08d}"
+
+        rec = FlightRecorder(
+            max_spans=self.max_spans,
+            max_events=self.max_events,
+            clock=self.clock,
+            id_factory=_factory,
+        )
+        self.recorder = rec
+        spans_n = events_n = 0
+        for r in workload.records:
+            parent = r.get("parent_id")
+            parent = new_ids.get(parent, parent)
+            attrs = dict(r.get("attrs") or {})
+            if r.get("record") == "span":
+                t0 = float(r.get("t0") or 0.0)
+                t1 = r.get("t1")
+                t1 = t0 if t1 is None else float(t1)
+                self.clock.t = max(self.clock.t, t1)
+                rec.record_span(
+                    str(r.get("name") or "span"),
+                    kind=str(r.get("kind") or "span"),
+                    trace_id=r.get("trace_id") or f"{self.id_prefix}-orphan",
+                    parent=parent,
+                    t0=t0,
+                    t1=t1,
+                    attrs=attrs,
+                )
+                spans_n += 1
+            else:
+                ts = float(r.get("ts") or 0.0)
+                self.clock.t = max(self.clock.t, ts)
+                rec.event(
+                    str(r.get("name") or "event"),
+                    kind=str(r.get("kind") or "event"),
+                    trace_id=r.get("trace_id"),
+                    parent=parent,
+                    ts=ts,
+                    attrs=attrs,
+                )
+                events_n += 1
+
+        # Account every job trace through the REAL ledger — the same
+        # decomposition live submissions get.
+        ledger = GoodputLedger(
+            clock=self.clock, bucket_s=bucket_s, history_buckets=history_buckets
+        )
+        traces: Dict[str, Any] = {}
+        for job in workload.jobs:
+            tid = job["trace_id"]
+            if tid is None or tid in traces:
+                continue
+            gang = job.get("gang")
+            ledger.track(
+                tid,
+                tenant=str(job["attrs"].get("submitter") or "twin"),
+                workload=str(job.get("workload") or "training"),
+                full_gang=int(gang) if gang else None,
+            )
+            now = job["t1"] if job["t1"] is not None else self.clock.t
+            d = ledger.finalize(rec, tid, now=float(now))
+            if d is None:
+                continue
+            traces[tid] = {
+                "root": job["name"],
+                "wall_s": d["wall_s"],
+                "goodput_fraction": d["goodput_fraction"],
+                "categories": dict(d["categories"]),
+                "compile_split": dict(d.get("compile_split") or {}),
+            }
+        cpu_s = max(time.perf_counter() - t_cpu0, 1e-9)
+        t_lo, t_hi = workload.t_range
+        fleet_s = max(0.0, t_hi - t_lo)
+        speedup = fleet_s / cpu_s
+        _bump(
+            replays_total=1,
+            replayed_spans_total=spans_n,
+            replayed_events_total=events_n,
+            fleet_seconds_total=fleet_s,
+            cpu_seconds_total=cpu_s,
+        )
+        with _STATS_LOCK:
+            _STATS["last_fleet_seconds_per_cpu_second"] = round(speedup, 1)
+        return {
+            "spans_replayed": spans_n,
+            "events_replayed": events_n,
+            "records": n,
+            "traces": traces,
+            "ingest": dict(workload.ingest),
+            "fleet_seconds": round(fleet_s, 3),
+            "cpu_seconds": round(cpu_s, 6),
+            "fleet_seconds_per_cpu_second": round(speedup, 1),
+        }
+
+
+def decomposition_diff(
+    source: Dict[str, float], replayed: Dict[str, float], wall_s: float
+) -> Dict[str, Any]:
+    """Per-category |source − replay| as % of the wall clock (the
+    fidelity acceptance metric: every category within 1%)."""
+    per = {
+        c: round(
+            abs(float(source.get(c, 0.0)) - float(replayed.get(c, 0.0)))
+            / max(wall_s, 1e-9)
+            * 100.0,
+            4,
+        )
+        for c in CATEGORIES
+    }
+    return {
+        "per_category_pct": per,
+        "max_error_pct": max(per.values()) if per else 0.0,
+    }
+
+
+# -- synthetic traffic generators ---------------------------------------------
+
+
+def _open_loop_arrivals(
+    rng: random.Random,
+    rate_fn: Callable[[float], float],
+    duration_s: float,
+    n_prefixes: int,
+    prefix_len: int,
+    mean_new_tokens: float,
+    min_new_tokens: int,
+    prefill_fn: Optional[Callable[[random.Random], float]],
+) -> List[dict]:
+    """Shared open-loop arrival core. The draw order (interarrival →
+    prefix → [prefill] → n_new) matches the legacy sims' generators
+    exactly, so seeded traces reproduce byte-for-byte."""
+    out: List[dict] = []
+    t = 0.0
+    while t < duration_s:
+        t += rng.expovariate(rate_fn(t))
+        if t >= duration_s:
+            break
+        pid = rng.randrange(n_prefixes)
+        # Prompt = shared prefix tokens + a unique tail (router affinity
+        # keys on the first tokens; the tail keeps requests distinct).
+        prompt = [pid * prefix_len + i for i in range(prefix_len)]
+        prompt.append(10_000 + len(out))
+        req: Dict[str, Any] = {"t": t, "prefix_id": pid, "prompt": prompt}
+        if prefill_fn is not None:
+            req["prefill_units"] = prefill_fn(rng)
+        req["n_new"] = max(
+            min_new_tokens, int(rng.expovariate(1.0 / mean_new_tokens))
+        )
+        out.append(req)
+    return out
+
+
+def bursty_arrivals(
+    seed: int,
+    duration_s: float = 600.0,
+    base_rps: float = 1.0,
+    burst_rps: float = 14.0,
+    burst_every_s: float = 120.0,
+    burst_len_s: float = 35.0,
+    n_prefixes: int = 4,
+    prefix_len: int = 32,
+    mean_new_tokens: float = 96,
+    min_new_tokens: int = 8,
+    prefill_mean_s: Optional[float] = None,
+    prefill_min_s: float = 0.3,
+    seed_offset: int = 0,
+) -> List[dict]:
+    """Seeded bursty open-loop arrivals: [{t, prefix_id, prompt, n_new}]
+    (+ ``prefill_units`` seconds when ``prefill_mean_s`` is set)."""
+    rng = random.Random(seed + seed_offset)
+
+    def rate(t: float) -> float:
+        return burst_rps if (t % burst_every_s) < burst_len_s else base_rps
+
+    prefill = None
+    if prefill_mean_s is not None:
+        def prefill(r: random.Random) -> float:
+            return max(prefill_min_s, r.expovariate(1.0 / prefill_mean_s))
+
+    return _open_loop_arrivals(
+        rng, rate, duration_s, n_prefixes, prefix_len,
+        mean_new_tokens, min_new_tokens, prefill,
+    )
+
+
+def diurnal_arrivals(
+    seed: int,
+    duration_s: float = 600.0,
+    trough_rps: float = 0.5,
+    peak_rps: float = 4.0,
+    period_s: float = 300.0,
+    n_prefixes: int = 4,
+    prefix_len: int = 32,
+    mean_new_tokens: float = 96,
+    min_new_tokens: int = 8,
+) -> List[dict]:
+    """Sinusoidal day/night arrival rate between trough and peak."""
+    rng = random.Random(seed)
+
+    def rate(t: float) -> float:
+        phase = 0.5 * (1.0 - math.cos(2.0 * math.pi * t / period_s))
+        return trough_rps + (peak_rps - trough_rps) * phase
+
+    return _open_loop_arrivals(
+        rng, rate, duration_s, n_prefixes, prefix_len,
+        mean_new_tokens, min_new_tokens, None,
+    )
+
+
+def heavy_tail_prefill_arrivals(
+    seed: int,
+    duration_s: float = 600.0,
+    base_rps: float = 0.4,
+    burst_rps: float = 3.0,
+    burst_every_s: float = 120.0,
+    burst_len_s: float = 35.0,
+    alpha: float = 1.5,
+    prefill_min_s: float = 0.3,
+    n_prefixes: int = 4,
+    prefix_len: int = 32,
+    mean_new_tokens: float = 96,
+    min_new_tokens: int = 8,
+) -> List[dict]:
+    """Bursty arrivals whose prefill cost is Pareto(``alpha``) — the
+    heavy-tail regime where a single huge prompt can wedge a symmetric
+    replica's slot pool."""
+    rng = random.Random(seed)
+
+    def rate(t: float) -> float:
+        return burst_rps if (t % burst_every_s) < burst_len_s else base_rps
+
+    def prefill(r: random.Random) -> float:
+        return prefill_min_s * r.paretovariate(alpha)
+
+    return _open_loop_arrivals(
+        rng, rate, duration_s, n_prefixes, prefix_len,
+        mean_new_tokens, min_new_tokens, prefill,
+    )
+
+
+# -- training lane: self-heal vs die-and-restart under chip faults ------------
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainTwinParams:
+    """The chaos training-gang scenario knobs (defaults = the seeded
+    benchmark the sentinel gates; ``benchmarks/chaos.py`` re-exports
+    them as module constants)."""
+
+    n_chips: int = 8
+    model_axis: int = 2
+    min_chips: int = 2
+    total_steps: int = 1_000
+    step_time_s: float = 0.5
+    ckpt_interval_steps: int = 100
+    ckpt_save_s: float = 5.0
+    resume_admit_s: float = 5.0
+    cold_compile_s: float = 15.0
+    warm_compile_s: float = 1.5
+    die_detect_s: float = 30.0
+    die_restart_s: float = 120.0
+    chip_recovery_base_s: float = 60.0
+    chip_recovery_per_duration_s: float = 30.0
+    layout_prefix: str = "chaos"
+
+
+def chip_fault_timeline(
+    seed: int, n_faults: int = 12, params: TrainTwinParams = TrainTwinParams()
+) -> List[dict]:
+    """Chip-unhealthy events from a seeded plan: (step, device, recovery_s).
+
+    Draws a larger random plan and keeps the chip faults — same seed,
+    same trace, every policy replays it identically."""
+    plan = FaultPlan.random(
+        seed,
+        n_faults=n_faults * 3,
+        max_step=params.total_steps,
+        n_devices=params.n_chips,
+    )
+    events, seen_steps = [], set()
+    for s in plan.specs:
+        if s.kind is not FaultKind.CHIP_UNHEALTHY or s.at_step is None:
+            continue
+        if s.at_step in seen_steps:  # one fault per step keeps the sim simple
+            continue
+        seen_steps.add(s.at_step)
+        events.append({
+            "step": int(s.at_step),
+            "device": int(s.device_index or 0),
+            "recovery_s": params.chip_recovery_base_s
+            + params.chip_recovery_per_duration_s * float(s.duration_steps or 1),
+        })
+    events.sort(key=lambda e: e["step"])
+    return events[:n_faults]
+
+
+def _usable(healthy: int, params: TrainTwinParams) -> int:
+    return max(params.min_chips, (healthy // params.model_axis) * params.model_axis)
+
+
+def _layout_key(use: int, params: TrainTwinParams) -> str:
+    """Index key for the shrunk-mesh layout running on ``use`` chips."""
+    return f"{params.layout_prefix}|data{use // params.model_axis}xfsdp{params.model_axis}"
+
+
+def seed_initial_compile(
+    index: CompileCacheIndex, params: TrainTwinParams = TrainTwinParams()
+) -> None:
+    """The job's own startup compile put the full-mesh layout in the cache."""
+    key = _layout_key(params.n_chips, params)
+    index.record(
+        key, params.cold_compile_s, cache_hit=False,
+        label=key.split("|", 1)[1], model=params.layout_prefix,
+        via=params.layout_prefix,
+    )
+
+
+def _resume_compile(
+    index: Optional[CompileCacheIndex], use: int, params: TrainTwinParams
+) -> Tuple[float, bool]:
+    """Compile cost of a shrink-resume onto ``use`` chips: (seconds, warm)."""
+    if index is None:  # index off: a fresh process always compiles cold
+        return params.cold_compile_s, False
+    key = _layout_key(use, params)
+    if index.is_warm(key):
+        index.record(key, params.warm_compile_s, cache_hit=True,
+                     via=params.layout_prefix)
+        return params.warm_compile_s, True
+    index.record(key, params.cold_compile_s, cache_hit=False,
+                 label=key.split("|", 1)[1], model=params.layout_prefix,
+                 via=params.layout_prefix)
+    return params.cold_compile_s, False
+
+
+def _grow_compile(
+    index: Optional[CompileCacheIndex], use: int, params: TrainTwinParams
+) -> Tuple[float, bool]:
+    """Compile cost of a grow-back preempt-resume onto ``use`` chips.
+
+    With the index on, the scheduler precompiles the target layout in the
+    background *before* preempting, so the cold compile never lands on
+    the critical path — the resume pays only the warm relink either way;
+    a never-seen layout is recorded as a background precompile."""
+    if index is None:
+        return params.cold_compile_s, False
+    key = _layout_key(use, params)
+    if not index.is_warm(key):
+        index.record(key, params.cold_compile_s, cache_hit=False,
+                     label=key.split("|", 1)[1], model=params.layout_prefix,
+                     via="precompile")
+    index.record(key, params.warm_compile_s, cache_hit=True,
+                 via=params.layout_prefix)
+    return params.warm_compile_s, True
+
+
+def replay_self_heal(
+    events: List[dict],
+    params: TrainTwinParams = TrainTwinParams(),
+    recorder: Optional[FlightRecorder] = None,
+    trace_id: Optional[str] = None,
+    compile_index: Optional[CompileCacheIndex] = None,
+) -> dict:
+    """Self-heal policy over a chip-fault timeline on the virtual clock:
+    in-band detect, emergency save, shrink re-admit (zero lost steps),
+    grow back when the chip recovers. Records the causal recovery chain
+    (detect → emergency_save → requeue → shrink_admit → compile → resume)
+    when given a recorder."""
+    clock = 0.0
+    healthy = params.n_chips
+    pending: List[float] = []  # clocks at which a failed chip becomes healthy
+    mttrs: List[float] = []
+    grow_backs = 0
+    degraded_s = 0.0
+    warm_resumes = 0
+    cold_resumes = 0
+    compile_s_total = 0.0
+    i = 0
+    # Flight-recorder lane (virtual-clock timestamps — the recorder takes
+    # explicit t0/t1 everywhere for exactly this). Each fault's recovery
+    # chain links causally; a later grow_back chains off the resume.
+    root = chain_tail = None
+    if recorder is not None:
+        trace_id = trace_id or recorder.new_trace_id()
+        root = recorder.start_span(
+            "job:chaos-self-heal", kind="job", trace_id=trace_id, t0=0.0,
+            attrs={"n_chips": params.n_chips, "total_steps": params.total_steps},
+        )
+    for step in range(1, params.total_steps + 1):
+        # Grow back as soon as a chip has recovered: preempt-save-resume at
+        # the larger mesh (the scheduler's _maybe_grow pass).
+        while pending and pending[0] <= clock and healthy < params.n_chips:
+            pending.pop(0)
+            healthy += 1
+            if _usable(healthy, params) > _usable(healthy - 1, params):
+                g_compile_s, g_warm = _grow_compile(
+                    compile_index, _usable(healthy, params), params
+                )
+                g_admit_end = clock + params.ckpt_save_s + params.resume_admit_s
+                if recorder is not None:
+                    recorder.record_span(
+                        "grow_back", kind="admission", trace_id=trace_id,
+                        parent=chain_tail or root, t0=clock, t1=g_admit_end,
+                        attrs={"step": step, "mesh": _usable(healthy, params)},
+                    )
+                    recorder.record_span(
+                        "compile", kind="compile", trace_id=trace_id,
+                        parent=chain_tail or root, t0=g_admit_end,
+                        t1=g_admit_end + g_compile_s,
+                        attrs={"cache_hit": g_warm,
+                               "compile_s": g_compile_s,
+                               "layout": _layout_key(_usable(healthy, params), params)},
+                    )
+                clock = g_admit_end + g_compile_s
+                compile_s_total += g_compile_s
+                warm_resumes += 1 if g_warm else 0
+                cold_resumes += 0 if g_warm else 1
+                grow_backs += 1
+        use = _usable(healthy, params)
+        step_t = params.step_time_s * params.n_chips / use
+        clock += step_t
+        if use < params.n_chips:
+            degraded_s += step_t
+        if step % params.ckpt_interval_steps == 0:
+            if recorder is not None:
+                recorder.record_span(
+                    "checkpoint_save", kind="checkpoint_save",
+                    trace_id=trace_id, parent=root, t0=clock,
+                    t1=clock + params.ckpt_save_s, attrs={"step": step},
+                )
+            clock += params.ckpt_save_s
+        if i < len(events) and step >= events[i]["step"]:
+            ev = events[i]
+            i += 1
+            healthy -= 1
+            # Detection is the in-band health check on this very step;
+            # emergency save persists `step`, shrink-resume follows. The
+            # compile leg is warm iff the index has seen this layout.
+            compile_s, warm = _resume_compile(
+                compile_index, _usable(healthy, params), params
+            )
+            down = params.ckpt_save_s + params.resume_admit_s + compile_s
+            admit_end = clock + params.ckpt_save_s + params.resume_admit_s
+            if recorder is not None:
+                detect = recorder.record_span(
+                    "detect", kind="fault", trace_id=trace_id, parent=root,
+                    t0=clock, t1=clock,
+                    attrs={"step": step, "device": ev["device"]},
+                )
+                save = recorder.record_span(
+                    "emergency_save", kind="emergency_save",
+                    trace_id=trace_id, parent=detect, t0=clock,
+                    t1=clock + params.ckpt_save_s, attrs={"step": step},
+                )
+                requeue = recorder.record_span(
+                    "requeue", kind="scheduler", trace_id=trace_id,
+                    parent=save, t0=clock + params.ckpt_save_s,
+                    t1=clock + params.ckpt_save_s, attrs={"step": step},
+                )
+                admit = recorder.record_span(
+                    "shrink_admit", kind="admission", trace_id=trace_id,
+                    parent=requeue, t0=clock + params.ckpt_save_s, t1=admit_end,
+                    attrs={"step": step, "mesh": _usable(healthy, params)},
+                )
+                comp = recorder.record_span(
+                    "compile", kind="compile", trace_id=trace_id,
+                    parent=admit, t0=admit_end, t1=admit_end + compile_s,
+                    attrs={"cache_hit": warm, "compile_s": compile_s,
+                           "layout": _layout_key(_usable(healthy, params), params)},
+                )
+                chain_tail = recorder.record_span(
+                    "resume", kind="supervisor", trace_id=trace_id,
+                    parent=comp, t0=clock + down, t1=clock + down,
+                    attrs={"from_step": step},
+                )
+            clock += down
+            compile_s_total += compile_s
+            warm_resumes += 1 if warm else 0
+            cold_resumes += 0 if warm else 1
+            mttrs.append(step_t + down)
+            pending.append(clock + ev["recovery_s"])
+            pending.sort()
+    wall = clock
+    if root is not None:
+        root.end(t1=wall, faults=len(mttrs), grow_backs=grow_backs)
+    return {
+        "policy": "self-heal",
+        "compile_index": compile_index is not None,
+        "wall_s": round(wall, 1),
+        "steps_run": params.total_steps,
+        "lost_steps": 0,
+        "faults": len(mttrs),
+        "grow_backs": grow_backs,
+        "degraded_step_s": round(degraded_s, 1),
+        "warm_resumes": warm_resumes,
+        "cold_resumes": cold_resumes,
+        "compile_s_total": round(compile_s_total, 1),
+        "mttr_mean_s": round(sum(mttrs) / len(mttrs), 2) if mttrs else 0.0,
+        "mttr_max_s": round(max(mttrs), 2) if mttrs else 0.0,
+        "goodput": round(params.total_steps * params.step_time_s / wall, 4),
+    }
+
+
+def replay_die_and_restart(
+    events: List[dict], params: TrainTwinParams = TrainTwinParams()
+) -> dict:
+    """Die-and-restart policy: external poll detect, wait for the chip,
+    cold restart from the last periodic checkpoint (steps lost)."""
+    clock = 0.0
+    step = 0
+    last_ckpt = 0
+    lost_steps = 0
+    steps_run = 0
+    mttrs: List[float] = []
+    i = 0
+    while step < params.total_steps:
+        clock += params.step_time_s
+        step += 1
+        steps_run += 1
+        if step % params.ckpt_interval_steps == 0:
+            last_ckpt = step
+            clock += params.ckpt_save_s
+        if i < len(events) and step >= events[i]["step"]:
+            ev = events[i]
+            i += 1  # each fault fires once, even though step rolls back
+            lost = step - last_ckpt
+            lost_steps += lost
+            # Nothing runs until the chip is replaced (full mesh required),
+            # then a cold restart replays everything since the checkpoint.
+            down = params.die_detect_s + ev["recovery_s"] + params.die_restart_s
+            clock += down
+            mttrs.append(down + lost * params.step_time_s)
+            step = last_ckpt
+    wall = clock
+    return {
+        "policy": "die-and-restart",
+        "wall_s": round(wall, 1),
+        "steps_run": steps_run,
+        "lost_steps": lost_steps,
+        "faults": len(mttrs),
+        "grow_backs": 0,
+        "degraded_step_s": 0.0,
+        "mttr_mean_s": round(sum(mttrs) / len(mttrs), 2) if mttrs else 0.0,
+        "mttr_max_s": round(max(mttrs), 2) if mttrs else 0.0,
+        "goodput": round(params.total_steps * params.step_time_s / wall, 4),
+    }
+
+
+def goodput_lane(
+    recorder: FlightRecorder,
+    trace_id: str,
+    wall: float,
+    full_gang: int = 8,
+    tenant: str = "chaos",
+    goodput_target: float = 0.88,
+    short_window_s: float = 120.0,
+    long_window_s: float = 600.0,
+    warning_burn: float = 1.5,
+    page_burn: float = 3.0,
+) -> dict:
+    """Account a recorded training trace through the REAL goodput ledger
+    (the same decomposition live submissions get), then replay the SLO
+    burn-rate alerter over the run's virtual clock.
+
+    Alert transitions land as ``slo_alert`` events on the recorder's
+    ``fleet`` timeline and per-window counter samples as a Perfetto
+    counter track — both ride the same Chrome-trace export as the
+    recovery chains they explain."""
+    ledger = GoodputLedger(clock=lambda: wall, bucket_s=60.0,
+                           history_buckets=256)
+    ledger.track(trace_id, tenant=tenant, workload="training",
+                 full_gang=full_gang)
+    d = ledger.finalize(recorder, trace_id, now=wall)
+    assert d is not None
+    cats = d["categories"]
+    sum_error_pct = abs(sum(cats.values()) - d["wall_s"]) / d["wall_s"] * 100
+    alerter = SLOBurnRateAlerter(
+        ledger,
+        goodput_target=goodput_target,
+        short_window_s=short_window_s,
+        long_window_s=long_window_s,
+        warning_burn=warning_burn,
+        page_burn=page_burn,
+        recorder=recorder,
+        clock=lambda: wall,
+    )
+    progression = ["ok"]
+    t = 0.0
+    while t <= wall + 60.0:
+        out = alerter.evaluate(now=t)
+        g = out["goodput"]
+        if g["state"] != progression[-1]:
+            progression.append(g["state"])
+        recorder.counter(
+            "goodput_burn",
+            {
+                "goodput_fraction_short": g["short_fraction"] or 1.0,
+                "burn_short": g["short_burn"] or 0.0,
+                "burn_long": g["long_burn"] or 0.0,
+            },
+            trace_id=trace_id,
+            ts=t,
+        )
+        t += 60.0
+    split = d.get("compile_split") or {}
+    return {
+        "breakdown_s": {c: round(cats[c], 2) for c in CATEGORIES},
+        "breakdown_pct": {
+            c: round(100.0 * cats[c] / d["wall_s"], 2) for c in CATEGORIES
+        },
+        "compile_split_s": {
+            "warm_s": round(float(split.get("warm_s", 0.0)), 2),
+            "cold_s": round(float(split.get("cold_s", 0.0)), 2),
+        },
+        "wall_s": round(d["wall_s"], 1),
+        "goodput_fraction": round(d["goodput_fraction"], 4),
+        "sum_error_pct": round(sum_error_pct, 6),
+        "slo": {
+            "target": alerter.goodput_target,
+            "warning_burn": alerter.warning_burn,
+            "page_burn": alerter.page_burn,
+            "progression": progression,
+            "alert_count": len(alerter.alerts),
+            "alerts": list(alerter.alerts),
+        },
+    }
+
+
+# -- heterogeneous sharding lane ----------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class HeteroTwinParams:
+    """Slow-host gang scenario: one host runs sustained-slow; the
+    synchronous gang gates every step on it unless the heterogeneity
+    plane reweights the per-process row assignment."""
+
+    hosts: int = 8
+    global_micro: int = 128
+    steps: int = 400
+    tail_steps: int = 100       # steady-state window: the last N steps
+    check_every: int = 10       # rebalance consult cadence (steps)
+    shrink_at_step: int = 25    # when the shrink policy evicts the slow host
+    step_time_s: float = 0.5
+    # Reported per-step stall while uniformly loaded; the slow host's true
+    # rate is STEP/(STEP+stall) = 0.75 — the headline 25%-degraded host.
+    slow_s: float = 0.5 / 3.0
+    ckpt_save_s: float = 5.0
+    resume_admit_s: float = 5.0
+    cold_compile_s: float = 15.0
+
+
+def host_slow_plan(
+    seed: int, params: HeteroTwinParams = HeteroTwinParams()
+) -> FaultPlan:
+    """Sustained host-slow on one seeded host: fires every step."""
+    host = random.Random(seed).randrange(params.hosts)
+    return FaultPlan(seed=seed, specs=[
+        FaultSpec(
+            kind=FaultKind.HOST_SLOW, at_step=1, device_index=host,
+            slow_s=round(params.slow_s, 6), count=params.steps,
+        )
+    ])
+
+
+def replay_hetero(
+    policy: str,
+    plan: FaultPlan,
+    params: HeteroTwinParams = HeteroTwinParams(),
+    recorder: Optional[FlightRecorder] = None,
+    trace_id: Optional[str] = None,
+) -> dict:
+    """Replay ``plan`` under one policy on the virtual clock.
+
+    The injector is the only degradation source: a consumed HOST_SLOW spec
+    both slows the simulated host (truth) and feeds the ThroughputTracker
+    (signal) — exactly the supervisor's ``take_host_slow`` seam."""
+    inj = FaultInjector(plan)
+    inj.arm()
+    rate = [1.0] * params.hosts        # ground-truth relative rates
+    rows_u = params.global_micro // params.hosts
+    vclock = 0.0
+    tracker = hetero_mod.ThroughputTracker(params.hosts)
+    reb = hetero_mod.HeteroRebalancer(
+        tracker, params.global_micro, dry_run=False, cooldown_s=30.0,
+        min_gain=0.01, clock=lambda: vclock,
+        recorder=recorder, trace_id=trace_id,
+    )
+    assignment = list(reb.assignment)
+    active = list(range(params.hosts))
+    shrunk = False
+    downtime_s = 0.0
+    rebalance_step: Optional[int] = None
+    ideal_wall = 0.0
+    tail_wall = tail_ideal = 0.0
+    for step in range(1, params.steps + 1):
+        spec = inj.take_host_slow(step)
+        if spec is not None:
+            idx = int(spec.device_index or 0)
+            rate[idx] = params.step_time_s / (params.step_time_s + float(spec.slow_s))
+            tracker.note_host_slow(idx, float(spec.slow_s), params.step_time_s)
+        if policy == "shrink" and not shrunk and step >= params.shrink_at_step:
+            # Evict the slow host: emergency save + re-admit + cold compile,
+            # then a smaller uniform gang carries the full global batch.
+            shrunk = True
+            slow_host = min(range(params.hosts), key=lambda h: rate[h])
+            active = [h for h in range(params.hosts) if h != slow_host]
+            assignment = hetero_mod.uniform_assignment(
+                params.global_micro, len(active)
+            )
+            downtime_s = params.ckpt_save_s + params.resume_admit_s + params.cold_compile_s
+            vclock += downtime_s
+        # Synchronous gang: the step ends when the slowest member finishes
+        # its rows; a host's nominal pace is rows_u rows per step_time_s.
+        step_s = max(
+            assignment[j] * params.step_time_s / (rows_u * rate[h])
+            for j, h in enumerate(active)
+        )
+        ideal_s = params.global_micro * params.step_time_s / (rows_u * sum(rate))
+        vclock += step_s
+        ideal_wall += ideal_s
+        tracker.observe_step(step_s)
+        if policy == "rebalance-on" and step % params.check_every == 0:
+            r_plan = reb.maybe_rebalance(step)
+            if r_plan is not None:
+                assignment = list(r_plan.assignment)
+                if rebalance_step is None:
+                    rebalance_step = step
+        if step > params.steps - params.tail_steps:
+            tail_wall += step_s
+            tail_ideal += ideal_s
+    return {
+        "policy": policy,
+        "wall_s": round(vclock, 1),
+        "ideal_wall_s": round(ideal_wall, 1),
+        "downtime_s": round(downtime_s, 1),
+        "goodput": round(ideal_wall / vclock, 4),
+        "steady_goodput": round(tail_ideal / tail_wall, 4),
+        "assignment": list(assignment),
+        "active_hosts": len(active),
+        "rebalance_step": rebalance_step,
+        "rebalancer": reb.stats() if policy == "rebalance-on" else None,
+    }
+
+
+def run_hetero_ab(
+    seed: int = 0,
+    params: HeteroTwinParams = HeteroTwinParams(),
+    recorder: Optional[FlightRecorder] = None,
+) -> dict:
+    """Rebalance-on vs rebalance-off vs shrink on one seeded slow-host plan."""
+    plan = host_slow_plan(seed, params)
+    trace_id = recorder.new_trace_id() if recorder is not None else None
+    on = replay_hetero("rebalance-on", plan, params, recorder=recorder,
+                       trace_id=trace_id)
+    off = replay_hetero("rebalance-off", plan, params)
+    shrink = replay_hetero("shrink", plan, params)
+    return {
+        "seed": seed,
+        "params": {
+            "n_hosts": params.hosts,
+            "global_micro": params.global_micro,
+            "steps": params.steps,
+            "slow_host_rate": round(
+                params.step_time_s / (params.step_time_s + params.slow_s), 4
+            ),
+            "slow_host": int(plan.specs[0].device_index or 0),
+            "check_every_steps": params.check_every,
+        },
+        "rebalance_on": on,
+        "rebalance_off": off,
+        "shrink": shrink,
+        "steady_goodput_on": on["steady_goodput"],
+        "steady_goodput_off": off["steady_goodput"],
+        "steady_goodput_shrink": shrink["steady_goodput"],
+        "goodput_recovered": round(
+            on["steady_goodput"] - off["steady_goodput"], 4
+        ),
+    }
+
+
+# -- serving lane: open-loop tick driver + autoscaled fleet -------------------
+
+
+def percentile(vals: List[float], q: float) -> float:
+    if not vals:
+        return 0.0
+    vals = sorted(vals)
+    return vals[min(int(q * (len(vals) - 1)), len(vals) - 1)]
+
+
+def run_open_loop(
+    trace: List[dict],
+    dt: float,
+    duration_s: float,
+    pending: Callable[[], Any],
+    arrive: Callable[[dict], None],
+    tick: Callable[[float], None],
+    control: Optional[Callable[[float], None]] = None,
+    control_period_s: float = 1.0,
+    safety_factor: float = 3.0,
+) -> float:
+    """The shared open-loop discrete-event driver every serving scenario
+    runs on: deliver arrivals due by ``t``, run the control-plane closure
+    on its cadence, advance the world one ``dt`` tick — until the trace
+    is exhausted AND ``pending()`` is falsy. ``safety_factor`` bounds a
+    sim bug from spinning forever. Returns the final virtual time."""
+    idx, t, next_control = 0, 0.0, 0.0
+    while t < duration_s or pending():
+        if t > duration_s * safety_factor:
+            break
+        while idx < len(trace) and trace[idx]["t"] <= t:
+            arrive(trace[idx])
+            idx += 1
+        if control is not None and t >= next_control:
+            next_control = t + control_period_s
+            control(t)
+        tick(t)
+        t += dt
+    return t
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingTwinParams:
+    """Autoscaled serving-fleet scenario knobs (defaults = the seeded
+    benchmark; ``benchmarks/serving_fleet_sim.py`` re-exports them)."""
+
+    duration_s: float = 600.0
+    dt_s: float = 0.05
+    control_period_s: float = 1.0
+    slots: int = 8
+    tokens_per_slot_s: float = 30.0
+    degraded_fraction: float = 0.4
+    prefill_s: float = 1.2
+    prefill_hit_s: float = 0.15
+    startup_delay_s: float = 25.0
+    chips_per_replica: int = 1
+    prefix_len: int = 32
+    p99_slo_ms: float = 25_000.0
+    warmup_s: float = 120.0
+
+
+class SlotReplica:
+    """Capacity model of one decode replica: a slot pool, a per-slot decode
+    rate, and a prefix cache that skips prefill for resident prefixes."""
+
+    def __init__(
+        self,
+        rid: str,
+        rate_fraction: float,
+        ready_at: float,
+        params: ServingTwinParams = ServingTwinParams(),
+    ):
+        self.rid = rid
+        self.params = params
+        self.rate = params.tokens_per_slot_s * rate_fraction
+        self.ready_at = ready_at
+        self.active: List[dict] = []      # {req, prefill_left, tokens_left}
+        self.prefix_cache: set = set()
+        self.tokens_out = 0.0
+        self.draining = False
+
+    def ready(self, now: float) -> bool:
+        return now >= self.ready_at
+
+    def free_slots(self, now: float) -> int:
+        if not self.ready(now) or self.draining:
+            return 0
+        return self.params.slots - len(self.active)
+
+    def admit(self, req: dict) -> None:
+        hit = req["prefix_id"] in self.prefix_cache
+        self.prefix_cache.add(req["prefix_id"])
+        self.active.append({
+            "req": req,
+            "prefill_left": self.params.prefill_hit_s if hit
+            else self.params.prefill_s,
+            "tokens_left": float(req["n_new"]),
+            "hit": hit,
+        })
+
+    def step(self, now: float, dt: float, done: List[dict]) -> None:
+        if not self.ready(now):
+            return
+        for sl in list(self.active):
+            if sl["prefill_left"] > 0:
+                sl["prefill_left"] -= dt
+                continue
+            produced = min(self.rate * dt, sl["tokens_left"])
+            sl["tokens_left"] -= produced
+            self.tokens_out += produced
+            if sl["tokens_left"] <= 0:
+                sl["req"]["done_at"] = now
+                sl["req"]["replica"] = self.rid
+                sl["req"]["prefix_hit"] = sl["hit"]
+                done.append(sl["req"])
+                self.active.remove(sl)
+
+    def router_stats(self, now: float) -> dict:
+        # tokens/sec the router would measure: rate × busy slots (plus a
+        # trickle when idle so a fresh replica is not weight-zero).
+        busy = sum(1 for s in self.active if s["prefill_left"] <= 0)
+        return {
+            "tokens_per_sec": self.rate * max(busy, 0.2),
+            "free_slots": self.free_slots(now),
+            "slots": self.params.slots,
+        }
+
+
+def replay_serving_fleet(
+    trace: List[dict],
+    autoscale: bool,
+    autoscaler_cfg,
+    params: ServingTwinParams = ServingTwinParams(),
+) -> dict:
+    """Autoscaled (or static-1) fleet over an open-loop trace, driven by
+    the REAL FleetRouter + ReplicaAutoscaler on the twin's tick driver."""
+    from tpu_engine.serving_fleet import FleetRouter, ReplicaAutoscaler
+
+    router = FleetRouter(affinity_tokens=params.prefix_len)
+    scaler = ReplicaAutoscaler(autoscaler_cfg)
+    replicas: Dict[str, SlotReplica] = {
+        # Replica 0 is the degraded host — present from t=0 in both modes;
+        # in static mode it is the whole fleet.
+        "r0": SlotReplica("r0", params.degraded_fraction, ready_at=0.0,
+                          params=params)
+    }
+    state = {"next_rid": 1, "chip_seconds": 0.0}
+    queue: List[dict] = []
+    done: List[dict] = []
+    replica_trace: List[tuple] = []
+
+    def control(t: float) -> None:
+        up = {
+            r.rid: r.router_stats(t)
+            for r in replicas.values()
+            if r.ready(t) and not r.draining
+        }
+        router.update(up)
+        ready_n = len(up)
+        # Change-point trace: one entry per replica-count transition
+        # keeps the bench JSON line readable.
+        if not replica_trace or replica_trace[-1][1] != ready_n:
+            replica_trace.append((round(t, 1), ready_n))
+        if autoscale and ready_n > 0:
+            lat = [(r["done_at"] - r["t"]) * 1000.0 for r in done[-256:]]
+            desired = scaler.observe(
+                t, len(queue), percentile(lat, 0.99) if lat else None, ready_n
+            )
+            booting = sum(
+                1 for r in replicas.values()
+                if not r.ready(t) and not r.draining
+            )
+            while desired > ready_n + booting:
+                rid = f"r{state['next_rid']}"
+                replicas[rid] = SlotReplica(
+                    rid, 1.0, ready_at=t + params.startup_delay_s,
+                    params=params,
+                )
+                state["next_rid"] += 1
+                booting += 1
+            if desired < ready_n:
+                # Drain the emptiest ready replica (never the last one).
+                cands = sorted(
+                    (r for r in replicas.values()
+                     if r.ready(t) and not r.draining and r.rid != "r0"),
+                    key=lambda r: len(r.active),
+                )
+                for r in cands[: ready_n - desired]:
+                    r.draining = True
+
+    def tick(t: float) -> None:
+        # Dispatch through the real router (affinity keys on the prefix).
+        # Route only while the fleet has a free slot — an overloaded fleet
+        # must queue, not spin the router on unplaceable requests.
+        free_total = sum(r.free_slots(t) for r in replicas.values())
+        placed = 0
+        while queue and free_total > 0:
+            req = queue[0]
+            rid = router.route(req["prompt"])
+            rep = replicas.get(rid) if rid else None
+            if rep is not None and rep.free_slots(t) > 0:
+                rep.admit(queue.pop(0))
+                free_total -= 1
+                placed += 1
+            else:
+                # Router picked a full/draining replica: stop this tick,
+                # weights refresh at the next control period.
+                break
+            if placed > params.slots * len(replicas):
+                break
+        for r in list(replicas.values()):
+            r.step(t, params.dt_s, done)
+            if r.draining and not r.active:
+                del replicas[r.rid]
+        state["chip_seconds"] += params.dt_s * params.chips_per_replica * sum(
+            1 for r in replicas.values() if r.ready(t)
+        )
+
+    run_open_loop(
+        trace,
+        dt=params.dt_s,
+        duration_s=params.duration_s,
+        pending=lambda: queue or any(r.active for r in replicas.values()),
+        arrive=queue.append,
+        tick=tick,
+        control=control,
+        control_period_s=params.control_period_s,
+        safety_factor=3.0,
+    )
+
+    lat_ms = [
+        (r["done_at"] - r["t"]) * 1000.0 for r in done
+        if r["t"] >= params.warmup_s
+    ]
+    # Count tokens from completed requests, not replica counters — drained
+    # replicas leave the dict and would take their counters with them.
+    total_tokens = float(sum(req["n_new"] for req in done))
+    makespan = max((r["done_at"] for r in done), default=params.dt_s)
+    p99 = percentile(lat_ms, 0.99)
+    return {
+        "completed": len(done),
+        "total_tokens": total_tokens,
+        "tokens_per_sec": total_tokens / makespan,
+        "tokens_per_sec_per_chip": total_tokens
+        / max(state["chip_seconds"], params.dt_s),
+        "p50_ms": round(percentile(lat_ms, 0.50), 1),
+        "p99_ms": round(p99, 1),
+        "p99_within_slo": p99 <= params.p99_slo_ms,
+        "makespan_s": round(makespan, 1),
+        "replica_trace": replica_trace,
+        "max_replicas_used": max(n for _, n in replica_trace),
+        "prefix_hit_rate": round(
+            sum(1 for r in done if r.get("prefix_hit")) / max(len(done), 1), 3
+        ),
+        "router": router.stats(),
+        "autoscaler": scaler.stats(),
+    }
+
+
+def serving_metrics(
+    done: List[dict],
+    ttfts: List[float],
+    warmup_s: float = 120.0,
+    total_chips: int = 8,
+    dt_s: float = 0.05,
+) -> dict:
+    """Steady-state latency/TTFT percentiles + throughput of one serving
+    run (the symmetric-vs-disagg A/B's shared report shape)."""
+    lat_ms = [(r["done_at"] - r["t"]) * 1000.0 for r in done
+              if r["t"] >= warmup_s]
+    steady_ttfts = [
+        (r["first_token_at"] - r["t"]) * 1000.0 for r in done
+        if r["t"] >= warmup_s and "first_token_at" in r
+    ]
+    total_tokens = float(sum(r["n_new"] for r in done))
+    makespan = max((r["done_at"] for r in done), default=dt_s)
+    return {
+        "completed": len(done),
+        "total_tokens": total_tokens,
+        "tokens_per_sec": round(total_tokens / makespan, 2),
+        "tokens_per_sec_per_chip": round(
+            total_tokens / (makespan * total_chips), 2),
+        "ttft_p50_ms": round(percentile(steady_ttfts, 0.50), 1),
+        "ttft_p99_ms": round(percentile(steady_ttfts, 0.99), 1),
+        "p50_ms": round(percentile(lat_ms, 0.50), 1),
+        "p99_ms": round(percentile(lat_ms, 0.99), 1),
+        "makespan_s": round(makespan, 1),
+    }
+
+
+# -- warm-admission lane ------------------------------------------------------
+
+
+def warm_admission_lane(
+    jobs: List[Tuple[str, float]],
+    prefer_warm: bool,
+    cold_compile_s: float = 15.0,
+    warm_compile_s: float = 1.5,
+) -> dict:
+    """Serve ``jobs`` (layout key, work seconds) through one slot.
+
+    Every job's service time is compile + work; the compile leg consults a
+    fresh :class:`CompileCacheIndex` — cold the first time a layout is
+    seen, warm after. ``prefer_warm`` is the cache-aware admission policy:
+    among queued jobs, the first whose layout the index says is warm is
+    admitted ahead of the FIFO head (ties broken FIFO)."""
+    index = CompileCacheIndex(path=None, default_cold_s=cold_compile_s)
+    queue = list(range(len(jobs)))
+    clock = 0.0
+    waits: List[float] = []
+    cold_compiles = 0
+    while queue:
+        pick = 0
+        if prefer_warm:
+            pick = next(
+                (qi for qi, j in enumerate(queue)
+                 if index.is_warm(jobs[j][0])),
+                0,
+            )
+        j = queue.pop(pick)
+        layout, work_s = jobs[j]
+        waits.append(clock)
+        if index.is_warm(layout):
+            compile_s = warm_compile_s
+            index.record(layout, compile_s, cache_hit=True, via="sim")
+        else:
+            compile_s = cold_compile_s
+            cold_compiles += 1
+            index.record(layout, compile_s, cache_hit=False,
+                         label=layout.split("|", 1)[1], model="sim", via="sim")
+        clock += compile_s + work_s
+    return {
+        "mean_wait_s": round(sum(waits) / len(waits), 2),
+        "makespan_s": round(clock, 2),
+        "cold_compiles": cold_compiles,
+        "warm_hits": len(jobs) - cold_compiles,
+    }
+
+
+# -- A/B scorecard layer ------------------------------------------------------
+
+
+def _flatten_numeric(d: Dict[str, Any]) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for k, v in d.items():
+        if isinstance(v, bool) or isinstance(v, (int, float)):
+            out[k] = float(v)
+    return out
+
+
+def ab_scorecard(
+    variants: Dict[str, Any],
+    runner: Callable[[str, Any], Dict[str, Any]],
+    label: str = "twin-ab",
+) -> Dict[str, Any]:
+    """Run ``runner(name, cfg)`` once per variant over the same ingested
+    workload; the first variant is the baseline. One JSON artifact:
+    per-variant metrics plus numeric deltas vs the baseline."""
+    results: Dict[str, Dict[str, Any]] = {}
+    cpu_s: Dict[str, float] = {}
+    for name, cfg in variants.items():
+        c0 = time.perf_counter()
+        results[name] = runner(name, cfg)
+        cpu_s[name] = round(time.perf_counter() - c0, 4)
+    base_name = next(iter(results))
+    base = _flatten_numeric(results[base_name])
+    deltas: Dict[str, Dict[str, float]] = {}
+    for name, res in results.items():
+        if name == base_name:
+            continue
+        flat = _flatten_numeric(res)
+        deltas[name] = {
+            k: round(flat[k] - base[k], 6) for k in flat if k in base
+        }
+    _bump(ab_runs_total=1)
+    return {
+        "label": label,
+        "baseline": base_name,
+        "variants": results,
+        "deltas_vs_baseline": deltas,
+        "cpu_s": cpu_s,
+    }
+
+
+def default_policy_scorecard(seed: int = 0, n_faults: int = 12) -> dict:
+    """A real policy question answered on one ingested fault timeline:
+    checkpoint-interval 50/100/200 × compile-index on/off, each variant
+    replayed through the full self-heal lane + goodput ledger + SLO
+    alerter. The baseline is the shipped config (interval 100, index on)."""
+    base = TrainTwinParams()
+    events = chip_fault_timeline(seed, n_faults, base)
+    variants: Dict[str, dict] = {
+        "ckpt100_index_on": {"params": base, "compile_index": True},
+        "ckpt50_index_on": {
+            "params": dataclasses.replace(base, ckpt_interval_steps=50),
+            "compile_index": True,
+        },
+        "ckpt200_index_on": {
+            "params": dataclasses.replace(base, ckpt_interval_steps=200),
+            "compile_index": True,
+        },
+        "ckpt100_index_off": {"params": base, "compile_index": False},
+    }
+
+    def runner(name: str, cfg: dict) -> dict:
+        params: TrainTwinParams = cfg["params"]
+        rec = FlightRecorder(
+            max_spans=16384, max_events=16384, clock=lambda: 0.0,
+            id_factory=deterministic_ids(name),
+        )
+        tid = rec.new_trace_id()
+        index = None
+        if cfg["compile_index"]:
+            index = CompileCacheIndex(
+                path=None, default_cold_s=params.cold_compile_s
+            )
+            seed_initial_compile(index, params)
+        heal = replay_self_heal(
+            events, params, recorder=rec, trace_id=tid, compile_index=index
+        )
+        gp = goodput_lane(rec, tid, heal["wall_s"], full_gang=params.n_chips)
+        return {
+            "ckpt_interval_steps": params.ckpt_interval_steps,
+            "compile_index": cfg["compile_index"],
+            "wall_s": heal["wall_s"],
+            "goodput_fraction": gp["goodput_fraction"],
+            "productive_pct": gp["breakdown_pct"]["productive"],
+            "checkpoint_pct": gp["breakdown_pct"]["checkpoint_save"],
+            "compile_pct": gp["breakdown_pct"]["compile"],
+            "mttr_mean_s": heal["mttr_mean_s"],
+            "warm_resumes": heal["warm_resumes"],
+            "cold_resumes": heal["cold_resumes"],
+            "slo_alerts": gp["slo"]["alert_count"],
+        }
+
+    card = ab_scorecard(
+        variants, runner, label="chaos-ckpt-interval-x-compile-index"
+    )
+    card["seed"] = seed
+    card["n_faults"] = n_faults
+    return card
+
+
+def admission_policy_scorecard(seed: int = 0, n_jobs: int = 16) -> dict:
+    """Queue-wait A/B on one seeded job list: strict FIFO vs the
+    cache-aware warm-preferring admission order."""
+    rng = random.Random(seed)
+    layouts = [f"sim|data{g}xfsdp2" for g in (1, 2, 4)]
+    jobs = [
+        (rng.choice(layouts), round(rng.uniform(4.0, 12.0), 2))
+        for _ in range(n_jobs)
+    ]
+    return ab_scorecard(
+        {"fifo": False, "warm_preferring": True},
+        lambda name, prefer_warm: warm_admission_lane(jobs, prefer_warm),
+        label="admission-fifo-vs-warm",
+    )
+
+
+# -- fidelity + bench wiring --------------------------------------------------
+
+
+def replay_fidelity(seed: int = 0, n_faults: int = 12) -> dict:
+    """The acceptance loop end to end: record a real self-heal run to
+    JSONL, ingest it, replay it on the twin, and diff the replayed
+    goodput decomposition against the source run's (per category, % of
+    wall). Also measures replay throughput in simulated fleet-seconds
+    per CPU-second."""
+    params = TrainTwinParams()
+    with tempfile.TemporaryDirectory(prefix="twin_fidelity_") as root:
+        path = os.path.join(root, "trace.jsonl")
+        rec = FlightRecorder(
+            max_spans=16384, max_events=16384, clock=lambda: 0.0,
+            persist_path=path, persist_max_bytes=64 * 1024 * 1024,
+        )
+        tid = rec.new_trace_id()
+        index = CompileCacheIndex(path=None, default_cold_s=params.cold_compile_s)
+        seed_initial_compile(index, params)
+        events = chip_fault_timeline(seed, n_faults, params)
+        heal = replay_self_heal(
+            events, params, recorder=rec, trace_id=tid, compile_index=index
+        )
+        source = goodput_lane(rec, tid, heal["wall_s"], full_gang=params.n_chips)
+        workload = ReplayWorkload.from_jsonl(path)
+    engine = TwinEngine()
+    out = engine.replay(workload)
+    twin_side = out["traces"].get(tid) or {}
+    diff = decomposition_diff(
+        source["breakdown_s"], twin_side.get("categories") or {},
+        source["wall_s"],
+    )
+    return {
+        "seed": seed,
+        "wall_s": source["wall_s"],
+        "source_goodput_fraction": source["goodput_fraction"],
+        "replay_goodput_fraction": round(
+            float(twin_side.get("goodput_fraction") or 0.0), 4
+        ),
+        "per_category_error_pct": diff["per_category_pct"],
+        "max_error_pct": diff["max_error_pct"],
+        "spans_replayed": out["spans_replayed"],
+        "events_replayed": out["events_replayed"],
+        "ingest": out["ingest"],
+        "fleet_seconds": out["fleet_seconds"],
+        "cpu_seconds": out["cpu_seconds"],
+        "fleet_seconds_per_cpu_second": out["fleet_seconds_per_cpu_second"],
+    }
+
+
+def twin_bench_line(seed: int = 0) -> dict:
+    """The twin's deterministic bench line, shared by ``bench.py`` and
+    ``tools/bench_sentinel.py``: replay fidelity vs the recorded source
+    run, plus the two policy A/Bs' headline deltas."""
+    fid = replay_fidelity(seed=seed)
+    card = default_policy_scorecard(seed=seed)
+    adm = admission_policy_scorecard(seed=seed)
+    variants = card["variants"]
+    gates = {
+        "replay_within_1pct": fid["max_error_pct"] < 1.0,
+        "replay_fast_enough": fid["fleet_seconds_per_cpu_second"] >= 1000.0,
+        "policy_delta_measured": (
+            variants["ckpt50_index_on"]["goodput_fraction"]
+            != variants["ckpt200_index_on"]["goodput_fraction"]
+        ),
+        "warm_beats_fifo": (
+            adm["variants"]["warm_preferring"]["mean_wait_s"]
+            < adm["variants"]["fifo"]["mean_wait_s"]
+        ),
+    }
+    return {
+        "metric": "twin_replay_policy_ab",
+        "value": fid["max_error_pct"],
+        "unit": "max per-category replay error, % of wall",
+        "replay_goodput_fraction": fid["replay_goodput_fraction"],
+        "spans_replayed": fid["spans_replayed"],
+        "ingest_skipped_lines": fid["ingest"].get("skipped", 0),
+        "fleet_seconds_per_cpu_second": fid["fleet_seconds_per_cpu_second"],
+        "variant_goodput": {
+            name: v["goodput_fraction"] for name, v in variants.items()
+        },
+        "variant_mttr_s": {
+            name: v["mttr_mean_s"] for name, v in variants.items()
+        },
+        "variant_ckpt_pct": {
+            name: v["checkpoint_pct"] for name, v in variants.items()
+        },
+        "ab_wait_fifo_s": adm["variants"]["fifo"]["mean_wait_s"],
+        "ab_wait_warm_s": adm["variants"]["warm_preferring"]["mean_wait_s"],
+        "gates": gates,
+        "ok": all(gates.values()),
+    }
