@@ -24,6 +24,8 @@ from backend.routers import (
     faults,
     goodput,
     hetero,
+    history,
+    incidents,
     metrics,
     monitoring,
     profiling,
@@ -103,6 +105,11 @@ async def root(request: web.Request) -> web.Response:
                 "versioned) replayed against the real control-plane "
                 "components under one virtual clock, with synthetic "
                 "traffic generators and A/B policy scorecards",
+                "fleet historian: bounded multi-resolution metric history "
+                "(raw + 10s/1m rollups) with range queries, Perfetto "
+                "counter export, and an incident correlator stitching "
+                "faults/anomalies/SLO alerts and scheduler actions into "
+                "causal detect -> action -> resolution timelines",
                 "OpenAPI 3.1 schema (/openapi.json) and self-contained "
                 "/docs page",
             ],
@@ -120,6 +127,8 @@ async def root(request: web.Request) -> web.Response:
                 "hetero": "/api/v1/hetero",
                 "compile_cache": "/api/v1/compile-cache",
                 "twin": "/api/v1/twin",
+                "history": "/api/v1/history",
+                "incidents": "/api/v1/incidents",
                 "metrics": "/metrics",
                 "openapi": "/openapi.json",
                 "docs": "/docs",
@@ -161,6 +170,8 @@ def create_app() -> web.Application:
     hetero.setup(app)
     compile_cache.setup(app)
     twin.setup(app)
+    history.setup(app)
+    incidents.setup(app)
     serving.setup(app)
     metrics.setup(app)
     app.router.add_get("/", root)

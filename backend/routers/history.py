@@ -1,0 +1,79 @@
+"""Fleet-historian routes — the query surface for
+``tpu_engine/historian.py``'s metric store:
+
+- ``GET /api/v1/history/query`` — one range query against the retained
+  multi-resolution history: ``name`` (required), ``t0``/``t1`` (float
+  seconds, default the series' trailing 10 minutes), ``agg`` (one of
+  ``avg``/``min``/``max``/``last``/``sum``/``count``/``rate``/``p99``),
+  ``tier`` (``raw``/``10s``/``1m``/``auto``), repeated ``label.<k>=<v>``
+  pairs to select a labelled series, and ``format=perfetto`` to get the
+  matching samples as a Perfetto counter-track JSON instead (drop it
+  into ui.perfetto.dev next to the flight-recorder export).
+- ``GET /api/v1/history/series`` — the retained series inventory plus
+  the store's health counters.
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from backend.http import json_response
+from tpu_engine import historian as historian_mod
+
+_AGGS = historian_mod.AGGS
+_TIERS = ("auto", "raw", "10s", "1m")
+
+
+def _float_param(request: web.Request, key: str):
+    raw = request.query.get(key)
+    if raw is None or raw == "":
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        raise web.HTTPBadRequest(reason=f"{key} must be a float, got {raw!r}")
+
+
+async def history_query(request: web.Request) -> web.Response:
+    name = request.query.get("name")
+    if not name:
+        return json_response(
+            {"error": "query parameter 'name' is required"}, status=400
+        )
+    agg = request.query.get("agg", "avg")
+    if agg not in _AGGS:
+        return json_response(
+            {"error": f"unknown agg {agg!r}", "allowed": list(_AGGS)}, status=400
+        )
+    tier = request.query.get("tier", "auto")
+    if tier not in _TIERS:
+        return json_response(
+            {"error": f"unknown tier {tier!r}", "allowed": list(_TIERS)},
+            status=400,
+        )
+    try:
+        t0 = _float_param(request, "t0")
+        t1 = _float_param(request, "t1")
+    except web.HTTPBadRequest as exc:
+        return json_response({"error": exc.reason}, status=400)
+    labels = {
+        k[len("label."):]: v
+        for k, v in request.query.items()
+        if k.startswith("label.")
+    } or None
+    hist = historian_mod.get_historian()
+    if request.query.get("format") == "perfetto":
+        return json_response(hist.export_chrome_counters([name], t0=t0, t1=t1))
+    return json_response(
+        hist.query(name, t0=t0, t1=t1, agg=agg, labels=labels, tier=tier)
+    )
+
+
+async def history_series(request: web.Request) -> web.Response:
+    hist = historian_mod.get_historian()
+    return json_response({"series": hist.series_list(), "stats": hist.stats()})
+
+
+def setup(app: web.Application, prefix: str = "/api/v1") -> None:
+    app.router.add_get(f"{prefix}/history/query", history_query)
+    app.router.add_get(f"{prefix}/history/series", history_series)

@@ -1,0 +1,67 @@
+"""Incident-correlator routes — the query surface for
+``tpu_engine/historian.py``'s :class:`IncidentCorrelator`:
+
+- ``GET /api/v1/incidents`` — pulls any new flight-recorder activity
+  into the correlator (same pull model as the ``/metrics`` scrape), then
+  returns the stitched incidents newest-first: trigger, causal timeline
+  (detect → action → resolution), implicated device/submission, and
+  resolution state. ``state=open|mitigating|resolved|unresolved``
+  filters; ``limit`` bounds (default 50); ``snippets=1`` attaches the
+  historian's metric-series snippets around each incident window.
+- ``GET /api/v1/incidents/{incident_id}`` — one incident with snippets.
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from backend.http import json_response
+from tpu_engine import historian as historian_mod
+from tpu_engine import tracing
+
+_STATES = ("open", "mitigating", "resolved", "unresolved")
+
+
+async def incidents_view(request: web.Request) -> web.Response:
+    state = request.query.get("state")
+    if state is not None and state not in _STATES:
+        return json_response(
+            {"error": f"unknown state {state!r}", "allowed": list(_STATES)},
+            status=400,
+        )
+    try:
+        limit = int(request.query.get("limit", "50"))
+    except ValueError:
+        return json_response({"error": "limit must be an integer"}, status=400)
+    corr = historian_mod.get_correlator()
+    corr.ingest(recorder=tracing.get_recorder())
+    hist = (
+        historian_mod.get_historian()
+        if request.query.get("snippets") in ("1", "true", "yes")
+        else None
+    )
+    return json_response(
+        {
+            "incidents": corr.incidents(
+                state=state, limit=limit, historian=hist
+            ),
+            "stats": corr.stats(),
+        }
+    )
+
+
+async def incident_view(request: web.Request) -> web.Response:
+    corr = historian_mod.get_correlator()
+    corr.ingest(recorder=tracing.get_recorder())
+    incident_id = request.match_info["incident_id"]
+    inc = corr.get(incident_id, historian=historian_mod.get_historian())
+    if inc is None:
+        return json_response(
+            {"error": f"unknown incident {incident_id!r}"}, status=404
+        )
+    return json_response(inc)
+
+
+def setup(app: web.Application, prefix: str = "/api/v1") -> None:
+    app.router.add_get(f"{prefix}/incidents", incidents_view)
+    app.router.add_get(f"{prefix}/incidents/{{incident_id}}", incident_view)

@@ -201,6 +201,9 @@ def main() -> None:
     twin_line = _twin_metric()
     if twin_line is not None:
         print(json.dumps(twin_line))
+    historian_line = _historian_metric()
+    if historian_line is not None:
+        print(json.dumps(historian_line))
 
 
 def _comm_compress_metric(n_dev: int) -> dict | None:
@@ -602,6 +605,22 @@ def _twin_metric() -> dict | None:
         from tpu_engine.twin import twin_bench_line
 
         return twin_bench_line(seed=0)
+    except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
+        return None
+
+
+def _historian_metric() -> dict | None:
+    """Eleventh JSON line: fleet-historian chaos-replay fidelity — the
+    seeded chaos trace is replayed from its JSONL alone and the rebuilt
+    metric history must match the live run within 1% per queried
+    aggregate, with every injected fault stitched into exactly one
+    resolved detect→action→resolution incident
+    (tpu_engine/historian.py via twin.historian_bench_line). Never fails
+    the bench: any error degrades to None."""
+    try:
+        from tpu_engine.twin import historian_bench_line
+
+        return historian_bench_line(seed=0)
     except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
         return None
 

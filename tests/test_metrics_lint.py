@@ -201,6 +201,40 @@ def test_trace_families_always_present(client):
         assert re.search(rf"^{family} ", text, re.M), family
 
 
+def test_historian_incident_families_always_present(client):
+    """The historian/incident plane exports even before any history is
+    retained — dashboards over retention health and incident counts must
+    never go 'no data', and every incident trigger is a labelled series
+    from the first scrape."""
+    text = _scrape(client)
+    for family in (
+        "tpu_engine_historian_series",
+        "tpu_engine_historian_samples_total",
+        "tpu_engine_historian_raw_samples",
+        "tpu_engine_historian_rollup_buckets",
+        "tpu_engine_historian_ticks_total",
+        "tpu_engine_historian_series_evicted_total",
+        "tpu_engine_historian_estimated_bytes",
+        "tpu_engine_incident_open",
+        "tpu_engine_incident_opened_total",
+        "tpu_engine_incident_resolved_total",
+        "tpu_engine_incident_correlated_records_total",
+        "tpu_engine_hetero_host_health",
+        "tpu_engine_metrics_scrape_seconds",
+    ):
+        assert re.search(rf"^{family}[ {{]", text, re.M), family
+    for trigger in ("fault", "anomaly", "slo_alert"):
+        assert re.search(
+            rf'^tpu_engine_incident_opened_total\{{trigger="{trigger}"\}} ',
+            text, re.M,
+        ), trigger
+    # The scrape records into the historian, so by the second scrape the
+    # store retains at least the scrape-time series it just wrote.
+    text2 = _scrape(client)
+    m = re.search(r"^tpu_engine_historian_samples_total (\d+)", text2, re.M)
+    assert m and int(m.group(1)) > 0, "scrape did not retain history"
+
+
 def test_twin_families_always_present(client):
     """The digital-twin plane exports even before any replay ran — an
     alerting rule on ingest skips must never go 'no data', and every

@@ -61,6 +61,8 @@ NOISY_KEYS = {
     "goodput_work_s_per_wall_s",
     "loss_delta_final",
     "fleet_seconds_per_cpu_second",
+    "ingest_samples_per_sec",
+    "query_avg_us",
 }
 
 
@@ -88,7 +90,7 @@ def collect_quick() -> list[dict]:
     from benchmarks.scheduler_sim import run_warm_admission
     from benchmarks.serving_fleet_sim import run_disagg_ab
     from tpu_engine.parallel.pipeline_zb import schedule_account
-    from tpu_engine.twin import twin_bench_line
+    from tpu_engine.twin import historian_bench_line, twin_bench_line
 
     trace = chaos_trace(seed=0)
     ab = run_disagg_ab(seed=0)
@@ -159,6 +161,7 @@ def collect_quick() -> list[dict]:
             "gates_pass": ab["gates_pass"],
         },
         twin_bench_line(seed=0),
+        historian_bench_line(seed=0),
     ]
 
 

@@ -35,7 +35,7 @@ from typing import Optional
 
 from pydantic import BaseModel, Field, model_validator
 
-from tpu_engine import tracing
+from tpu_engine import historian, tracing
 
 
 class FaultKind(str, enum.Enum):
@@ -357,6 +357,19 @@ class FaultInjector:
             trace_id="fleet",
             attrs={"step": step, "device_index": device_index, "detail": detail},
         )
+        # Retain the injection in the historian too, so incident windows
+        # can pull "faults over the last N minutes" as a series. Best
+        # effort: the injector must keep working if the historian is
+        # swapped mid-flight by a test.
+        try:
+            historian.get_historian().record(
+                "fault_injected",
+                1.0,
+                ts=self.events[-1].timestamp,
+                labels={"kind": kind},
+            )
+        except Exception:
+            pass
 
     def record(
         self,

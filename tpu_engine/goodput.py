@@ -40,11 +40,13 @@ render it for scrapers.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from collections import OrderedDict, deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from tpu_engine import historian as historian_mod
 from tpu_engine.tracing import FlightRecorder
 
 __all__ = [
@@ -660,6 +662,10 @@ class GoodputLedger:
 _SEVERITY_ORDER = {"ok": 0, "warning": 1, "page": 2}
 
 
+# Unique historian label per alerter instance (see SLOBurnRateAlerter).
+_ALERTER_SEQ = itertools.count(1)
+
+
 class SLOBurnRateAlerter:
     """Multi-window burn-rate alerting over two SLOs:
 
@@ -689,6 +695,7 @@ class SLOBurnRateAlerter:
         recorder: Optional[FlightRecorder] = None,
         clock: Optional[Callable[[], float]] = None,
         max_alerts: int = 256,
+        historian: Optional["historian_mod.MetricHistorian"] = None,
     ):
         self._lock = threading.RLock()
         self.ledger = ledger
@@ -704,9 +711,22 @@ class SLOBurnRateAlerter:
         self.state: Dict[str, str] = {"goodput": "ok", "serving_p99": "ok"}
         self.alerts: deque = deque(maxlen=int(max_alerts))
         self.alerts_total: Dict[str, int] = {}
-        # (ts, ok) p99 samples, bounded to the long window by count
-        self._p99_samples: deque = deque(maxlen=4096)
+        # p99 samples live in the historian (bounded there by the series
+        # raw ring), so the alert window and a `/history/query` over the
+        # same range can never disagree. Per-instance label: repeated
+        # constructions in one process never share a window.
+        self._historian = historian
+        self.p99_series = "slo_serving_p99_ms"
+        self.p99_ok_series = "slo_serving_p99_ok"
+        self.series_labels: Dict[str, str] = {
+            "alerter": str(next(_ALERTER_SEQ))
+        }
         self.last_eval: Optional[Dict[str, Any]] = None
+
+    def _hist(self) -> "historian_mod.MetricHistorian":
+        if self._historian is None:
+            self._historian = historian_mod.get_historian()
+        return self._historian
 
     # -- inputs --------------------------------------------------------------
 
@@ -716,7 +736,16 @@ class SLOBurnRateAlerter:
             return
         ts = self.clock() if ts is None else float(ts)
         with self._lock:
-            self._p99_samples.append((ts, float(p99_ms) <= self.p99_slo_ms))
+            hist = self._hist()
+            hist.record(
+                self.p99_series, float(p99_ms), ts=ts, labels=self.series_labels
+            )
+            hist.record(
+                self.p99_ok_series,
+                1.0 if float(p99_ms) <= self.p99_slo_ms else 0.0,
+                ts=ts,
+                labels=self.series_labels,
+            )
 
     # -- evaluation ----------------------------------------------------------
 
@@ -726,13 +755,17 @@ class SLOBurnRateAlerter:
         return bad_fraction / max(budget, 1e-9)
 
     def _p99_bad_fraction(self, window_s: float, now: float) -> Optional[float]:
-        lo = now - window_s
-        seen = bad = 0
-        for ts, ok in self._p99_samples:
-            if ts >= lo:
-                seen += 1
-                bad += 0 if ok else 1
-        return (bad / seen) if seen else None
+        q = self._hist().query(
+            self.p99_ok_series,
+            t0=now - window_s,
+            t1=now,
+            agg="avg",
+            labels=self.series_labels,
+            tier="raw",
+        )
+        if not q["count"]:
+            return None
+        return 1.0 - float(q["value"])
 
     def _severity(
         self, short_burn: Optional[float], long_burn: Optional[float]
@@ -829,7 +862,9 @@ class SLOBurnRateAlerter:
                     "target": self.serving_target,
                     "short_burn": sb_short,
                     "long_burn": sb_long,
-                    "samples": len(self._p99_samples),
+                    "samples": self._hist().raw_len(
+                        self.p99_ok_series, labels=self.series_labels
+                    ),
                 },
                 "thresholds": {
                     "warning_burn": self.warning_burn,

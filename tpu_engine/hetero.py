@@ -335,6 +335,47 @@ class ThroughputTracker:
             }
 
 
+# -- per-host health ----------------------------------------------------------
+
+
+def host_health(
+    n_hosts: int,
+    relative_throughput: Optional[Sequence[float]] = None,
+    quarantined_devices: Sequence[int] = (),
+    devices_per_host: int = 1,
+    fault_counts: Optional[dict[int, int]] = None,
+) -> list[float]:
+    """Composite 0–1 health score per host.
+
+    Folds the three degradation signals this plane already measures into
+    one scalar the historian can retain and the autopilot can threshold:
+    the tracker's relative-throughput EMA (clamped to [0, 1] — a host
+    running *faster* than the gang is healthy, not >1 healthy), a 4×
+    penalty while any of the host's devices sits in scheduler
+    quarantine, and a per-recent-fault penalty (40% each, floored at
+    0.2 so a flapping host stays visible instead of pinning to 0).
+    Pure function: callers map devices to hosts and window the fault
+    counts (``backend/routers/metrics.py`` uses the flight recorder's
+    recent fleet fault events).
+    """
+    n_hosts = max(1, int(n_hosts))
+    devices_per_host = max(1, int(devices_per_host))
+    rel = list(relative_throughput or [])
+    quarantined_hosts = {
+        int(d) // devices_per_host for d in quarantined_devices
+    }
+    scores = []
+    for h in range(n_hosts):
+        score = min(1.0, max(0.0, rel[h] if h < len(rel) else 1.0))
+        if h in quarantined_hosts:
+            score *= 0.25
+        faults = int((fault_counts or {}).get(h, 0))
+        if faults > 0:
+            score *= max(0.2, 1.0 - 0.4 * faults)
+        scores.append(score)
+    return scores
+
+
 # -- rebalance policy ---------------------------------------------------------
 
 

@@ -44,6 +44,7 @@ import jax
 from tpu_engine import compile_index as compile_index_mod
 from tpu_engine import goodput as goodput_mod
 from tpu_engine import hetero as hetero_mod
+from tpu_engine import historian as historian_mod
 from tpu_engine import tracing
 from tpu_engine.hbm_estimate import (
     HBMEstimate,
@@ -580,6 +581,23 @@ class FleetScheduler:
                 self._admit()
                 self._maybe_rebalance()
                 self._maybe_grow()
+            queued = len(self._queued())
+            running = len(self._active())
+            quarantined = len(self._hetero_quarantined)
+        # Retain queue depth per poll pass in the historian (outside the
+        # lock — the historian has its own). Best effort: scheduling must
+        # never fail because observability did.
+        try:
+            historian_mod.get_historian().record_many(
+                {
+                    "scheduler_queued": float(queued),
+                    "scheduler_running": float(running),
+                    "scheduler_quarantined_devices": float(quarantined),
+                },
+                ts=time.time(),
+            )
+        except Exception:
+            pass
 
     def wait(self, submission_id: str, timeout: Optional[float] = None) -> Submission:
         """Block until the submission reaches a terminal state."""

@@ -158,7 +158,9 @@ class TrainingJob:
         # auto-start a bounded XPlane capture via anomaly_trace_session
         # (any object with TraceSession's start(log_dir, duration_s)).
         self._anomaly = anomaly_detector or (
-            tracing.StepTimeAnomalyDetector() if anomaly_detection else None
+            tracing.StepTimeAnomalyDetector(series_labels={"job": job_id})
+            if anomaly_detection
+            else None
         )
         self._anomaly_trace_session = anomaly_trace_session
         self._anomaly_trace_dir = anomaly_trace_dir

@@ -33,6 +33,7 @@ when omitted, the recorder's ``clock`` (default ``time.time``) is used.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import threading
@@ -41,6 +42,8 @@ import uuid
 from collections import Counter, OrderedDict, deque
 from statistics import median
 from typing import Any, Callable, Dict, List, Optional, Union
+
+from tpu_engine import historian as historian_mod
 
 __all__ = [
     "SCHEMA_VERSION",
@@ -643,6 +646,12 @@ class FlightRecorder:
             }
 
 
+# Every detector instance gets a unique historian label so concurrent
+# jobs (and repeated constructions in one process) never share a
+# baseline series in the process-wide historian.
+_DETECTOR_SEQ = itertools.count(1)
+
+
 class StepTimeAnomalyDetector:
     """Sliding per-job step-latency baseline (Poplar-style continuous
     measurement: the per-step wall time IS the health signal).
@@ -652,7 +661,12 @@ class StepTimeAnomalyDetector:
     against the rolling median of recent *non-anomalous* steps (outliers
     are excluded from the baseline so a regression cannot normalise
     itself away). ``sustained`` turns true after ``sustained_k``
-    consecutive anomalous steps — the auto-trace trigger."""
+    consecutive anomalous steps — the auto-trace trigger.
+
+    The sample windows live in the :mod:`tpu_engine.historian` (every
+    observed duration in ``series``, the non-anomalous baseline window in
+    ``series + "_baseline"``), so a historian range query over
+    ``step_time_s`` sees exactly what the detector thresholds against."""
 
     def __init__(
         self,
@@ -661,23 +675,52 @@ class StepTimeAnomalyDetector:
         ratio: float = 1.75,
         min_excess_s: float = 0.025,
         sustained_k: int = 3,
+        historian: Optional["historian_mod.MetricHistorian"] = None,
+        series: str = "step_time_s",
+        series_labels: Optional[Dict[str, Any]] = None,
     ):
         self.window = int(window)
         self.warmup = max(1, int(warmup))
         self.ratio = float(ratio)
         self.min_excess_s = float(min_excess_s)
         self.sustained_k = max(1, int(sustained_k))
-        self._durations: deque = deque(maxlen=self.window)
+        self._historian = historian
+        self.series = series
+        self.baseline_series = series + "_baseline"
+        self.series_labels: Dict[str, str] = {
+            "detector": str(next(_DETECTOR_SEQ))
+        }
+        if series_labels:
+            self.series_labels.update(
+                {str(k): str(v) for k, v in series_labels.items()}
+            )
         self.consecutive = 0
         self.flagged_total = 0
 
+    def _hist(self) -> "historian_mod.MetricHistorian":
+        if self._historian is None:
+            self._historian = historian_mod.get_historian()
+        return self._historian
+
+    def _baseline_window(self) -> List[float]:
+        return self._hist().last_n(
+            self.baseline_series, self.window, labels=self.series_labels
+        )
+
     @property
     def baseline_s(self) -> Optional[float]:
-        if len(self._durations) < self.warmup:
+        window = self._baseline_window()
+        if len(window) < self.warmup:
             return None
-        return float(median(self._durations))
+        return float(median(window))
 
-    def observe(self, step: int, duration_s: float) -> Optional[Dict[str, Any]]:
+    def observe(
+        self, step: int, duration_s: float, ts: Optional[float] = None
+    ) -> Optional[Dict[str, Any]]:
+        hist = self._hist()
+        hist.record(
+            self.series, float(duration_s), ts=ts, labels=self.series_labels
+        )
         baseline = self.baseline_s
         anomalous = baseline is not None and duration_s > max(
             baseline * self.ratio, baseline + self.min_excess_s
@@ -693,13 +736,16 @@ class StepTimeAnomalyDetector:
                 "sustained": self.consecutive >= self.sustained_k,
             }
         self.consecutive = 0
-        self._durations.append(float(duration_s))
+        hist.record(
+            self.baseline_series, float(duration_s), ts=ts,
+            labels=self.series_labels,
+        )
         return None
 
     def summary(self) -> Dict[str, Any]:
         return {
             "baseline_s": self.baseline_s,
-            "observed": len(self._durations),
+            "observed": len(self._baseline_window()),
             "flagged_total": self.flagged_total,
             "consecutive": self.consecutive,
         }

@@ -56,6 +56,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from tpu_engine import hetero as hetero_mod
+from tpu_engine import historian as historian_mod
 from tpu_engine.compile_index import CompileCacheIndex
 from tpu_engine.faults import FaultInjector, FaultKind, FaultPlan, FaultSpec
 from tpu_engine.goodput import CATEGORIES, GoodputLedger, SLOBurnRateAlerter
@@ -92,6 +93,8 @@ __all__ = [
     "admission_policy_scorecard",
     "replay_fidelity",
     "twin_bench_line",
+    "historian_lane",
+    "historian_bench_line",
     "twin_stats",
 ]
 
@@ -1708,4 +1711,162 @@ def twin_bench_line(seed: int = 0) -> dict:
         "ab_wait_warm_s": adm["variants"]["warm_preferring"]["mean_wait_s"],
         "gates": gates,
         "ok": all(gates.values()),
+    }
+
+
+# -- historian lane ------------------------------------------------------------
+
+_HISTORIAN_FIDELITY_AGGS = ("avg", "min", "max", "last", "sum")
+
+
+def _fault_incidents(correlator: "historian_mod.IncidentCorrelator") -> List[dict]:
+    return [
+        i for i in correlator.incidents(limit=0) if i["trigger"] == "fault"
+    ]
+
+
+def _incident_chain_ok(inc: dict) -> bool:
+    """detect → action → resolution, in timestamp order, resolved."""
+    roles = [e["role"] for e in inc["timeline"]]
+    if "detect" not in roles or "action" not in roles or "resolution" not in roles:
+        return False
+    t_detect = min(e["ts"] for e in inc["timeline"] if e["role"] == "detect")
+    t_action = min(e["ts"] for e in inc["timeline"] if e["role"] == "action")
+    t_resol = min(e["ts"] for e in inc["timeline"] if e["role"] == "resolution")
+    return inc["state"] == "resolved" and t_detect <= t_action <= t_resol
+
+
+def historian_lane(seed: int = 0, n_faults: int = 12) -> dict:
+    """Record a chaos self-heal + goodput run to JSONL, build the live
+    historian series and incident set from the in-memory recorder, then
+    rebuild both from the persisted JSONL alone and diff — the
+    acceptance loop for the historian: a replayed trace must yield the
+    same metric history (per queried aggregate, within 1%) and the same
+    causally-chained incidents the live run produced, and every injected
+    fault must land in exactly one resolved detect→action→resolution
+    incident."""
+    params = TrainTwinParams()
+    with tempfile.TemporaryDirectory(prefix="twin_historian_") as root:
+        path = os.path.join(root, "trace.jsonl")
+        rec = FlightRecorder(
+            max_spans=16384, max_events=16384, clock=lambda: 0.0,
+            persist_path=path, persist_max_bytes=64 * 1024 * 1024,
+            id_factory=deterministic_ids("hist"),
+        )
+        tid = rec.new_trace_id()
+        index = CompileCacheIndex(path=None, default_cold_s=params.cold_compile_s)
+        seed_initial_compile(index, params)
+        events = chip_fault_timeline(seed, n_faults, params)
+        heal = replay_self_heal(
+            events, params, recorder=rec, trace_id=tid, compile_index=index
+        )
+        gp = goodput_lane(rec, tid, heal["wall_s"], full_gang=params.n_chips)
+        wall = heal["wall_s"]
+        counter_events = rec.events(kind="counter", limit=0)
+        live_hist = historian_mod.MetricHistorian(clock=lambda: 0.0)
+        t_ingest = time.perf_counter()
+        ingested = live_hist.ingest_counter_events(counter_events)
+        ingest_s = max(time.perf_counter() - t_ingest, 1e-9)
+        live_corr = historian_mod.IncidentCorrelator(
+            clock=lambda: wall, stale_after_s=1e9,
+        )
+        live_corr.ingest(recorder=rec, now=wall)
+        records, ingest_stats = read_recorder_jsonl(path)
+    replay_hist = historian_mod.MetricHistorian(clock=lambda: 0.0)
+    replay_hist.ingest_jsonl_records(records)
+    replay_corr = historian_mod.IncidentCorrelator(
+        clock=lambda: wall, stale_after_s=1e9,
+    )
+    replay_corr.ingest(records=records, now=wall)
+
+    # Per-series, per-aggregate fidelity of the rebuilt store.
+    max_err = 0.0
+    n_queries = 0
+    t_query = time.perf_counter()
+    for info in live_hist.series_list():
+        for agg in _HISTORIAN_FIDELITY_AGGS:
+            live_q = live_hist.query(
+                info["name"], t0=0.0, t1=wall + 120.0, agg=agg, tier="raw"
+            )
+            rep_q = replay_hist.query(
+                info["name"], t0=0.0, t1=wall + 120.0, agg=agg, tier="raw"
+            )
+            n_queries += 2
+            lv, rv = live_q["value"], rep_q["value"]
+            if lv is None and rv is None:
+                continue
+            if lv is None or rv is None:
+                max_err = float("inf")
+                continue
+            denom = max(abs(lv), 1e-9)
+            max_err = max(max_err, abs(lv - rv) / denom * 100.0)
+    query_s = max(time.perf_counter() - t_query, 1e-9)
+
+    live_faults = _fault_incidents(live_corr)
+    replay_faults = _fault_incidents(replay_corr)
+
+    def _fault_keys(incs: List[dict]) -> set:
+        keys = set()
+        for inc in incs:
+            detects = [e for e in inc["timeline"] if e["role"] == "detect"]
+            step = detects[0]["attrs"].get("step") if detects else None
+            keys.add((step, inc.get("device_index")))
+        return keys
+
+    # chip_fault_timeline dedups colliding steps, so the injected count
+    # is len(events), not necessarily n_faults.
+    injected = {(e["step"], e["device"]) for e in events}
+    gates = {
+        "series_within_1pct": max_err < 1.0,
+        "every_fault_one_incident": (
+            len(live_faults) == len(injected)
+            and _fault_keys(live_faults) == injected
+        ),
+        "causal_chains": all(_incident_chain_ok(i) for i in live_faults),
+        "replay_incidents_match": (
+            replay_corr.stats()["opened_by_trigger"]
+            == live_corr.stats()["opened_by_trigger"]
+            and replay_corr.stats()["resolved_total"]
+            == live_corr.stats()["resolved_total"]
+            and _fault_keys(replay_faults) == _fault_keys(live_faults)
+        ),
+        "nothing_skipped": ingest_stats["skipped"] == 0,
+    }
+    return {
+        "seed": seed,
+        "wall_s": wall,
+        "series": live_hist.stats()["series"],
+        "samples": live_hist.stats()["samples_total"],
+        "samples_ingested": ingested,
+        "incidents": live_corr.stats()["opened_by_trigger"],
+        "fault_incidents": len(live_faults),
+        "resolved_incidents": live_corr.stats()["resolved_total"],
+        "slo_progression": gp["slo"]["progression"][:3],
+        "max_series_error_pct": round(max_err, 6),
+        "ingest_samples_per_sec": round(ingested / ingest_s, 1),
+        "query_avg_us": round(query_s / max(n_queries, 1) * 1e6, 1),
+        "gates": gates,
+        "ok": all(gates.values()),
+    }
+
+
+def historian_bench_line(seed: int = 0) -> dict:
+    """The historian's deterministic bench line, shared by ``bench.py``
+    and ``tools/bench_sentinel.py``: series fidelity and incident
+    stitching on the seeded chaos trace, plus (noisy, ungated) ingest
+    and query throughput."""
+    lane = historian_lane(seed=seed)
+    return {
+        "metric": "historian_chaos_incidents",
+        "value": lane["max_series_error_pct"],
+        "unit": "max replayed-series error, % per queried aggregate",
+        "series": lane["series"],
+        "samples": lane["samples"],
+        "fault_incidents": lane["fault_incidents"],
+        "resolved_incidents": lane["resolved_incidents"],
+        "incidents_by_trigger": lane["incidents"],
+        "ingest_samples_per_sec": lane["ingest_samples_per_sec"],
+        "query_avg_us": lane["query_avg_us"],
+        "gates": lane["gates"],
+        "ok": lane["ok"],
     }
