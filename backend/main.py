@@ -20,6 +20,7 @@ from aiohttp import web
 from backend import openapi
 from backend.http import cors_middleware, error_middleware, json_response
 from backend.routers import (
+    autopilot,
     compile_cache,
     faults,
     goodput,
@@ -110,6 +111,13 @@ async def root(request: web.Request) -> web.Response:
                 "counter export, and an incident correlator stitching "
                 "faults/anomalies/SLO alerts and scheduler actions into "
                 "causal detect -> action -> resolution timelines",
+                "explainable fleet autopilot: one audited control loop "
+                "(subsuming the scheduler poll, serving autoscaler and "
+                "precompile ticks) that turns historian trends + incident "
+                "links into DecisionRecords — replan / rescale / drain / "
+                "kick-precompile or a structured suppression — with "
+                "hysteresis, per-target cooldowns, a blast-radius budget "
+                "and a byte-identical dry-run shadow mode",
                 "OpenAPI 3.1 schema (/openapi.json) and self-contained "
                 "/docs page",
             ],
@@ -129,6 +137,7 @@ async def root(request: web.Request) -> web.Response:
                 "twin": "/api/v1/twin",
                 "history": "/api/v1/history",
                 "incidents": "/api/v1/incidents",
+                "autopilot": "/api/v1/autopilot",
                 "metrics": "/metrics",
                 "openapi": "/openapi.json",
                 "docs": "/docs",
@@ -172,6 +181,7 @@ def create_app() -> web.Application:
     twin.setup(app)
     history.setup(app)
     incidents.setup(app)
+    autopilot.setup(app)
     serving.setup(app)
     metrics.setup(app)
     app.router.add_get("/", root)

@@ -1,0 +1,111 @@
+"""Autopilot routes — the query + control surface for
+``tpu_engine/autopilot.py``'s :class:`FleetAutopilot`:
+
+- ``GET /api/v1/autopilot`` — loop status: mode (armed vs dry-run),
+  tick/decision/actuation counters, suppression breakdown, guard config.
+- ``GET /api/v1/autopilot/decisions`` — the DecisionRecord stream,
+  newest-first: every actuation AND every suppression with its historian
+  query inputs, incident links, hysteresis state and outcome.
+  ``rule=``, ``outcome=fired|suppressed``, ``target=`` filter; ``limit``
+  bounds (default 50, ``0`` = all retained).
+- ``POST /api/v1/autopilot/tick`` — run one control pass now (the
+  headless/cron entry; a scrape never actuates, only this does).
+- ``POST /api/v1/autopilot/mode`` — body ``{"dry_run": bool}``: flip
+  shadow mode. Guard state carries over, so arming after a shadow soak
+  keeps the learned streaks and cooldowns.
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from backend.http import json_response
+from tpu_engine import autopilot as autopilot_mod
+
+
+def _status_payload(ap: "autopilot_mod.FleetAutopilot") -> dict:
+    cfg = ap.config
+    return {
+        "mode": "dry-run" if ap.dry_run else "armed",
+        "action_source": ap.action_source(),
+        "stats": ap.stats(),
+        "config": {
+            "trend_window_s": cfg.trend_window_s,
+            "sustain_consults": cfg.sustain_consults,
+            "rule_sustain": dict(cfg.rule_sustain),
+            "cooldown_s": cfg.cooldown_s,
+            "max_actions_per_window": cfg.max_actions_per_window,
+            "action_window_s": cfg.action_window_s,
+            "max_decisions": cfg.max_decisions,
+        },
+        "rules": list(autopilot_mod.RULES),
+        "suppression_reasons": list(autopilot_mod.SUPPRESSION_REASONS),
+    }
+
+
+async def autopilot_view(request: web.Request) -> web.Response:
+    return json_response(_status_payload(autopilot_mod.get_autopilot()))
+
+
+async def decisions_view(request: web.Request) -> web.Response:
+    rule = request.query.get("rule")
+    if rule is not None and rule not in autopilot_mod.RULES:
+        return json_response(
+            {"error": f"unknown rule {rule!r}",
+             "allowed": list(autopilot_mod.RULES)},
+            status=400,
+        )
+    outcome = request.query.get("outcome")
+    if outcome is not None and outcome not in autopilot_mod.OUTCOMES:
+        return json_response(
+            {"error": f"unknown outcome {outcome!r}",
+             "allowed": list(autopilot_mod.OUTCOMES)},
+            status=400,
+        )
+    try:
+        limit = int(request.query.get("limit", "50"))
+    except ValueError:
+        return json_response({"error": "limit must be an integer"}, status=400)
+    ap = autopilot_mod.get_autopilot()
+    return json_response(
+        {
+            "decisions": ap.decisions(
+                limit=limit, rule=rule, outcome=outcome,
+                target=request.query.get("target"),
+            ),
+            "stats": ap.stats(),
+        }
+    )
+
+
+async def tick_view(request: web.Request) -> web.Response:
+    ap = autopilot_mod.get_autopilot()
+    records = ap.tick()
+    return json_response(
+        {
+            "decisions": [r.to_dict() for r in records],
+            "stats": ap.stats(),
+        }
+    )
+
+
+async def mode_view(request: web.Request) -> web.Response:
+    try:
+        body = await request.json()
+    except Exception:
+        return json_response({"error": "body must be JSON"}, status=400)
+    dry_run = body.get("dry_run")
+    if not isinstance(dry_run, bool):
+        return json_response(
+            {"error": "body must carry a boolean 'dry_run'"}, status=400
+        )
+    ap = autopilot_mod.get_autopilot()
+    ap.set_dry_run(dry_run)
+    return json_response(_status_payload(ap))
+
+
+def setup(app: web.Application, prefix: str = "/api/v1") -> None:
+    app.router.add_get(f"{prefix}/autopilot", autopilot_view)
+    app.router.add_get(f"{prefix}/autopilot/decisions", decisions_view)
+    app.router.add_post(f"{prefix}/autopilot/tick", tick_view)
+    app.router.add_post(f"{prefix}/autopilot/mode", mode_view)

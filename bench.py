@@ -204,6 +204,9 @@ def main() -> None:
     historian_line = _historian_metric()
     if historian_line is not None:
         print(json.dumps(historian_line))
+    autopilot_line = _autopilot_metric()
+    if autopilot_line is not None:
+        print(json.dumps(autopilot_line))
 
 
 def _comm_compress_metric(n_dev: int) -> dict | None:
@@ -621,6 +624,21 @@ def _historian_metric() -> dict | None:
         from tpu_engine.twin import historian_bench_line
 
         return historian_bench_line(seed=0)
+    except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
+        return None
+
+
+def _autopilot_metric() -> dict | None:
+    """Twelfth JSON line: autopilot chaos A/B — steady-state goodput on
+    the seeded slow-host trace with the armed autopilot (drains the
+    blamed host off historian trends + incident links) vs the loop off,
+    plus the dry-run shadow stream (same decisions, zero actuations)
+    (tpu_engine/twin.py autopilot lane, deterministic virtual clock).
+    Never fails the bench: any error degrades to None."""
+    try:
+        from tpu_engine.twin import autopilot_bench_line
+
+        return autopilot_bench_line(seed=0)
     except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
         return None
 

@@ -130,6 +130,12 @@ def run_hetero_lane(
     return twin_mod.run_hetero_ab(seed, HET_PARAMS, recorder=recorder)
 
 
+def run_autopilot_lane(seed: int = 0) -> dict:
+    """Autopilot armed vs off vs dry-run on the seeded slow-host chaos
+    plan (see :func:`tpu_engine.twin.autopilot_lane`)."""
+    return twin_mod.autopilot_lane(seed, HET_PARAMS)
+
+
 def goodput_lane(
     recorder: FlightRecorder, trace_id: str, wall: float
 ) -> dict:
@@ -215,6 +221,7 @@ def main() -> None:
         recorder = FlightRecorder(persist_path=args.trace_jsonl or None)
     trace = run_trace(args.seed, n_faults=args.faults, recorder=recorder)
     trace["hetero"] = run_hetero_lane(args.seed, recorder=recorder)
+    trace["autopilot"] = run_autopilot_lane(args.seed)
     if recorder is not None and args.trace_out:
         doc = recorder.export_chrome_trace()
         with open(args.trace_out, "w", encoding="utf-8") as f:
@@ -290,7 +297,27 @@ def main() -> None:
         "assignment": het["rebalance_on"]["assignment"],
         "ok": het_ok,
     }))
-    if not (ok and het_ok):
+    ap = trace["autopilot"]
+    # The lane's own gates already cover: armed goodput >= off, the armed
+    # loop drained exactly the seeded slow host, dry-run produced the
+    # decision stream with zero actuations, every decision carries
+    # historian query inputs + incident links, and the correlator holds
+    # the decision as the incident's action leg with the right source.
+    ap_ok = ap["ok"] and ap["steady_goodput_on"] >= ap["steady_goodput_off"]
+    print(json.dumps({
+        "metric": "chaos_autopilot_goodput",
+        "value": ap["steady_goodput_on"],
+        "unit": "steady-state chaos goodput, autopilot armed (off = baseline)",
+        "autopilot_off": ap["steady_goodput_off"],
+        "autopilot_dry_run": ap["steady_goodput_dry"],
+        "goodput_recovered": ap["goodput_recovered"],
+        "decisions_armed": ap["armed"]["decisions_total"],
+        "actuations_armed": ap["armed"]["actuations_total"],
+        "actuations_dry_run": ap["dry_run"]["actuations_total"],
+        "gates": ap["gates"],
+        "ok": ap_ok,
+    }))
+    if not (ok and het_ok and ap_ok):
         raise SystemExit(1)
 
 

@@ -235,6 +235,38 @@ def test_historian_incident_families_always_present(client):
     assert m and int(m.group(1)) > 0, "scrape did not retain history"
 
 
+def test_autopilot_families_always_present(client):
+    """The autopilot plane exports even before the loop ever ticked — a
+    burn-rate rule on suppressions or a 'shadow mode left on' alert must
+    never go 'no data', and every outcome/rule/reason is a labelled
+    series from the first scrape."""
+    text = _scrape(client)
+    for family in (
+        "tpu_engine_autopilot_armed",
+        "tpu_engine_autopilot_ticks_total",
+        "tpu_engine_autopilot_decisions_retained",
+        "tpu_engine_autopilot_decisions_dropped_total",
+    ):
+        assert re.search(rf"^{family} ", text, re.M), family
+    from tpu_engine.autopilot import RULES, SUPPRESSION_REASONS
+
+    for outcome in ("fired", "suppressed"):
+        assert re.search(
+            rf'^tpu_engine_autopilot_decisions_total\{{outcome="{outcome}"\}} ',
+            text, re.M,
+        ), outcome
+    for rule in RULES:
+        assert re.search(
+            rf'^tpu_engine_autopilot_actuations_total\{{rule="{rule}"\}} ',
+            text, re.M,
+        ), rule
+    for reason in SUPPRESSION_REASONS:
+        assert re.search(
+            rf'^tpu_engine_autopilot_suppressions_total\{{reason="{reason}"\}} ',
+            text, re.M,
+        ), reason
+
+
 def test_twin_families_always_present(client):
     """The digital-twin plane exports even before any replay ran — an
     alerting rule on ingest skips must never go 'no data', and every

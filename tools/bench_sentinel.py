@@ -90,7 +90,11 @@ def collect_quick() -> list[dict]:
     from benchmarks.scheduler_sim import run_warm_admission
     from benchmarks.serving_fleet_sim import run_disagg_ab
     from tpu_engine.parallel.pipeline_zb import schedule_account
-    from tpu_engine.twin import historian_bench_line, twin_bench_line
+    from tpu_engine.twin import (
+        autopilot_bench_line,
+        historian_bench_line,
+        twin_bench_line,
+    )
 
     trace = chaos_trace(seed=0)
     ab = run_disagg_ab(seed=0)
@@ -162,6 +166,7 @@ def collect_quick() -> list[dict]:
         },
         twin_bench_line(seed=0),
         historian_bench_line(seed=0),
+        autopilot_bench_line(seed=0),
     ]
 
 

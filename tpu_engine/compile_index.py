@@ -511,6 +511,11 @@ class PrecompileWorker:
     lowering via ``benchmarks/aot.py``; tests and simulators inject a stub.
     Consults the process fault injector's ``precompile-error`` seam before
     every attempt, so chaos plans can break this path deterministically.
+
+    With ``background=False`` no worker thread is ever spawned: requests
+    queue and a caller drains them synchronously via :meth:`pump` — the
+    autopilot's unified tick subsumes this worker that way, keeping the
+    whole control loop single-threaded and virtual-clock-driven.
     """
 
     def __init__(
@@ -519,11 +524,13 @@ class PrecompileWorker:
         compile_fn: Optional[Callable[[PrecompileTask], None]] = None,
         max_pending: int = 4,
         clock: Callable[[], float] = time.time,
+        background: bool = True,
     ):
         self.index = index
         self.compile_fn = compile_fn or _default_precompile
         self.max_pending = max_pending
         self.clock = clock
+        self.background = bool(background)
         self._lock = threading.Lock()
         self._tasks: dict[str, PrecompileTask] = {}
         self._queue: collections.deque[str] = collections.deque()
@@ -567,8 +574,9 @@ class PrecompileWorker:
                     if t.state in ("warm", "failed")
                 ][: len(self._tasks) - (4 * self.max_pending + 16)]:
                     del self._tasks[k]
-        self._ensure_thread()
-        self._wake.set()
+        if self.background:
+            self._ensure_thread()
+            self._wake.set()
         return "queued"
 
     def status(self, key: str) -> Optional[str]:
@@ -598,6 +606,21 @@ class PrecompileWorker:
                 "rejected_total": self.rejected_total,
                 "max_pending": self.max_pending,
             }
+
+    def pump(self, max_tasks: Optional[int] = None) -> int:
+        """Drain queued tasks inline on the caller's thread (the same
+        locked pop as the background loop, so both modes can coexist).
+        Returns the number of tasks run."""
+        ran = 0
+        while max_tasks is None or ran < max_tasks:
+            with self._lock:
+                key = self._queue.popleft() if self._queue else None
+                task = self._tasks.get(key) if key else None
+            if task is None:
+                break
+            self._run_one(task)
+            ran += 1
+        return ran
 
     def shutdown(self) -> None:
         self._shutdown.set()

@@ -516,8 +516,12 @@ class MetricHistorian:
 #: existing claims them.
 _TRIGGER_KINDS = ("fault", "anomaly", "slo_alert")
 #: Action kinds: attach to an incident (via parent link or adjacency) and
-#: move it to ``mitigating``.
-_ACTION_KINDS = ("scheduler", "admission", "emergency_save", "compile", "hetero")
+#: move it to ``mitigating``. ``autopilot`` spans are the control loop's
+#: DecisionRecord mirrors (``tpu_engine/autopilot.py``).
+_ACTION_KINDS = (
+    "scheduler", "admission", "emergency_save", "compile", "hetero",
+    "autopilot",
+)
 #: Records that resolve an incident.
 _RESOLUTION_NAMES = ("resume", "grow_back", "hetero_quarantine_release")
 
@@ -562,15 +566,19 @@ class Incident:
 
     def add(self, role: str, rec: Dict[str, Any]) -> None:
         attrs = rec.get("attrs") or {}
-        self.timeline.append(
-            {
-                "ts": rec["ts"],
-                "role": role,
-                "kind": rec["kind"],
-                "name": rec["name"],
-                "attrs": dict(attrs),
-            }
-        )
+        entry = {
+            "ts": rec["ts"],
+            "role": role,
+            "kind": rec["kind"],
+            "name": rec["name"],
+            "attrs": dict(attrs),
+        }
+        if role == "action":
+            # Who acted: autopilot decision mirrors carry their own
+            # source (``autopilot`` | ``autopilot-dryrun``); every other
+            # action leg is human-operated machinery.
+            entry["action_source"] = attrs.get("action_source") or "human"
+        self.timeline.append(entry)
         self.t1 = max(self.t1, rec.get("t_end") or rec["ts"])
         if self.device_index is None:
             d = attrs.get("device", attrs.get("device_index"))
@@ -862,6 +870,25 @@ class IncidentCorrelator:
             i.to_dict(historian=historian, snippet_series=snippet_series)
             for i in reversed(all_inc)
         ]
+
+    def open_refs(self, limit: int = 8) -> List[Dict[str, Any]]:
+        """Lightweight refs to open incidents, newest-first — the
+        autopilot copies these ids into every DecisionRecord's inputs
+        without paying for full timelines."""
+        with self._lock:
+            out = [
+                {
+                    "incident_id": inc.incident_id,
+                    "trigger": inc.trigger,
+                    "state": inc.state,
+                    "t0": inc.t0,
+                    "trace_id": inc.trace_id,
+                    "device_index": inc.device_index,
+                    "slo": inc.slo,
+                }
+                for inc in reversed(self._open)
+            ]
+        return out[: max(0, int(limit))] if limit else out
 
     def get(
         self, incident_id: str, historian: Optional[MetricHistorian] = None

@@ -566,6 +566,91 @@ class FleetScheduler:
             self._draining = False
         self._wake.set()
 
+    # -- external control surface (the autopilot's actuators) -----------------
+
+    def quarantine_device(
+        self,
+        device_index: int,
+        owner: str = "autopilot",
+        now: Optional[float] = None,
+    ) -> bool:
+        """Quarantine one device out of admission on behalf of an external
+        controller. Entries tagged ``source="autopilot"`` skip the
+        owner-vouch healing in ``_heal_quarantine`` (no submission will
+        ever vouch for them): only the quarantine TTL or an explicit
+        :meth:`release_quarantine` returns the chip. Returns False when
+        the device is already quarantined."""
+        idx = int(device_index)
+        now = time.time() if now is None else float(now)
+        with self._lock:
+            if idx in self._hetero_quarantined:
+                return False
+            self._hetero_quarantined[idx] = {
+                "owner": owner, "ts": now, "source": "autopilot",
+            }
+        tracing.get_recorder().event(
+            "hetero_quarantine",
+            kind="scheduler",
+            trace_id="fleet",
+            attrs={"devices": [idx], "owner": owner, "source": "autopilot"},
+        )
+        return True
+
+    def release_quarantine(self, device_index: int) -> bool:
+        """Explicitly release one quarantined device (any owner)."""
+        idx = int(device_index)
+        with self._lock:
+            if idx not in self._hetero_quarantined:
+                return False
+            del self._hetero_quarantined[idx]
+        tracing.get_recorder().event(
+            "hetero_quarantine_release",
+            kind="hetero",
+            trace_id="fleet",
+            attrs={"devices": [idx], "reason": "released"},
+        )
+        return True
+
+    def request_replan(self, submission_id: Optional[str] = None) -> bool:
+        """Ask a running training job to consult its heterogeneity
+        rebalancer at the next safe step boundary — the autopilot's
+        replan actuator. Targets ``submission_id`` when given, else the
+        first RUNNING training job with a heterogeneity plane. The job's
+        own rebalancer still applies its hysteresis (cooldown, sustain,
+        min-gain); avoided-shrink accounting settles through the normal
+        ``_resolve_hetero_consults`` path. Returns True when a consult
+        was requested."""
+        with self._lock:
+            subs = (
+                [self._subs.get(submission_id)]
+                if submission_id is not None
+                else list(self._subs.values())
+            )
+            for sub in subs:
+                if sub is None or sub.state != SubmissionState.RUNNING:
+                    continue
+                if sub.workload != "training":
+                    continue
+                reb = getattr(sub.job, "_hetero", None)
+                if reb is None:
+                    continue
+                self._hetero_pending[sub.submission_id] = (
+                    reb.rebalances_total + reb.dry_runs_total
+                )
+                reb.request_consult()
+                tracing.get_recorder().event(
+                    "replan_requested",
+                    kind="scheduler",
+                    trace_id=sub.trace_id,
+                    parent=sub._root_span,
+                    attrs={
+                        "submission_id": sub.submission_id,
+                        "consult_requested": True,
+                    },
+                )
+                return True
+        return False
+
     @property
     def draining(self) -> bool:
         return self._draining
@@ -1148,8 +1233,21 @@ class FleetScheduler:
             return
         released: dict[str, list[int]] = {}
         for idx, ent in list(self._hetero_quarantined.items()):
-            sub = self._subs.get(ent["owner"])
             reason = None
+            if ent.get("source") == "autopilot":
+                # Autopilot drains have no owning submission to vouch for
+                # them — only the TTL below or an explicit
+                # release_quarantine() returns the chips.
+                if (
+                    self.hetero_quarantine_ttl_s > 0
+                    and now - ent["ts"] >= self.hetero_quarantine_ttl_s
+                ):
+                    reason = "ttl-expired"
+                if reason is not None:
+                    del self._hetero_quarantined[idx]
+                    released.setdefault(reason, []).append(idx)
+                continue
+            sub = self._subs.get(ent["owner"])
             if sub is None or sub.state in TERMINAL_STATES:
                 # Finished/failed/cancelled owners are kept in _subs as
                 # history; their quarantine must not outlive them.

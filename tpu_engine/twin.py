@@ -57,6 +57,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from tpu_engine import hetero as hetero_mod
 from tpu_engine import historian as historian_mod
+from tpu_engine.autopilot import AutopilotConfig, FleetAutopilot
 from tpu_engine.compile_index import CompileCacheIndex
 from tpu_engine.faults import FaultInjector, FaultKind, FaultPlan, FaultSpec
 from tpu_engine.goodput import CATEGORIES, GoodputLedger, SLOBurnRateAlerter
@@ -95,6 +96,9 @@ __all__ = [
     "twin_bench_line",
     "historian_lane",
     "historian_bench_line",
+    "replay_autopilot",
+    "autopilot_lane",
+    "autopilot_bench_line",
     "twin_stats",
 ]
 
@@ -1867,6 +1871,241 @@ def historian_bench_line(seed: int = 0) -> dict:
         "incidents_by_trigger": lane["incidents"],
         "ingest_samples_per_sec": lane["ingest_samples_per_sec"],
         "query_avg_us": lane["query_avg_us"],
+        "gates": lane["gates"],
+        "ok": lane["ok"],
+    }
+
+# -- autopilot lane ------------------------------------------------------------
+
+
+def replay_autopilot(
+    mode: str,
+    plan: FaultPlan,
+    params: HeteroTwinParams = HeteroTwinParams(),
+) -> dict:
+    """Replay the seeded slow-host chaos plan under one autopilot mode on
+    the virtual clock: ``"off"`` (no control loop — the uniform gang
+    gates on the slow host forever), ``"armed"`` (the autopilot's
+    drain-host rule sheds the blamed host after its hysteresis clears),
+    or ``"dry-run"`` (the full decision stream, zero actuations).
+
+    The injector is both truth and signal, as in :func:`replay_hetero`:
+    each consumed HOST_SLOW spec slows the simulated host and is
+    mirrored as a ``kind="fault"`` blame event on the lane recorder; the
+    lane also retains per-step time and per-host health into its own
+    historian, so every autopilot decision consults real range queries
+    over the exact series a live fleet would have."""
+    hosts = params.hosts
+    rows_u = params.global_micro // hosts
+    vclock = VirtualClock(0.0)
+    rec = FlightRecorder(
+        max_spans=8192, max_events=8192, clock=vclock,
+        id_factory=deterministic_ids(f"ap-{mode}"),
+    )
+    hist = historian_mod.MetricHistorian(clock=vclock)
+    # Sustained degradation is ONE incident: successive blame events land
+    # well inside the widened merge window instead of opening per-step
+    # incidents.
+    corr = historian_mod.IncidentCorrelator(
+        clock=vclock, merge_window_s=4.0 * params.step_time_s,
+        stale_after_s=1e9,
+    )
+    inj = FaultInjector(plan)
+    inj.arm()
+    rate = [1.0] * hosts
+    drained = [False] * hosts
+
+    def drain_actuator(record) -> None:
+        drained[int(record.action["params"]["device_index"])] = True
+
+    autopilot = FleetAutopilot(
+        AutopilotConfig(
+            trend_window_s=60.0,
+            sustain_consults=3,
+            cooldown_s=120.0,
+            max_actions_per_window=2,
+            action_window_s=600.0,
+            fault_blame_threshold=3,
+            host_health_floor=0.9,
+        ),
+        dry_run=(mode == "dry-run"),
+        historian=hist,
+        correlator=corr,
+        recorder=rec,
+        actuators={} if mode == "off" else {"drain_host": drain_actuator},
+        gauges_fn=lambda: {
+            f"host_health_{h}": (0.0 if drained[h] else rate[h])
+            for h in range(hosts)
+        },
+        clock=vclock,
+        id_factory=deterministic_ids("apd"),
+        trace_id="fleet",
+    )
+    downtime_s = 0.0
+    ideal_wall = 0.0
+    tail_wall = tail_ideal = 0.0
+    for step in range(1, params.steps + 1):
+        spec = inj.take_host_slow(step)
+        if spec is not None:
+            idx = int(spec.device_index or 0)
+            if not drained[idx]:
+                rate[idx] = params.step_time_s / (
+                    params.step_time_s + float(spec.slow_s)
+                )
+                rec.event(
+                    "host_slow", kind="fault", trace_id="fleet", ts=vclock.t,
+                    attrs={"step": step, "device_index": idx,
+                           "slow_s": float(spec.slow_s)},
+                )
+        active = [h for h in range(hosts) if not drained[h]]
+        rows_h = params.global_micro / len(active)
+        step_s = max(
+            rows_h * params.step_time_s / (rows_u * rate[h]) for h in active
+        )
+        ideal_s = params.global_micro * params.step_time_s / (
+            rows_u * sum(rate)
+        )
+        now = vclock.advance(step_s)
+        ideal_wall += ideal_s
+        hist.record("step_time_s", step_s, ts=now)
+        for h in range(hosts):
+            hist.record(
+                "hetero_host_health", 0.0 if drained[h] else rate[h],
+                ts=now, labels={"host": str(h)},
+            )
+        if mode != "off" and step % params.check_every == 0:
+            before = sum(drained)
+            autopilot.tick(now=now)
+            if sum(drained) > before:
+                # Shedding a host is an emergency save + re-admit + cold
+                # compile, exactly the shrink path's price.
+                downtime_s += (
+                    params.ckpt_save_s + params.resume_admit_s
+                    + params.cold_compile_s
+                )
+                vclock.advance(
+                    params.ckpt_save_s + params.resume_admit_s
+                    + params.cold_compile_s
+                )
+        if step > params.steps - params.tail_steps:
+            tail_wall += step_s
+            tail_ideal += ideal_s
+    stats = autopilot.stats()
+    return {
+        "mode": mode,
+        "wall_s": round(vclock.t, 1),
+        "ideal_wall_s": round(ideal_wall, 1),
+        "downtime_s": round(downtime_s, 1),
+        "goodput": round(ideal_wall / vclock.t, 4),
+        "steady_goodput": round(tail_ideal / tail_wall, 4),
+        "drained_hosts": [h for h in range(hosts) if drained[h]],
+        "autopilot": stats,
+        "decisions": autopilot.decisions(limit=0),
+        "incidents": corr.incidents(limit=0),
+        "incident_stats": corr.stats(),
+    }
+
+
+def _autopilot_action_legs(incidents: List[dict]) -> List[dict]:
+    return [
+        e
+        for inc in incidents
+        for e in inc["timeline"]
+        if e["role"] == "action" and e["kind"] == "autopilot"
+    ]
+
+
+def autopilot_lane(
+    seed: int = 0, params: HeteroTwinParams = HeteroTwinParams()
+) -> dict:
+    """Chaos A/B for the autopilot: armed vs off vs dry-run on one seeded
+    slow-host fault plan. Gates: the armed loop's steady-state goodput
+    beats (or matches) the uncontrolled fleet; dry-run emits the decision
+    stream with zero actuations; every decision carries historian query
+    inputs and its incident link; and the correlator shows the decision
+    as the incident's action leg with the right ``action_source``."""
+    plan = host_slow_plan(seed, params)
+    slow_host = int(plan.specs[0].device_index or 0)
+    off = replay_autopilot("off", plan, params)
+    on = replay_autopilot("armed", plan, params)
+    dry = replay_autopilot("dry-run", plan, params)
+    explained = [
+        d
+        for run in (on, dry)
+        for d in run["decisions"]
+    ]
+    gates = {
+        "autopilot_on_ge_off": on["steady_goodput"] >= off["steady_goodput"],
+        "armed_drained_slow_host": on["drained_hosts"] == [slow_host],
+        "dry_run_zero_actuations": (
+            dry["autopilot"]["actuations_total"] == 0
+            and dry["drained_hosts"] == []
+        ),
+        "dry_run_emits_decisions": (
+            dry["autopilot"]["decisions_total"] > 0
+            and dry["autopilot"]["fired_total"] > 0
+        ),
+        "every_decision_explainable": bool(explained) and all(
+            d["inputs"]["queries"]
+            and d["inputs"]["incidents"]
+            and d["hysteresis"]["required"] >= 1
+            for d in explained
+        ),
+        "action_leg_sourced": (
+            all(
+                leg["action_source"] == "autopilot"
+                for leg in _autopilot_action_legs(on["incidents"])
+            )
+            and all(
+                leg["action_source"] == "autopilot-dryrun"
+                for leg in _autopilot_action_legs(dry["incidents"])
+            )
+            and bool(_autopilot_action_legs(on["incidents"]))
+            and bool(_autopilot_action_legs(dry["incidents"]))
+        ),
+    }
+    return {
+        "seed": seed,
+        "slow_host": slow_host,
+        "steady_goodput_on": on["steady_goodput"],
+        "steady_goodput_off": off["steady_goodput"],
+        "steady_goodput_dry": dry["steady_goodput"],
+        "goodput_recovered": round(
+            on["steady_goodput"] - off["steady_goodput"], 4
+        ),
+        "armed": {
+            k: on["autopilot"][k]
+            for k in ("decisions_total", "fired_total", "suppressed_total",
+                      "actuations_total", "suppressed_by_reason")
+        },
+        "dry_run": {
+            k: dry["autopilot"][k]
+            for k in ("decisions_total", "fired_total", "suppressed_total",
+                      "actuations_total", "suppressed_by_reason")
+        },
+        "incidents_armed": on["incident_stats"]["opened_by_trigger"],
+        "gates": gates,
+        "ok": all(gates.values()),
+    }
+
+
+def autopilot_bench_line(seed: int = 0) -> dict:
+    """The autopilot's deterministic bench line, shared by ``bench.py``
+    and ``tools/bench_sentinel.py``: chaos goodput A/B (armed vs off vs
+    shadow) plus the decision-stream accounting on the seeded slow-host
+    plan."""
+    lane = autopilot_lane(seed=seed)
+    return {
+        "metric": "autopilot_chaos_ab",
+        "value": lane["steady_goodput_on"],
+        "unit": "steady-state chaos goodput, autopilot armed",
+        "steady_goodput_off": lane["steady_goodput_off"],
+        "steady_goodput_dry": lane["steady_goodput_dry"],
+        "goodput_recovered": lane["goodput_recovered"],
+        "decisions_armed": lane["armed"]["decisions_total"],
+        "actuations_armed": lane["armed"]["actuations_total"],
+        "decisions_dry": lane["dry_run"]["decisions_total"],
+        "actuations_dry": lane["dry_run"]["actuations_total"],
         "gates": lane["gates"],
         "ok": lane["ok"],
     }
