@@ -207,6 +207,9 @@ def main() -> None:
     autopilot_line = _autopilot_metric()
     if autopilot_line is not None:
         print(json.dumps(autopilot_line))
+    ctl_scale_line = _ctl_scale_metric()
+    if ctl_scale_line is not None:
+        print(json.dumps(ctl_scale_line))
 
 
 def _comm_compress_metric(n_dev: int) -> dict | None:
@@ -639,6 +642,22 @@ def _autopilot_metric() -> dict | None:
         from tpu_engine.twin import autopilot_bench_line
 
         return autopilot_bench_line(seed=0)
+    except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
+        return None
+
+
+def _ctl_scale_metric() -> dict | None:
+    """Thirteenth JSON line: control-plane scale — 100k submissions and
+    1M serving requests pushed through the real scheduler, router,
+    historian and incident correlator under the virtual clock, gating
+    that control overhead per simulated fleet-second stays flat (<=1.25x
+    vs the 1k-job config) and every ring stays at its cap
+    (tpu_engine/twin.py scale lane). Never fails the bench: any error
+    degrades to None."""
+    try:
+        from tpu_engine.twin import ctl_scale_bench_line
+
+        return ctl_scale_bench_line(seed=0)
     except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
         return None
 

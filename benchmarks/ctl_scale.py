@@ -1,0 +1,70 @@
+"""Control-plane scale lane: 100k jobs / 1M requests, flat overhead.
+
+Runs :func:`tpu_engine.twin.ctl_scale_profile` — the twin-driven lane
+that pushes ~100k submissions through the real
+:class:`~tpu_engine.scheduler.FleetScheduler` and ~1M serving requests
+through the real :class:`~tpu_engine.serving_fleet.FleetRouter`, with
+the real :class:`~tpu_engine.historian.MetricHistorian` and
+:class:`~tpu_engine.historian.IncidentCorrelator` ingesting the whole
+run under the virtual clock — and prints the profile plus the bench
+line (``JAX_PLATFORMS=cpu python -m benchmarks.ctl_scale``).
+
+Exit gates (process exits 1 when any fails):
+
+- ``deterministic`` — five runs of the small config produce
+  byte-identical deterministic counts (jobs, routes, incidents);
+- ``overhead_flat_1k_to_100k`` — marginal control cost per job and per
+  request at 100k jobs / 1M requests is <= 1.25x the small (1k/10k)
+  config's median (the per-fleet-second overheads are reported too, but
+  the tiny config spends a large share of its wall in half-empty
+  ramp/drain tails, so the marginal cost is the scale-clean signal);
+- ``all_jobs_completed`` / ``requests_routed_98pct`` — nothing wedges
+  at depth;
+- ``rings_bounded`` — recorder spans/events, historian raw windows,
+  incident store, and scheduler finished-history all sit at or under
+  their caps after the big run (the live set is bounded, which is what
+  keeps the overhead flat in the first place).
+
+Measured with ``time.process_time()`` and the collector paused (the
+lane separately proves the live set is bounded, so steady-state GC cost
+is flat); when the ratio gate trips, profile the frames with
+``python tools/ctl_profile.py --jobs 100000 --requests 1000000``.
+"""
+
+from __future__ import annotations
+
+import json
+
+from tpu_engine.twin import ctl_scale_bench_line, ctl_scale_profile
+
+
+def main() -> None:
+    prof = ctl_scale_profile(seed=0)
+    print(json.dumps({
+        "small": {k: prof["small"][k] for k in (
+            "params", "phases", "control_s", "sim_fleet_s", "work_fleet_s",
+            "overhead_us_per_fleet_s", "control_us_per_job",
+            "control_us_per_request", "rings",
+        )},
+        "big": {k: prof["big"][k] for k in (
+            "params", "phases", "control_s", "sim_fleet_s", "work_fleet_s",
+            "overhead_us_per_fleet_s", "control_us_per_job",
+            "control_us_per_request", "rings",
+        )},
+        "overhead_small_us_per_fleet_s": prof["overhead_small_us_per_fleet_s"],
+        "overhead_small_spread_us": prof["overhead_small_spread_us"],
+        "overhead_big_us_per_fleet_s": prof["overhead_big_us_per_fleet_s"],
+        "per_job_us": prof["per_job_us"],
+        "per_request_us": prof["per_request_us"],
+        "overhead_ratio": prof["overhead_ratio"],
+        "gates": prof["gates"],
+        "ok": prof["ok"],
+    }, indent=2))
+    line = ctl_scale_bench_line(seed=0, profile=prof)
+    print(json.dumps(line))
+    if not (prof["ok"] and line["ok"]):
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,241 @@
+"""Bounded control-plane structures at depth.
+
+Drives 50k+ samples/records through every bounded ring — historian
+raw/rollup rings, flight-recorder span/event rings, autopilot decision
+ring, incident store — and asserts each holds its configured bound with
+exact (byte-stable) eviction counters, mirroring the 10k-tick historian
+plateau test. Also pins the scrape-cost contract: a metrics scrape of
+the scheduler reads the state indexes, never a ``_subs`` scan, and never
+mutates scheduler state.
+
+Everything here runs on the synthetic clock (tier 1, no sleeps).
+"""
+
+import math
+import random
+
+from tpu_engine.autopilot import AutopilotConfig, DecisionRecord, FleetAutopilot
+from tpu_engine.historian import IncidentCorrelator, MetricHistorian
+from tpu_engine.serving_fleet import _PercentileWindow
+from tpu_engine.tracing import FlightRecorder
+
+
+def _forbidden_clock():
+    raise AssertionError("wall clock consulted on the synthetic-clock path")
+
+
+# ---------------------------------------------------------------------------
+# Historian rings at 50k batched samples
+# ---------------------------------------------------------------------------
+
+
+def test_historian_rings_plateau_at_50k_batched_samples():
+    """50k samples through the batched ingest path: raw + rollup rings
+    plateau, and every eviction is accounted for exactly."""
+    raw_cap, t10_cap, t60_cap = 64, 32, 16
+    hist = MetricHistorian(
+        raw_capacity=raw_cap,
+        tiers=((10.0, t10_cap), (60.0, t60_cap)),
+        max_series=8,
+        clock=_forbidden_clock,
+    )
+    n_series, n_ticks = 4, 12_500  # 50k samples
+    steady = None
+    for i in range(n_ticks):
+        ts = i * 5.0
+        hist.observe_batch(
+            [(f"depth_{k}", (ts % 97.0) + k) for k in range(n_series)], ts=ts
+        )
+        if i == n_ticks - 1_250:  # 90% mark
+            steady = hist.stats()
+    final = hist.stats()
+
+    assert final["samples_total"] == n_series * n_ticks
+    assert final["ingest_batch_total"] == n_ticks
+    assert final["ingest_batched_samples_total"] == n_series * n_ticks
+    assert final["series"] == n_series
+    assert final["raw_samples"] == n_series * raw_cap
+    assert final["rollup_buckets"]["10s"] == n_series * t10_cap
+    assert final["rollup_buckets"]["1m"] == n_series * t60_cap
+    # Exact eviction accounting: every bucket ever created either is
+    # still retained or bumped the eviction counter — nothing vanishes.
+    max_ts = (n_ticks - 1) * 5.0
+    created_10s = int(max_ts // 10.0) + 1
+    created_1m = int(max_ts // 60.0) + 1
+    expected_evictions = n_series * (
+        (created_10s - t10_cap) + (created_1m - t60_cap)
+    )
+    assert final["bucket_evictions_total"] == expected_evictions
+    # Plateau: footprint at 90% and 100% of the run is byte-identical.
+    assert final["estimated_bytes"] == steady["estimated_bytes"]
+    assert final["raw_samples"] == steady["raw_samples"]
+    assert final["rollup_buckets"] == steady["rollup_buckets"]
+
+
+# ---------------------------------------------------------------------------
+# Flight-recorder span/event rings at depth
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_rings_hold_bound_at_depth():
+    n, cap = 50_000, 128
+    seq = iter(range(10_000_000))
+    rec = FlightRecorder(
+        max_spans=cap,
+        max_events=cap,
+        clock=_forbidden_clock,
+        id_factory=lambda: f"id-{next(seq)}",
+    )
+    checkpoint = None
+    for i in range(n):
+        t = float(i)
+        rec.record_span("depth_op", kind="depth", trace_id="tr", t0=t, t1=t + 0.5)
+        rec.event("depth_ev", kind="depth", trace_id="tr", ts=t)
+        if i == n - 5_000 - 1:  # 90% mark
+            checkpoint = rec.stats()
+    st = rec.stats()
+
+    assert len(rec.spans(limit=0)) == cap
+    assert len(rec.events(limit=0)) == cap
+    assert st["spans_total"] == n
+    assert st["events_total"] == n
+    assert st["spans_dropped"] == n - cap
+    assert st["events_dropped"] == n - cap
+    # Steady state: the last 10% of the run dropped exactly what it
+    # recorded — the rings neither grow nor leak.
+    assert st["spans_dropped"] - checkpoint["spans_dropped"] == 5_000
+    assert st["events_dropped"] - checkpoint["events_dropped"] == 5_000
+    assert st["open_spans"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Autopilot decision ring at depth
+# ---------------------------------------------------------------------------
+
+
+def test_autopilot_decision_ring_bound_at_depth():
+    n, cap = 50_000, 64
+    ap = FleetAutopilot(
+        config=AutopilotConfig(max_decisions=cap), clock=_forbidden_clock
+    )
+    for i in range(n):
+        ap._admit(
+            DecisionRecord(
+                decision_id=f"d-{i}",
+                ts=float(i),
+                rule="replan_slow_job",
+                target="scheduler",
+                inputs={},
+                hysteresis={},
+                action=None,
+                suppressed_reason="below_streak",
+                outcome="suppressed",
+            )
+        )
+    st = ap.stats()
+    assert st["decisions_total"] == n
+    assert st["decisions_retained"] == cap
+    assert st["decisions_dropped_total"] == n - cap
+    assert len(ap.decisions(limit=0)) == cap
+    # The ring keeps the newest records.
+    newest = ap.decisions(limit=1)[0]
+    assert newest["decision_id"] == f"d-{n - 1}"
+
+
+# ---------------------------------------------------------------------------
+# Incident store at depth
+# ---------------------------------------------------------------------------
+
+
+def _fault_resume_pair(i):
+    t = i * 10.0
+    fault = {
+        "record": "event",
+        "event_id": f"f-{i}",
+        "trace_id": f"tr-{i}",
+        "parent_id": None,
+        "name": "fault_injected",
+        "kind": "fault",
+        "ts": t,
+        "attrs": {"device": i % 7},
+    }
+    resume = {
+        "record": "event",
+        "event_id": f"r-{i}",
+        "trace_id": f"tr-{i}",
+        "parent_id": f"f-{i}",
+        "name": "supervisor_resume",
+        "kind": "supervisor",
+        "ts": t + 1.0,
+        "attrs": {},
+    }
+    return [fault, resume]
+
+
+def test_incident_store_bounded_at_depth():
+    cap = 16
+    corr = IncidentCorrelator(
+        max_incidents=cap, stale_after_s=1e9, clock=_forbidden_clock
+    )
+    n = 2_000
+    batch = []
+    for i in range(n):
+        batch.extend(_fault_resume_pair(i))
+        if len(batch) >= 400:
+            corr.ingest(records=batch, now=batch[-1]["ts"])
+            batch = []
+    if batch:
+        corr.ingest(records=batch, now=batch[-1]["ts"])
+    st = corr.stats()
+    assert st["opened_total"] == n
+    assert st["resolved_total"] == n
+    assert st["correlated_total"] == 2 * n
+    assert st["open"] == 0
+    # Closed-incident ring holds its bound and keeps the newest.
+    retained = corr.incidents(limit=0)
+    assert len(retained) == cap
+    assert retained[0]["trigger"] == "fault"
+    assert retained[0]["t0"] == (n - 1) * 10.0
+
+
+# ---------------------------------------------------------------------------
+# Percentile window: accuracy contract + bound
+# ---------------------------------------------------------------------------
+
+
+def _exact_pct(vals, q):
+    vals = sorted(vals)
+    return vals[min(int(q * (len(vals) - 1)), len(vals) - 1)]
+
+
+def test_percentile_window_within_1pct_of_exact():
+    """Property test over random latency streams spanning 7 decades: the
+    bucketed window's p50/p90/p99 stay within 1% (relative) of the exact
+    sorted-window percentile it replaced."""
+    window = 512
+    for seed in range(25):
+        rng = random.Random(seed)
+        pw = _PercentileWindow(window=window)
+        tail = []
+        for _ in range(2_000):
+            v = math.exp(rng.uniform(math.log(0.1), math.log(1e6)))
+            pw.add(v)
+            tail.append(v)
+        tail = tail[-window:]
+        assert len(pw) == window
+        got = pw.percentiles((0.50, 0.90, 0.99))
+        for q, approx in zip((0.50, 0.90, 0.99), got):
+            exact = _exact_pct(tail, q)
+            assert abs(approx - exact) / exact <= 0.01, (seed, q, approx, exact)
+
+
+def test_percentile_window_empty_and_degenerate():
+    pw = _PercentileWindow(window=8)
+    assert pw.percentiles((0.5, 0.99)) == [None, None]
+    pw.add(3.0)
+    p50, p99 = pw.percentiles((0.5, 0.99))
+    assert abs(p50 - 3.0) / 3.0 <= 0.01 and p50 == p99
+    # Out-of-range values clamp instead of crashing.
+    pw.add(0.0)
+    pw.add(1e12)
+    assert all(v is not None for v in pw.percentiles((0.5, 0.99)))

@@ -991,3 +991,69 @@ def test_hetero_quarantine_heals_per_process_estimate(sched_factory):
     # chip 7's process still reads ~0.5 and stays out of admission.
     assert 0 not in s._hetero_quarantined
     assert 7 in s._hetero_quarantined
+
+
+# ---------------------------------------------------------------------------
+# Metrics scrape cost: index-backed, read-only
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_scrape_is_readonly_and_index_backed(sched_factory):
+    """A scrape (``stats()``) reads the state indexes: it never iterates
+    ``_subs`` — so its cost is O(queued + running + tenants), not O(every
+    submission the scheduler has ever seen) — and never mutates state."""
+    s = sched_factory(max_concurrent_jobs=4)
+    done = [s.submit(cfg()) for _ in range(12)]
+    for _ in range(200):
+        for j in s._stub_jobs:
+            j.finish()
+        if all(d.state == SubmissionState.COMPLETED for d in done):
+            break
+        time.sleep(0.02)
+    assert all(d.state == SubmissionState.COMPLETED for d in done)
+    s.max_concurrent_jobs = 0  # freeze admission: deterministic queue
+    queued = [s.submit(cfg(), priority=JobPriority.LOW) for _ in range(6)]
+
+    class CountingSubs(dict):
+        scans = 0
+
+        def values(self):
+            CountingSubs.scans += 1
+            return super().values()
+
+        def items(self):
+            CountingSubs.scans += 1
+            return super().items()
+
+        def __iter__(self):
+            CountingSubs.scans += 1
+            return super().__iter__()
+
+    states_before = {sid: sub.state for sid, sub in s._subs.items()}
+    s._subs = CountingSubs(s._subs)
+    CountingSubs.scans = 0
+    try:
+        first = s.stats()
+        second = s.stats()
+        assert CountingSubs.scans == 0, (
+            "stats() scanned _subs — scrape cost grew with terminal history"
+        )
+        # queue_state() reads the queued/running/finished indexes too:
+        # rendering "finished" is O(terminal) because that is the size of
+        # the answer, never a _subs scan.
+        qs = s.queue_state()
+        assert CountingSubs.scans == 0, (
+            "queue_state() scanned _subs — history surface lost its index"
+        )
+    finally:
+        s._subs = dict(s._subs)
+    # Read-only: repeated scrapes agree (modulo the wall-clock age of the
+    # oldest queued entry) and no submission changed state.
+    first.pop("oldest_queued_wait_s")
+    second.pop("oldest_queued_wait_s")
+    assert first == second
+    assert {sid: sub.state for sid, sub in s._subs.items()} == states_before
+    assert [q["submission_id"] for q in qs["queued"]] == [
+        q.submission_id for q in queued
+    ]
+    assert len(qs["finished"]) == 12

@@ -63,6 +63,11 @@ NOISY_KEYS = {
     "fleet_seconds_per_cpu_second",
     "ingest_samples_per_sec",
     "query_avg_us",
+    # ctl_scale: nested wall-time profile + overhead ratio (prefix match
+    # skips "overhead.*" / "phases.*"); flatness regressions are still
+    # gated through the deterministic gates.* booleans.
+    "overhead",
+    "phases",
 }
 
 
@@ -92,6 +97,7 @@ def collect_quick() -> list[dict]:
     from tpu_engine.parallel.pipeline_zb import schedule_account
     from tpu_engine.twin import (
         autopilot_bench_line,
+        ctl_scale_bench_line,
         historian_bench_line,
         twin_bench_line,
     )
@@ -167,6 +173,7 @@ def collect_quick() -> list[dict]:
         twin_bench_line(seed=0),
         historian_bench_line(seed=0),
         autopilot_bench_line(seed=0),
+        ctl_scale_bench_line(seed=0),
     ]
 
 

@@ -1,0 +1,66 @@
+"""Profile the control-plane scale lane: where do the control seconds go?
+
+Runs :func:`tpu_engine.twin.scale_lane` under :mod:`cProfile` and prints
+the top cumulative frames — the first stop when the ctl_scale flatness
+gate (``tools/bench_sentinel.py``, ``benchmarks/ctl_scale.py``) reports
+the overhead ratio creeping up. A frame whose per-call time grows
+between ``--jobs 1000`` and ``--jobs 100000`` is the superlinear cost;
+a frame that merely scales with the job count is the workload.
+
+Run::
+
+    JAX_PLATFORMS=cpu python tools/ctl_profile.py                # small config
+    JAX_PLATFORMS=cpu python tools/ctl_profile.py --jobs 20000 --requests 200000
+    JAX_PLATFORMS=cpu python tools/ctl_profile.py --top 40 --sort tottime
+"""
+
+from __future__ import annotations
+
+import argparse
+import cProfile
+import io
+import json
+import os
+import pstats
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--jobs", type=int, default=1_000,
+                    help="submissions through the real scheduler")
+    ap.add_argument("--requests", type=int, default=10_000,
+                    help="requests through the real router")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--top", type=int, default=20,
+                    help="frames to print (default 20)")
+    ap.add_argument("--sort", default="cumulative",
+                    choices=("cumulative", "tottime", "ncalls"),
+                    help="pstats sort key (default cumulative)")
+    args = ap.parse_args(argv)
+
+    from tpu_engine.twin import ScaleLaneParams, scale_lane
+
+    params = ScaleLaneParams(n_jobs=args.jobs, n_requests=args.requests)
+    prof = cProfile.Profile()
+    prof.enable()
+    result = scale_lane(seed=args.seed, params=params)
+    prof.disable()
+
+    print(json.dumps({
+        "jobs": args.jobs,
+        "requests": args.requests,
+        "overhead_us_per_fleet_s": result["overhead_us_per_fleet_s"],
+        "phases": result["phases"],
+    }, indent=2))
+    out = io.StringIO()
+    stats = pstats.Stats(prof, stream=out)
+    stats.strip_dirs().sort_stats(args.sort).print_stats(args.top)
+    print(out.getvalue())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -127,8 +127,60 @@ class MetricHistorian:
         self.series_evicted_total = 0
         self.bucket_evictions_total = 0
         self.collector_errors_total = 0
+        # Batched-ingest efficiency counters: how many lock acquisitions
+        # the batch path saved is (batched_samples - batches).
+        self.ingest_batch_total = 0
+        self.ingest_batched_samples_total = 0
 
     # -- writes --------------------------------------------------------------
+
+    def _record_locked(
+        self,
+        name: str,
+        value: float,
+        ts: float,
+        labels: Optional[Dict[str, Any]],
+    ) -> None:
+        """Fold one (validated, floated) sample into the raw ring and every
+        rollup tier. Caller holds ``self._lock``."""
+        key = _series_key(name, labels)
+        s = self._series.get(key)
+        if s is None:
+            s = _Series(
+                name,
+                {str(k): str(v) for k, v in (labels or {}).items()},
+                self.raw_capacity,
+                self.tiers,
+            )
+            self._series[key] = s
+            while len(self._series) > self.max_series:
+                self._series.popitem(last=False)
+                self.series_evicted_total += 1
+        else:
+            self._series.move_to_end(key)
+        s.raw.append((ts, value))
+        s.last_ts = ts if s.last_ts is None else max(s.last_ts, ts)
+        for (width, max_buckets) in self.tiers:
+            od = s.tiers[width]
+            idx = int(ts // width)
+            b = od.get(idx)
+            if b is None:
+                od[idx] = [1, value, value, value, ts, value, ts, value]
+                while len(od) > max_buckets:
+                    od.popitem(last=False)
+                    self.bucket_evictions_total += 1
+            else:
+                b[_B_COUNT] += 1
+                b[_B_SUM] += value
+                if value < b[_B_MIN]:
+                    b[_B_MIN] = value
+                if value > b[_B_MAX]:
+                    b[_B_MAX] = value
+                if ts < b[_B_FTS]:
+                    b[_B_FTS], b[_B_FIRST] = ts, value
+                if ts >= b[_B_LTS]:
+                    b[_B_LTS], b[_B_LAST] = ts, value
+        self.samples_total += 1
 
     def record(
         self,
@@ -140,47 +192,48 @@ class MetricHistorian:
         """Append one sample; folds into the raw ring and every rollup tier."""
         if value is None or not isinstance(value, (int, float)):
             return
-        value = float(value)
         ts = self.clock() if ts is None else float(ts)
-        key = _series_key(name, labels)
         with self._lock:
-            s = self._series.get(key)
-            if s is None:
-                s = _Series(
-                    name,
-                    {str(k): str(v) for k, v in (labels or {}).items()},
-                    self.raw_capacity,
-                    self.tiers,
-                )
-                self._series[key] = s
-                while len(self._series) > self.max_series:
-                    self._series.popitem(last=False)
-                    self.series_evicted_total += 1
-            else:
-                self._series.move_to_end(key)
-            s.raw.append((ts, value))
-            s.last_ts = ts if s.last_ts is None else max(s.last_ts, ts)
-            for (width, max_buckets) in self.tiers:
-                od = s.tiers[width]
-                idx = int(ts // width)
-                b = od.get(idx)
-                if b is None:
-                    od[idx] = [1, value, value, value, ts, value, ts, value]
-                    while len(od) > max_buckets:
-                        od.popitem(last=False)
-                        self.bucket_evictions_total += 1
-                else:
-                    b[_B_COUNT] += 1
-                    b[_B_SUM] += value
-                    if value < b[_B_MIN]:
-                        b[_B_MIN] = value
-                    if value > b[_B_MAX]:
-                        b[_B_MAX] = value
-                    if ts < b[_B_FTS]:
-                        b[_B_FTS], b[_B_FIRST] = ts, value
-                    if ts >= b[_B_LTS]:
-                        b[_B_LTS], b[_B_LAST] = ts, value
-            self.samples_total += 1
+            self._record_locked(name, float(value), ts, labels)
+
+    def observe_batch(
+        self,
+        samples: Any,
+        ts: Optional[float] = None,
+        labels: Optional[Dict[str, Any]] = None,
+    ) -> int:
+        """Batched ingest: one lock acquisition for the whole batch, then
+        the same raw+rollup fold per sample as :meth:`record`.
+
+        ``samples`` is a mapping ``name → value`` or an iterable of
+        ``(name, value)`` / ``(name, value, labels)`` tuples (the tuple
+        form carries per-sample labels; the ``labels`` argument is the
+        default). Non-numeric values are skipped exactly as
+        :meth:`record` skips them. Returns the number of samples
+        retained. This is the hot-path entry: at control-plane scale the
+        per-sample lock round-trip in ``record`` dominated ingest cost,
+        so :meth:`record_many` and :meth:`tick` both route through here.
+        """
+        ts = self.clock() if ts is None else float(ts)
+        if isinstance(samples, dict):
+            items: List[Tuple[str, Any, Optional[Dict[str, Any]]]] = [
+                (name, value, labels) for name, value in samples.items()
+            ]
+        else:
+            items = [
+                (it[0], it[1], it[2] if len(it) > 2 else labels)
+                for it in samples
+            ]
+        n = 0
+        with self._lock:
+            for name, value, lab in items:
+                if value is None or not isinstance(value, (int, float)):
+                    continue
+                self._record_locked(name, float(value), ts, lab)
+                n += 1
+            self.ingest_batch_total += 1
+            self.ingest_batched_samples_total += n
+        return n
 
     def record_many(
         self,
@@ -188,9 +241,7 @@ class MetricHistorian:
         ts: Optional[float] = None,
         labels: Optional[Dict[str, Any]] = None,
     ) -> None:
-        ts = self.clock() if ts is None else float(ts)
-        for name, value in samples.items():
-            self.record(name, value, ts=ts, labels=labels)
+        self.observe_batch(samples, ts=ts, labels=labels)
 
     # -- scrape tick ---------------------------------------------------------
 
@@ -209,6 +260,9 @@ class MetricHistorian:
         recorded = 0
         with self._lock:
             collectors = list(self._collectors)
+        # Collectors run outside the lock (they may be arbitrarily slow);
+        # their combined output lands through ONE batched fold.
+        batch: List[Tuple[str, Any, Optional[Dict[str, Any]]]] = []
         for fn in collectors:
             try:
                 out = fn(now)
@@ -219,12 +273,14 @@ class MetricHistorian:
                 continue
             if isinstance(out, dict):
                 for name, value in out.items():
-                    self.record(name, value, ts=now)
+                    batch.append((name, value, None))
                     recorded += 1
             else:
                 for name, value, labels in out:
-                    self.record(name, value, ts=now, labels=labels)
+                    batch.append((name, value, labels))
                     recorded += 1
+        if batch:
+            self.observe_batch(batch, ts=now)
         with self._lock:
             self.ticks_total += 1
         return recorded
@@ -502,6 +558,8 @@ class MetricHistorian:
                 "series_evicted_total": self.series_evicted_total,
                 "bucket_evictions_total": self.bucket_evictions_total,
                 "collector_errors_total": self.collector_errors_total,
+                "ingest_batch_total": self.ingest_batch_total,
+                "ingest_batched_samples_total": self.ingest_batched_samples_total,
                 "estimated_bytes": est,
                 "raw_capacity": self.raw_capacity,
                 "max_series": self.max_series,

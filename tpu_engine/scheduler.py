@@ -35,6 +35,7 @@ import logging
 import threading
 import time
 import uuid
+from collections import deque
 from datetime import datetime, timezone
 from enum import Enum, IntEnum
 from typing import Any, Callable, Optional
@@ -124,10 +125,17 @@ class Submission:
         job_factory: Optional[Callable[["Submission"], Any]] = None,
     ):
         ts = datetime.now(timezone.utc).strftime("%Y%m%d_%H%M%S")
-        self.submission_id = f"sub_{ts}_{uuid.uuid4().hex[:6]}"
+        # The monotonic seq makes the id collision-proof per scheduler: at
+        # >10k submissions per wall-second the second-resolution timestamp
+        # plus 24 random bits alone collides (birthday bound), and a
+        # collision while both submissions are queued silently drops the
+        # older one from the admission index.
+        self.submission_id = f"sub_{ts}_{seq}_{uuid.uuid4().hex[:6]}"
         # Attempts reuse this id so the registry's newest entry wins.
         prefix = "srv" if workload == "serving" else "tpu"
-        self.job_id = f"{prefix}_{config.model_name}_{ts}_{uuid.uuid4().hex[:6]}"
+        self.job_id = (
+            f"{prefix}_{config.model_name}_{ts}_{seq}_{uuid.uuid4().hex[:6]}"
+        )
         self.config = config
         self.priority = priority
         self.submitter = submitter
@@ -295,6 +303,7 @@ class FleetScheduler:
         hetero_imbalance_trigger: float = 1.15,
         hetero_heal_threshold: float = 0.95,
         hetero_quarantine_ttl_s: float = 900.0,
+        max_finished_history: int = 10_000,
     ):
         self.grow_back = grow_back
         # Hysteresis window: a shrunk job is not grown back until this long
@@ -355,6 +364,41 @@ class FleetScheduler:
         self._seq = 0
         self._draining = False
         self._reserved: dict[int, float] = {}  # device index → reserved GiB
+
+        # State-bucketed indexes: `_subs` keeps every submission ever (the
+        # API's history surface), so any scan of it is O(all submissions
+        # ever) — at 100k jobs that made each 0.1 s poll pass quadratic.
+        # Admission, stats and the metrics scrape read these buckets
+        # instead; `_set_state` is the single transition point that keeps
+        # them consistent. Queued buckets are per-priority deques in seq
+        # order: a new submission always carries the max seq (append), a
+        # preempt-requeue re-enters at its ORIGINAL seq (sorted re-insert,
+        # rare — one per preemption).
+        self._queued_idx: dict[int, deque[Submission]] = {
+            int(p): deque() for p in JobPriority
+        }
+        self._state_idx: dict[SubmissionState, dict[str, Submission]] = {
+            SubmissionState.RUNNING: {},
+            SubmissionState.PREEMPTING: {},
+            SubmissionState.CANCELLING: {},
+        }
+        self._by_job_id: dict[str, Submission] = {}
+        # Terminal submissions in finish order: queue_state()'s "finished"
+        # history surface without a _subs scan (rendering it is still
+        # O(terminal) — that is the size of the answer, not a scan tax).
+        # Bounded: beyond max_finished_history the oldest terminal
+        # submissions leave _subs/_by_job_id too — at 100k jobs an
+        # unbounded history made every control action pay for the
+        # retained object graph (gen-2 GC scans grow with it), so per-job
+        # submit cost crept up 1.6x over the run. Aggregate counters and
+        # per-tenant rollups survive eviction; only the per-submission
+        # describe() record ages out.
+        self.max_finished_history = int(max_finished_history)
+        self.finished_evicted_total = 0
+        self._finished_idx: dict[str, Submission] = {}
+        # Quota reads and the stats() tenant roster without a _subs scan.
+        self._active_by_submitter: dict[str, int] = {}
+        self._tenants: set[str] = set()
 
         # Telemetry counters (the metrics router renders these).
         self.submitted_total = 0
@@ -468,11 +512,7 @@ class FleetScheduler:
         with self._lock:
             quota = self.quotas.get(submitter, self.default_quota)
             if quota is not None:
-                active = sum(
-                    1
-                    for s in self._subs.values()
-                    if s.submitter == submitter and s.state not in TERMINAL_STATES
-                )
+                active = self._active_by_submitter.get(submitter, 0)
                 if active >= quota:
                     raise QuotaExceeded(submitter, quota)
             if (
@@ -498,6 +538,12 @@ class FleetScheduler:
             )
             sub.auto_place = auto_place
             self._subs[sub.submission_id] = sub
+            self._index_add(sub)
+            self._by_job_id[sub.job_id] = sub
+            self._tenants.add(submitter)
+            self._active_by_submitter[submitter] = (
+                self._active_by_submitter.get(submitter, 0) + 1
+            )
             self.submitted_total += 1
         tracing.get_recorder().event(
             "submit",
@@ -524,10 +570,7 @@ class FleetScheduler:
         return self._subs.get(submission_id)
 
     def find_by_job_id(self, job_id: str) -> Optional[Submission]:
-        for s in self._subs.values():
-            if s.job_id == job_id:
-                return s
-        return None
+        return self._by_job_id.get(job_id)
 
     def queue_position(self, submission_id: str) -> Optional[int]:
         """1-based position in admission order; None when not queued."""
@@ -545,12 +588,12 @@ class FleetScheduler:
             if sub is None or sub.state in TERMINAL_STATES:
                 return False
             if sub.state == SubmissionState.QUEUED:
-                sub.state = SubmissionState.CANCELLED
+                self._set_state(sub, SubmissionState.CANCELLED)
                 sub.finished_at = time.time()
                 self.cancelled_total += 1
                 sub.finish_trace("cancelled")
                 return True
-            sub.state = SubmissionState.CANCELLING
+            self._set_state(sub, SubmissionState.CANCELLING)
             if sub.job is not None:
                 sub.job._stop.set()
         self._wake.set()
@@ -624,7 +667,7 @@ class FleetScheduler:
             subs = (
                 [self._subs.get(submission_id)]
                 if submission_id is not None
-                else list(self._subs.values())
+                else self._running()
             )
             for sub in subs:
                 if sub is None or sub.state != SubmissionState.RUNNING:
@@ -666,8 +709,8 @@ class FleetScheduler:
                 self._admit()
                 self._maybe_rebalance()
                 self._maybe_grow()
-            queued = len(self._queued())
-            running = len(self._active())
+            queued = self._queued_count()
+            running = self._active_count()
             quarantined = len(self._hetero_quarantined)
         # Retain queue depth per poll pass in the historian (outside the
         # lock — the historian has its own). Best effort: scheduling must
@@ -707,22 +750,99 @@ class FleetScheduler:
 
     # -- internals (all hold self._lock) --------------------------------------
 
+    def _index_add(self, sub: Submission) -> None:
+        st = sub.state
+        if st == SubmissionState.QUEUED:
+            dq = self._queued_idx[int(sub.priority)]
+            if dq and sub.seq < dq[-1].seq:
+                # Preempt-requeue: the submission keeps its ORIGINAL seq
+                # (front of its class, not the back) — re-insert in order.
+                items = sorted([*dq, sub], key=lambda s: s.seq)
+                dq.clear()
+                dq.extend(items)
+            else:
+                dq.append(sub)
+        elif st in self._state_idx:
+            self._state_idx[st][sub.submission_id] = sub
+        elif st in TERMINAL_STATES:
+            self._finished_idx[sub.submission_id] = sub
+
+    def _index_discard(self, sub: Submission) -> None:
+        st = sub.state
+        if st == SubmissionState.QUEUED:
+            try:
+                self._queued_idx[int(sub.priority)].remove(sub)
+            except ValueError:
+                pass
+        elif st in self._state_idx:
+            self._state_idx[st].pop(sub.submission_id, None)
+        elif st in TERMINAL_STATES:
+            self._finished_idx.pop(sub.submission_id, None)
+
+    def _set_state(self, sub: Submission, new_state: SubmissionState) -> None:
+        """The single transition point: moves the submission between state
+        buckets and settles the per-submitter active count. Every
+        ``sub.state`` write in the scheduler goes through here."""
+        old = sub.state
+        if old == new_state:
+            return
+        self._index_discard(sub)
+        sub.state = new_state
+        self._index_add(sub)
+        if old not in TERMINAL_STATES and new_state in TERMINAL_STATES:
+            n = self._active_by_submitter.get(sub.submitter, 0) - 1
+            if n > 0:
+                self._active_by_submitter[sub.submitter] = n
+            else:
+                self._active_by_submitter.pop(sub.submitter, None)
+            while (
+                self.max_finished_history > 0
+                and len(self._finished_idx) > self.max_finished_history
+            ):
+                sid = next(iter(self._finished_idx))
+                evicted = self._finished_idx.pop(sid)
+                self._subs.pop(sid, None)
+                if self._by_job_id.get(evicted.job_id) is evicted:
+                    del self._by_job_id[evicted.job_id]
+                self.finished_evicted_total += 1
+
     def _queued(self) -> list[Submission]:
-        q = [s for s in self._subs.values() if s.state == SubmissionState.QUEUED]
-        q.sort(key=lambda s: (-int(s.priority), s.seq))
-        return q
+        """Admission order — priority classes high→low, FIFO (seq) within.
+        O(queued): concatenates the per-priority index deques (each already
+        seq-ordered); never scans ``_subs``."""
+        out: list[Submission] = []
+        for p in sorted(self._queued_idx, reverse=True):
+            out.extend(self._queued_idx[p])
+        return out
+
+    def _queued_count(self) -> int:
+        return sum(len(dq) for dq in self._queued_idx.values())
+
+    def _queued_heads(self, k: int) -> list[Submission]:
+        """First ``k`` submissions in admission order — what one admission
+        pass actually looks at (the backfill window), O(k)."""
+        heads: list[Submission] = []
+        for p in sorted(self._queued_idx, reverse=True):
+            for s in self._queued_idx[p]:
+                heads.append(s)
+                if len(heads) >= k:
+                    return heads
+        return heads
 
     def _active(self) -> list[Submission]:
-        return [
-            s
-            for s in self._subs.values()
-            if s.state
-            in (
-                SubmissionState.RUNNING,
-                SubmissionState.PREEMPTING,
-                SubmissionState.CANCELLING,
-            )
+        subs = [
+            s for idx in self._state_idx.values() for s in idx.values()
         ]
+        subs.sort(key=lambda s: s.seq)  # == _subs insertion order
+        return subs
+
+    def _active_count(self) -> int:
+        return sum(len(idx) for idx in self._state_idx.values())
+
+    def _running(self) -> list[Submission]:
+        subs = list(self._state_idx[SubmissionState.RUNNING].values())
+        subs.sort(key=lambda s: s.seq)
+        return subs
 
     def _release(self, sub: Submission) -> None:
         for idx in sub.placement:
@@ -767,7 +887,7 @@ class FleetScheduler:
                 # back to the front of its priority class, it does not
                 # re-pay the whole wait.
                 self._release(sub)
-                sub.state = SubmissionState.QUEUED
+                self._set_state(sub, SubmissionState.QUEUED)
                 sub.preemptions += 1
                 sub.job = None
                 self.requeues_total += 1
@@ -797,19 +917,19 @@ class FleetScheduler:
                 self._release(sub)
                 sub.finished_at = time.time()
                 if sub.state == SubmissionState.CANCELLING:
-                    sub.state = SubmissionState.CANCELLED
+                    self._set_state(sub, SubmissionState.CANCELLED)
                     self.cancelled_total += 1
                 elif job.status == JobStatus.COMPLETED:
-                    sub.state = SubmissionState.COMPLETED
+                    self._set_state(sub, SubmissionState.COMPLETED)
                     self.completed_total += 1
                     self._tenant_completed[sub.submitter] = (
                         self._tenant_completed.get(sub.submitter, 0) + 1
                     )
                 elif job.status == JobStatus.STOPPED:
-                    sub.state = SubmissionState.CANCELLED
+                    self._set_state(sub, SubmissionState.CANCELLED)
                     self.cancelled_total += 1
                 else:
-                    sub.state = SubmissionState.FAILED
+                    self._set_state(sub, SubmissionState.FAILED)
                     self.failed_total += 1
                 sub.finish_trace(sub.state.value)
 
@@ -846,14 +966,16 @@ class FleetScheduler:
         ]
 
     def _admit(self) -> None:
-        queued = self._queued()
+        # One pass touches only the backfill window of queued heads — the
+        # rest of the queue (and every terminal submission) stays cold.
+        queued = self._queued_heads(max(self.backfill_depth, 1))
         if not queued:
             return
         fleet = self._fleet()
-        slots = self.max_concurrent_jobs - len(self._active())
+        slots = self.max_concurrent_jobs - self._active_count()
 
         preempt_wanted = False
-        for rank, sub in enumerate(queued[: max(self.backfill_depth, 1)]):
+        for rank, sub in enumerate(queued):
             if slots <= 0:
                 if rank == 0:
                     self._note_skip(sub, "at max_concurrent_jobs capacity")
@@ -1085,7 +1207,7 @@ class FleetScheduler:
         try:
             job = (sub.job_factory or self.job_factory)(sub)
         except Exception as e:  # noqa: BLE001 — constructor boundary
-            sub.state = SubmissionState.FAILED
+            self._set_state(sub, SubmissionState.FAILED)
             sub.finished_at = time.time()
             reason = f"job construction failed: {type(e).__name__}: {e}"
             if no_est_reason:
@@ -1097,7 +1219,7 @@ class FleetScheduler:
 
         sub.job = job
         sub.attempts += 1
-        sub.state = SubmissionState.RUNNING
+        self._set_state(sub, SubmissionState.RUNNING)
         # A capacity-only admission keeps its structured annotation (the
         # queue surface should say WHY the HBM gate was skipped); every
         # other stale skip reason clears on success.
@@ -1335,10 +1457,10 @@ class FleetScheduler:
         self._resolve_hetero_consults()
         if not self.hetero_rebalance or self._draining:
             return
-        if any(s.state == SubmissionState.PREEMPTING for s in self._subs.values()):
+        if self._state_idx[SubmissionState.PREEMPTING]:
             return
-        for sub in self._subs.values():
-            if sub.state != SubmissionState.RUNNING or sub.workload != "training":
+        for sub in self._running():
+            if sub.workload != "training":
                 continue
             reb = getattr(sub.job, "_hetero", None)
             if reb is None:
@@ -1405,7 +1527,7 @@ class FleetScheduler:
                 }
             self.hetero_shrinks_total += 1
             self.preemptions_total += 1
-            sub.state = SubmissionState.PREEMPTING
+            self._set_state(sub, SubmissionState.PREEMPTING)
             sub.last_resize_at = now
             self._last_hetero_action_at = now
             tracing.get_recorder().event(
@@ -1437,9 +1559,9 @@ class FleetScheduler:
         larger gang for it — one per pass, only when the queue is empty
         (queued work has first claim on freed chips) and no other
         preemption is in flight."""
-        if not self.grow_back or self._draining or self._queued():
+        if not self.grow_back or self._draining or self._queued_count():
             return
-        if any(s.state == SubmissionState.PREEMPTING for s in self._subs.values()):
+        if self._state_idx[SubmissionState.PREEMPTING]:
             return
         fleet = self._fleet()
         if fleet is None or not fleet.devices:
@@ -1456,10 +1578,9 @@ class FleetScheduler:
         ]
         healthy = len(healthy_devs)
         now = time.time()
-        for sub in self._subs.values():
+        for sub in self._running():
             if (
-                sub.state != SubmissionState.RUNNING
-                or sub.shrunk_mesh is None
+                sub.shrunk_mesh is None
                 or sub.admitted_gang is None
                 or not sub.preemptible
             ):
@@ -1500,7 +1621,7 @@ class FleetScheduler:
                 # the deadline/failure path lets the grow proceed cold).
                 continue
             self.grow_backs_total += 1
-            sub.state = SubmissionState.PREEMPTING
+            self._set_state(sub, SubmissionState.PREEMPTING)
             sub.last_resize_at = now
             self.preemptions_total += 1
             tracing.get_recorder().event(
@@ -1604,21 +1725,15 @@ class FleetScheduler:
     def _maybe_preempt(self, head: Submission) -> None:
         """Evict the lowest-priority running job strictly below ``head``'s
         priority (one per pass) via the emergency-save seam."""
-        if any(
-            s.state == SubmissionState.PREEMPTING for s in self._subs.values()
-        ):
+        if self._state_idx[SubmissionState.PREEMPTING]:
             return  # one eviction in flight at a time — its save must land
-        running = [
-            s
-            for s in self._subs.values()
-            if s.state == SubmissionState.RUNNING and s.preemptible
-        ]
+        running = [s for s in self._running() if s.preemptible]
         victims = [s for s in running if s.priority < head.priority]
         if not victims:
             return
         victims.sort(key=lambda s: (int(s.priority), -s.seq))  # lowest, youngest
         victim = victims[0]
-        victim.state = SubmissionState.PREEMPTING
+        self._set_state(victim, SubmissionState.PREEMPTING)
         self.preemptions_total += 1
         rec = tracing.get_recorder()
         rec.event(
@@ -1673,37 +1788,40 @@ class FleetScheduler:
                 "max_concurrent_jobs": self.max_concurrent_jobs,
                 "queued": [s.describe() for s in self._queued()],
                 "running": [s.describe() for s in self._active()],
-                "finished": [
-                    s.describe()
-                    for s in self._subs.values()
-                    if s.state in TERMINAL_STATES
-                ],
+                "finished": [s.describe() for s in self._finished_idx.values()],
                 "stats": self.stats(),
             }
 
     def stats(self) -> dict[str, Any]:
-        """Telemetry snapshot (the metrics router renders these as gauges)."""
+        """Telemetry snapshot (the metrics router renders these as gauges).
+
+        Cost is O(queued + running + tenants): the queued/running views
+        come from the state indexes, never from a ``_subs`` scan — a
+        metrics scrape must not get slower with every submission the
+        scheduler has EVER seen."""
         queued = self._queued()
+        running_subs = self._running()
         now = time.time()
         by_priority = {p.name.lower(): 0 for p in JobPriority}
+        queued_by_tenant: dict[str, int] = {}
         for s in queued:
             by_priority[s.priority.name.lower()] += 1
+            queued_by_tenant[s.submitter] = queued_by_tenant.get(s.submitter, 0) + 1
+        running_by_tenant: dict[str, int] = {}
+        for s in running_subs:
+            running_by_tenant[s.submitter] = (
+                running_by_tenant.get(s.submitter, 0) + 1
+            )
         waits = self._wait_samples
         tenants = sorted(
-            {s.submitter for s in self._subs.values()}
-            | set(self._tenant_waits) | set(self._tenant_busy_s)
+            self._tenants | set(self._tenant_waits) | set(self._tenant_busy_s)
         )
         per_submitter = {}
         for t in tenants:
             t_waits = self._tenant_waits.get(t, [])
-            t_subs = [s for s in self._subs.values() if s.submitter == t]
             per_submitter[t] = {
-                "queued": sum(
-                    1 for s in t_subs if s.state == SubmissionState.QUEUED
-                ),
-                "running": sum(
-                    1 for s in t_subs if s.state == SubmissionState.RUNNING
-                ),
+                "queued": queued_by_tenant.get(t, 0),
+                "running": running_by_tenant.get(t, 0),
                 "mean_wait_s": (
                     round(sum(t_waits) / len(t_waits), 4) if t_waits else 0.0
                 ),
@@ -1721,7 +1839,7 @@ class FleetScheduler:
         return {
             "queue_depth": len(queued),
             "queue_depth_by_priority": by_priority,
-            "running": len(self._active()),
+            "running": self._active_count(),
             "oldest_queued_wait_s": (
                 round(now - min(s.submitted_at for s in queued), 3) if queued else 0.0
             ),
@@ -1740,6 +1858,7 @@ class FleetScheduler:
             "completed_total": self.completed_total,
             "failed_total": self.failed_total,
             "cancelled_total": self.cancelled_total,
+            "finished_evicted_total": self.finished_evicted_total,
             "elastic_shrinks_total": self.elastic_shrinks_total,
             "grow_backs_total": self.grow_backs_total,
             "self_heal_requeues_total": self.self_heal_requeues_total,
@@ -1768,14 +1887,10 @@ class FleetScheduler:
                 "quarantined_devices": sorted(self._hetero_quarantined),
             },
             "running_shrunk": sum(
-                1
-                for s in self._subs.values()
-                if s.state == SubmissionState.RUNNING and s.shrunk_mesh is not None
+                1 for s in running_subs if s.shrunk_mesh is not None
             ),
             "running_serving": sum(
-                1
-                for s in self._subs.values()
-                if s.state == SubmissionState.RUNNING and s.workload == "serving"
+                1 for s in running_subs if s.workload == "serving"
             ),
             "reserved_hbm_gib": round(sum(self._reserved.values()), 3),
             "per_submitter": per_submitter,

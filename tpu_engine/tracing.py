@@ -184,6 +184,14 @@ class FlightRecorder:
         self._closed: deque = deque()  # Span dicts, oldest first
         self._open: "OrderedDict[str, Span]" = OrderedDict()
         self._events: deque = deque()  # event dicts, oldest first
+        # Per-trace views of the same ring entries (references, not
+        # copies), maintained on close/evict. A trace-filtered query —
+        # the goodput ledger decomposes one trace per finalize — reads
+        # O(that trace's records) instead of copying and filtering the
+        # whole ring, which made reaping 100k jobs quadratic in practice.
+        self._closed_by_trace: Dict[str, deque] = {}
+        self._open_by_trace: Dict[str, "OrderedDict[str, Span]"] = {}
+        self._events_by_trace: Dict[str, deque] = {}
         self._trace_roots: Dict[str, str] = {}  # trace_id -> root span_id
         self._trace_order: "OrderedDict[str, float]" = OrderedDict()
         # health counters (monotonic)
@@ -238,6 +246,9 @@ class FlightRecorder:
         span = Span(self, name, kind, trace_id, parent_id, t0, attrs)
         with self._lock:
             self._open[span.span_id] = span
+            self._open_by_trace.setdefault(trace_id, OrderedDict())[
+                span.span_id
+            ] = span
             self._note_trace(trace_id, span.span_id, t0)
         return span
 
@@ -280,11 +291,22 @@ class FlightRecorder:
         }
         with self._lock:
             self._events.append(ev)
+            if trace_id is not None:
+                self._events_by_trace.setdefault(trace_id, deque()).append(ev)
             self.events_total[kind] += 1
             if trace_id is not None and trace_id not in self._trace_order:
+                # Same bound as _note_trace: an event-only trace (e.g. a
+                # fault marker per submission) must not grow the trace
+                # registry past the span ring — at 100k submissions this
+                # was the control plane's only unbounded index.
                 self._trace_order[trace_id] = ts
+                while len(self._trace_order) > self.max_spans:
+                    self._trace_order.popitem(last=False)
             while len(self._events) > self.max_events:
-                self._events.popleft()
+                old = self._events.popleft()
+                self._drop_from_trace_index(
+                    self._events_by_trace, old["trace_id"]
+                )
                 self.events_dropped += 1
         self._persist(dict(ev, record="event"))
         return ev
@@ -326,16 +348,48 @@ class FlightRecorder:
             if span.t1 < span.t0:  # clock skew / bad virtual ts: clamp
                 span.t1 = span.t0
             self._open.pop(span.span_id, None)
-            self._closed.append(span.to_dict())
+            self._pop_open_by_trace(span)
+            closed = span.to_dict()
+            self._closed.append(closed)
+            self._closed_by_trace.setdefault(span.trace_id, deque()).append(
+                closed
+            )
             self.spans_total[span.kind] += 1
             while len(self._closed) > self.max_spans:
-                self._closed.popleft()
+                # Ring eviction is FIFO and so is each per-trace deque —
+                # the evicted span is always its trace's leftmost entry.
+                old = self._closed.popleft()
+                self._drop_from_trace_index(
+                    self._closed_by_trace, old["trace_id"]
+                )
                 self.spans_dropped += 1
         self._persist(dict(span.to_dict(), record="span"))
 
     def _cancel_span(self, span: Span) -> None:
         with self._lock:
             self._open.pop(span.span_id, None)
+            self._pop_open_by_trace(span)
+
+    def _pop_open_by_trace(self, span: Span) -> None:
+        # caller holds the lock
+        per_trace = self._open_by_trace.get(span.trace_id)
+        if per_trace is not None:
+            per_trace.pop(span.span_id, None)
+            if not per_trace:
+                self._open_by_trace.pop(span.trace_id, None)
+
+    @staticmethod
+    def _drop_from_trace_index(
+        index: Dict[str, deque], trace_id: Optional[str]
+    ) -> None:
+        # caller holds the lock
+        if trace_id is None:
+            return
+        per_trace = index.get(trace_id)
+        if per_trace:
+            per_trace.popleft()
+            if not per_trace:
+                index.pop(trace_id, None)
 
     # -- persistence ---------------------------------------------------------
 
@@ -371,11 +425,17 @@ class FlightRecorder:
     ) -> List[Dict[str, Any]]:
         """Recorded spans, newest last, optionally filtered."""
         with self._lock:
-            out = list(self._closed)
-            if include_open:
-                out.extend(s.to_dict() for s in self._open.values())
-        if trace_id is not None:
-            out = [s for s in out if s["trace_id"] == trace_id]
+            if trace_id is not None:
+                # Trace-indexed read: O(that trace's spans), not O(ring).
+                out = list(self._closed_by_trace.get(trace_id, ()))
+                if include_open:
+                    per_trace = self._open_by_trace.get(trace_id)
+                    if per_trace is not None:
+                        out.extend(s.to_dict() for s in per_trace.values())
+            else:
+                out = list(self._closed)
+                if include_open:
+                    out.extend(s.to_dict() for s in self._open.values())
         if kind is not None:
             out = [s for s in out if s["kind"] == kind]
         out.sort(key=lambda s: s["t0"])
@@ -388,9 +448,10 @@ class FlightRecorder:
         limit: int = 200,
     ) -> List[Dict[str, Any]]:
         with self._lock:
-            out = [dict(e) for e in self._events]
-        if trace_id is not None:
-            out = [e for e in out if e["trace_id"] == trace_id]
+            if trace_id is not None:
+                out = [dict(e) for e in self._events_by_trace.get(trace_id, ())]
+            else:
+                out = [dict(e) for e in self._events]
         if kind is not None:
             out = [e for e in out if e["kind"] == kind]
         return out[-max(0, int(limit)):] if limit else out
@@ -632,6 +693,7 @@ class FlightRecorder:
                 "events_total": sum(self.events_total.values()),
                 "events_by_kind": dict(self.events_total),
                 "open_spans": len(self._open),
+                "trace_index": len(self._trace_order),
                 "spans_dropped": self.spans_dropped,
                 "events_dropped": self.events_dropped,
                 "traces_total": self.traces_total,
