@@ -97,6 +97,11 @@ class FleetStartRequest(BaseModel):
     quantize: Optional[str] = Field(default=None, pattern="^int8$")
     kv_cache: Optional[str] = Field(default=None, pattern="^int8$")
     prefix_cache_tokens: int = Field(default=0, ge=0)
+    # Fleet prefix plane: radix prefix index + host-RAM KV tier. Routing
+    # consults the index for the longest-prefix-holding replica; replica
+    # cache overflow spills to (and rehydrates from) the host tier.
+    prefix_plane: bool = False
+    host_kv_budget_mb: int = Field(default=256, ge=1)
     # Autoscaler envelope + SLO.
     min_replicas: int = Field(default=1, ge=0)
     max_replicas: int = Field(default=4, ge=1)
@@ -515,8 +520,17 @@ async def fleet_start(request: web.Request) -> web.Response:
                 spec = spec.model_copy(update={"model_name": cfg.name})
             if spec.estimate() is None:
                 raise ApiError(404, f"unknown model '{spec.model_name}'")
+            plane = None
+            if req.prefix_plane:
+                from tpu_engine.prefix_plane import HostKVTier, PrefixPlane
+
+                plane = PrefixPlane(
+                    host=HostKVTier(
+                        budget_bytes=req.host_kv_budget_mb << 20
+                    ),
+                )
             fleet = ServingFleet(
-                state.scheduler, spec,
+                state.scheduler, spec, prefix_plane=plane,
                 autoscaler=ReplicaAutoscaler(AutoscalerConfig(
                     min_replicas=req.min_replicas,
                     max_replicas=req.max_replicas,
@@ -592,6 +606,28 @@ async def fleet_result(request: web.Request) -> web.Response:
         return json_response(await asyncio.to_thread(fleet.result, rid))
     except KeyError:
         raise ApiError(404, f"request '{rid}' not found")
+
+
+async def prefix_plane_status(request: web.Request) -> web.Response:
+    """Fleet prefix-plane view: the process-wide counters always, plus the
+    live index/host-tier breakdown when a running fleet has a plane
+    attached. Readable with no fleet running (counters at zero) so
+    dashboards and smoke probes never need a 409 branch."""
+
+    def _snap():
+        from tpu_engine import prefix_plane as prefix_plane_mod
+
+        fleet = _fleet
+        plane = getattr(fleet, "prefix_plane", None) if fleet else None
+        doc: dict[str, Any] = {
+            "attached": plane is not None,
+            "counters": prefix_plane_mod.plane_stats(),
+        }
+        if plane is not None:
+            doc["plane"] = plane.stats()
+        return doc
+
+    return json_response(await asyncio.to_thread(_snap))
 
 
 # ---------------------------------------------------------------------------
@@ -727,6 +763,7 @@ def setup(app: web.Application, prefix: str = "/api/v1/serving") -> None:
     app.router.add_post(f"{prefix}/fleet/submit", fleet_submit)
     app.router.add_get(f"{prefix}/fleet/result/{{request_id}}", fleet_result)
     app.router.add_get(f"{prefix}/fleet/status", fleet_status)
+    app.router.add_get(f"{prefix}/prefix_plane", prefix_plane_status)
     app.router.add_post(f"{prefix}/disagg/start", disagg_start)
     app.router.add_post(f"{prefix}/disagg/stop", disagg_stop)
     app.router.add_post(f"{prefix}/disagg/submit", disagg_submit)

@@ -210,6 +210,9 @@ def main() -> None:
     ctl_scale_line = _ctl_scale_metric()
     if ctl_scale_line is not None:
         print(json.dumps(ctl_scale_line))
+    prefix_plane_line = _prefix_plane_metric()
+    if prefix_plane_line is not None:
+        print(json.dumps(prefix_plane_line))
 
 
 def _comm_compress_metric(n_dev: int) -> dict | None:
@@ -642,6 +645,23 @@ def _autopilot_metric() -> dict | None:
         from tpu_engine.twin import autopilot_bench_line
 
         return autopilot_bench_line(seed=0)
+    except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
+        return None
+
+
+def _prefix_plane_metric() -> dict | None:
+    """Fourteenth JSON line: fleet prefix plane A/B — p99 TTFT on the
+    seeded many-tenant shared-prefix trace with the radix-index +
+    host-RAM-tier plane vs per-replica LRU at equal chips, gating a
+    >=2x improvement with tokens/sec no worse, byte-identical repeats,
+    host-tier absorption of replica-cache overflow, and the estimator's
+    structured host-budget rejection (tpu_engine/prefix_plane.py via
+    twin.prefix_plane_bench_line). Never fails the bench: any error
+    degrades to None."""
+    try:
+        from tpu_engine.twin import prefix_plane_bench_line
+
+        return prefix_plane_bench_line(seed=0)
     except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
         return None
 

@@ -1,0 +1,50 @@
+"""Fleet prefix plane A/B: per-replica LRU vs radix index + host tier.
+
+Runs :func:`tpu_engine.twin.prefix_plane_ab` — the twin serving lane
+with a seeded many-tenant shared-prefix trace (32 hot system prompts vs
+4 replicas x 4 resident prefixes, so half the working set cannot be
+device-resident anywhere) through the REAL
+:class:`~tpu_engine.serving_fleet.FleetRouter`, baseline vs with a real
+:class:`~tpu_engine.prefix_plane.PrefixPlane` attached — and prints the
+A/B plus the bench line
+(``JAX_PLATFORMS=cpu python -m benchmarks.prefix_plane_sim``).
+
+Exit gates (process exits 1 when any fails):
+
+- ``plane_beats_baseline_p99_ttft_2x`` — p99 TTFT on repeated shared
+  prefixes improves >= 2x at equal chips;
+- ``tokens_per_sec_no_worse`` — throughput within 1% of baseline;
+- ``deterministic_repeat`` — a second plane run is byte-identical;
+- ``host_tier_absorbs_overflow`` — replica-cache evictions actually
+  land in (and rehydrate from) the host tier;
+- ``host_budget_rejected`` — ``estimate_serving_hbm`` refuses an
+  oversubscribed host budget with a structured reason.
+"""
+
+from __future__ import annotations
+
+import json
+
+from tpu_engine.twin import prefix_plane_ab, prefix_plane_bench_line
+
+
+def main() -> None:
+    res = prefix_plane_ab(seed=0)
+    print(json.dumps({
+        "baseline": res["baseline"],
+        "plane": res["plane"],
+        "ttft_p99_improvement": res["ttft_p99_improvement"],
+        "tokens_per_sec_ratio": res["tokens_per_sec_ratio"],
+        "host_tier_gib": res["host_tier_gib"],
+        "host_budget_rejection": res["host_budget_rejection"],
+        "gates": res["gates"],
+        "ok": res["ok"],
+    }, indent=2))
+    line = prefix_plane_bench_line(seed=0, ab=res)
+    print(json.dumps(line))
+    if not (res["ok"] and line["ok"]):
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

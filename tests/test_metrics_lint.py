@@ -291,3 +291,23 @@ def test_twin_families_always_present(client):
             rf'^tpu_engine_twin_ingest_skipped_lines_total\{{reason="{reason}"\}} ',
             text, re.M,
         ), reason
+
+
+def test_prefix_plane_families_always_present(client):
+    """The fleet prefix plane exports even with no plane attached — the
+    counters render at zero from the first scrape so dashboards and
+    alerting rules never need absent()."""
+    text = _scrape(client)
+    for family in (
+        "tpu_engine_prefix_plane_lookups_total",
+        "tpu_engine_prefix_plane_index_hits_total",
+        "tpu_engine_prefix_plane_host_hits_total",
+        "tpu_engine_prefix_plane_host_stores_total",
+        "tpu_engine_prefix_plane_host_evictions_total",
+        "tpu_engine_prefix_plane_rehydrations_total",
+        "tpu_engine_prefix_plane_hit_tokens_total",
+        "tpu_engine_prefix_plane_index_prefixes",
+        "tpu_engine_prefix_plane_host_entries",
+        "tpu_engine_prefix_plane_host_bytes",
+    ):
+        assert re.search(rf"^{family}[ {{]", text, re.M), family

@@ -382,6 +382,49 @@ def test_router_prefix_affinity_sticks_and_survives_teardown():
     assert r.route([7, 7, 7, 7, 200]) == other
 
 
+def test_router_busy_fallthrough_keeps_live_pin():
+    """A momentarily-full pinned replica must not lose its pin: the
+    fall-through dispatch goes elsewhere, but the NEXT route with a free
+    slot returns to the replica that still holds the prefix KV."""
+    r = FleetRouter(affinity_tokens=4)
+    r.update({"a": _stats(50.0, 8), "b": _stats(50.0, 8)})
+    prompt = [3, 3, 3, 3, 1]
+    pinned = r.route(prompt)
+    other = "b" if pinned == "a" else "a"
+    # Alternate: pinned replica full (fall-through) / free again. Before
+    # the fix each fall-through re-pinned to the OTHER replica, so the
+    # prefix ping-ponged and never re-used its cache.
+    for i in range(6):
+        r.update({pinned: _stats(50.0, 0), other: _stats(50.0, 8)})
+        assert r.route([3, 3, 3, 3, 10 + i]) == other
+        r.update({pinned: _stats(50.0, 8), other: _stats(50.0, 8)})
+        assert r.route([3, 3, 3, 3, 20 + i]) == pinned
+    # The pin is only released when its target actually dies.
+    r.update({other: _stats(50.0, 8)})
+    assert r.route([3, 3, 3, 3, 99]) == other
+
+
+def test_router_affinity_hits_pay_wrr_share():
+    """Affinity picks run the same smooth-WRR ledger as fair rotation:
+    under an interleaved affinity/cold stream on equal-weight replicas,
+    long-run total traffic still splits by weight (the old hit path
+    skipped the ledger, skewing totals ~75/25)."""
+    r = FleetRouter(affinity_tokens=4)
+    r.update({"a": _stats(50.0, 8), "b": _stats(50.0, 8)})
+    hot = [5, 5, 5, 5, 0]
+    pinned = r.route(hot)
+    counts = {"a": 1 if pinned == "a" else 0, "b": 1 if pinned == "b" else 0}
+    for i in range(200):
+        r.update({"a": _stats(50.0, 8), "b": _stats(50.0, 8)})
+        counts[r.route([5, 5, 5, 5, i])] += 1   # affinity hit -> pinned
+        counts[r.route([i, 1000 + i])] += 1     # cold -> WRR
+    total = sum(counts.values())
+    assert counts[pinned] == 201  # every hot prompt stuck to its pin
+    # Equal weights -> both replicas within 45-55% of total traffic.
+    for rid in ("a", "b"):
+        assert 0.45 <= counts[rid] / total <= 0.55, counts
+
+
 # ---------------------------------------------------------------------------
 # ReplicaAutoscaler
 # ---------------------------------------------------------------------------

@@ -99,6 +99,7 @@ def collect_quick() -> list[dict]:
         autopilot_bench_line,
         ctl_scale_bench_line,
         historian_bench_line,
+        prefix_plane_bench_line,
         twin_bench_line,
     )
 
@@ -174,6 +175,7 @@ def collect_quick() -> list[dict]:
         historian_bench_line(seed=0),
         autopilot_bench_line(seed=0),
         ctl_scale_bench_line(seed=0),
+        prefix_plane_bench_line(seed=0),
     ]
 
 

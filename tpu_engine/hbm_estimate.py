@@ -44,6 +44,31 @@ def _itemsize(p: Precision) -> int:
     return jax.numpy.dtype(dtype_of(p)).itemsize
 
 
+class HostBudgetExceeded(ValueError):
+    """A serving host-RAM KV tier was promised more prefix tokens than its
+    budget holds. Structured so admission callers (the prefix plane, the
+    scheduler) can surface the rejection without parsing the message."""
+
+    def __init__(self, model_name: str, host_prefix_tokens: int,
+                 required_gib: float, budget_gib: float):
+        self.model_name = model_name
+        self.host_prefix_tokens = int(host_prefix_tokens)
+        self.required_gib = round(float(required_gib), 4)
+        self.budget_gib = round(float(budget_gib), 4)
+        self.reason = {
+            "kind": "host_budget_exceeded",
+            "model_name": self.model_name,
+            "host_prefix_tokens": self.host_prefix_tokens,
+            "required_gib": self.required_gib,
+            "budget_gib": self.budget_gib,
+        }
+        super().__init__(
+            f"host KV tier for {model_name}: {host_prefix_tokens} prefix "
+            f"tokens need {self.required_gib} GiB host RAM but the budget "
+            f"is {self.budget_gib} GiB"
+        )
+
+
 # ---------------------------------------------------------------------------
 # Exact plane: state accounting from a built program (ex benchmarks/
 # hbm_projection.run_table — the benchmark now imports this).
@@ -315,6 +340,8 @@ def estimate_serving_hbm(
     prefix_cache_tokens: int = 0,
     pool_role: str = "unified",
     inflight_handoffs: Optional[int] = None,
+    host_prefix_tokens: int = 0,
+    host_budget_gib: Optional[float] = None,
 ) -> Optional[HBMEstimate]:
     """Per-device HBM projection for one decode replica.
 
@@ -343,6 +370,16 @@ def estimate_serving_hbm(
     is the pool's steady-state occupant, not an admission transient).
     ``"decode"`` estimates like ``"unified"`` — the full slot pool is the
     honest cost either way.
+
+    ``host_prefix_tokens`` is the fleet prefix plane's host-RAM KV tier
+    (``tpu_engine/prefix_plane.py``): prefix entries parked in host memory
+    as int8 ``KVHandoff`` payloads (codes + per-(layer, token, kv-head)
+    fp32 scales, always int8 — the tier quantizes on store), unsharded
+    (host RAM is per-host, not per-chip). It lands in ``host_gib``, not
+    the device total. When ``host_budget_gib`` is given the projection is
+    checked against it and an oversubscribed tier raises
+    :class:`HostBudgetExceeded` with a structured reason — the plane can
+    never promise KV the host cannot hold.
 
     Returns None for unknown model names — the scheduler then degrades the
     serving submission to capacity-only admission, same as training.
@@ -414,6 +451,25 @@ def estimate_serving_hbm(
         )
     logits = slots * cfg.vocab_size * 4 / tp
 
+    host_bytes = 0.0
+    if host_prefix_tokens > 0:
+        # Host tier stores KVHandoff wire payloads: int8 k/v codes plus one
+        # fp32 scale per (layer, token, kv-head) row of each of k/v. Host
+        # RAM is per-host — no tensor-parallel division.
+        host_per_tok = 2 * cfg.n_layers * cfg.n_kv_heads * (cfg.head_dim + 4)
+        host_bytes = float(host_prefix_tokens) * host_per_tok
+        notes.append(
+            f"host KV tier: {int(host_prefix_tokens)} prefix tokens as int8 "
+            "KVHandoff payloads (codes + per-(layer, token, kv-head) fp32 "
+            "scales), unsharded host RAM"
+        )
+        if host_budget_gib is not None and host_bytes > host_budget_gib * _GIB:
+            raise HostBudgetExceeded(
+                model_name, host_prefix_tokens,
+                required_gib=host_bytes / _GIB,
+                budget_gib=host_budget_gib,
+            )
+
     total = params_dev + kv_pool + working + logits
     return HBMEstimate(
         model_name=model_name,
@@ -425,7 +481,7 @@ def estimate_serving_hbm(
         activations_gib=0.0,
         logits_gib=round(logits / _GIB, 4),
         device_total_gib=round(total / _GIB, 4),
-        host_gib=0.0,
+        host_gib=round(host_bytes / _GIB, 4),
         kv_pool_gib=round(kv_pool / _GIB, 4),
         notes=notes,
     )

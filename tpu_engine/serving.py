@@ -465,9 +465,15 @@ class _PrefixCache:
         self._entries: "collections.OrderedDict[tuple, KVCache]" = \
             collections.OrderedDict()
         self._keys: dict[tuple, np.ndarray] = {}
+        self._hit_counts: dict[tuple, int] = {}
         self.tokens = 0
         self.hits = 0
         self.misses = 0
+        # Reuse signal: total KV tokens served from cache instead of
+        # re-prefilled. hits counts lookups; this counts what they saved —
+        # the number reuse-driven eviction (and the fleet prefix plane's
+        # historian series) actually score on.
+        self.hit_tokens = 0
 
     def lookup(self, prompt: list[int]) -> tuple[int, Optional[KVCache]]:
         """Longest token-level common prefix with any stored entry,
@@ -494,6 +500,8 @@ class _PrefixCache:
             return 0, None
         self._entries.move_to_end(best_key)
         self.hits += 1
+        self.hit_tokens += best_use
+        self._hit_counts[best_key] = self._hit_counts.get(best_key, 0) + 1
         return best_use, self._entries[best_key]
 
     def wants(self, prefix: tuple) -> bool:
@@ -505,6 +513,7 @@ class _PrefixCache:
     def _drop(self, key: tuple) -> None:
         old = self._entries.pop(key)
         self._keys.pop(key)
+        self._hit_counts.pop(key, None)
         self.tokens -= old.max_len
 
     def insert(self, prefix: tuple, entry: KVCache) -> None:
@@ -527,10 +536,21 @@ class _PrefixCache:
         self._keys[prefix] = np.asarray(prefix, dtype=np.int64)
         self.tokens += size
 
-    def stats(self) -> dict[str, int]:
+    def reuse_counts(self) -> dict[tuple, int]:
+        """Per-resident-entry lookup-hit counts (entries never hit read 0)."""
+        return {k: self._hit_counts.get(k, 0) for k in self._entries}
+
+    def stats(self) -> dict[str, Any]:
         return {
             "entries": len(self._entries), "tokens": self.tokens,
             "hits": self.hits, "misses": self.misses,
+            "hit_tokens_total": self.hit_tokens,
+            # LRU order (coldest first) — the eviction order a reuse-aware
+            # policy would second-guess.
+            "entry_hits": [
+                {"prefix_tokens": len(k), "hits": self._hit_counts.get(k, 0)}
+                for k in self._entries
+            ],
         }
 
 
@@ -947,6 +967,104 @@ class ContinuousBatcher:
                 "longer held"
             )
         return out
+
+    # -- fleet prefix plane surface (see tpu_engine/prefix_plane.py) ---------
+
+    def export_prefix(self, prefix: list[int]) -> Optional[Any]:
+        """Ship a resident prefix-cache entry as a :class:`KVHandoff` wire
+        payload (the host tier's transport). The payload covers the WHOLE
+        prefix (``length == len(prefix)``, ``emitted == []``) — it is a
+        cache entry, not a decodable request, and ``submit_prefilled``
+        correctly rejects it; rehydrate with :meth:`install_prefix`. An
+        int8 pool ships codes + scales byte-for-byte; a fp pool ships the
+        wire fp dtype (the host tier quantizes on store). Returns None
+        when the prefix is not resident. Engine-thread only, like every
+        other prefix-cache touch."""
+        from tpu_engine.disagg import KVHandoff
+
+        if self._prefix_cache is None:
+            return None
+        key = tuple(int(t) for t in prefix)
+        entry = self._prefix_cache._entries.get(key)
+        if entry is None:
+            return None
+        T = int(entry.length)
+        k = entry.k[:, 0, :T]  # [L, T, KV, HD]
+        v = entry.v[:, 0, :T]
+        if entry.quantized:
+            return KVHandoff(
+                prompt=list(key), emitted=[], length=T,
+                n_layers=self.cfg.n_layers, n_kv_heads=self.cfg.n_kv_heads,
+                head_dim=self.cfg.head_dim, dtype="int8", quantized=True,
+                k=np.asarray(k), v=np.asarray(v),
+                k_scale=np.asarray(entry.k_scale[:, 0, :T]),
+                v_scale=np.asarray(entry.v_scale[:, 0, :T]),
+            )
+        wire = np.float32 if jnp.dtype(k.dtype) == jnp.dtype(jnp.bfloat16) \
+            else np.dtype(np.asarray(k).dtype)
+        return KVHandoff(
+            prompt=list(key), emitted=[], length=T,
+            n_layers=self.cfg.n_layers, n_kv_heads=self.cfg.n_kv_heads,
+            head_dim=self.cfg.head_dim, dtype=np.dtype(wire).name,
+            quantized=False,
+            k=np.asarray(k, dtype=wire), v=np.asarray(v, dtype=wire),
+        )
+
+    def install_prefix(self, prefix: list[int], handoff: Any) -> bool:
+        """Rehydrate a host-tier payload into this replica's prefix cache
+        so the NEXT prompt sharing ``prefix`` prefills only its tail. The
+        payload's resident K/V must cover the prefix (``handoff.length >=
+        len(prefix)`` with matching history tokens); all four wire×pool
+        dtype conversions ride :func:`tpu_engine.disagg.handoff_to_cache`.
+        Returns False when this engine has no prefix cache or the entry
+        exceeds its budget. Engine-thread only."""
+        import dataclasses as _dc
+
+        from tpu_engine import disagg  # local: disagg imports this module
+
+        if self._prefix_cache is None:
+            return False
+        key = tuple(int(t) for t in prefix)
+        if not key:
+            raise ValueError("empty prefix")
+        if handoff.n_layers != self.cfg.n_layers or \
+                handoff.n_kv_heads != self.cfg.n_kv_heads or \
+                handoff.head_dim != self.cfg.head_dim:
+            raise ValueError(
+                "handoff KV geometry does not match this engine's model "
+                f"({handoff.n_layers}L/{handoff.n_kv_heads}KV/"
+                f"{handoff.head_dim}HD vs {self.cfg.n_layers}L/"
+                f"{self.cfg.n_kv_heads}KV/{self.cfg.head_dim}HD)"
+            )
+        history = list(handoff.prompt) + list(handoff.emitted)
+        if handoff.length < len(key) or \
+                [int(t) for t in history[: len(key)]] != list(key):
+            raise ValueError(
+                "handoff does not cover the prefix: resident K/V is "
+                f"{handoff.length} tokens of a different history"
+            )
+        if not self._prefix_cache.wants(key):
+            # Already resident (success) or over budget (refusal).
+            return key in self._prefix_cache._entries
+        c1 = disagg.handoff_to_cache(
+            handoff, dtype=self._compute_dtype, kv_quant=self.kv_quant,
+            chunk=self.prefill_chunk, max_lanes=self._cache.n_lanes,
+        )
+        # handoff_to_cache leaves ``pos`` at -1 (the slot insert ignores
+        # it); a prefix entry is pasted into fresh ingestion caches, so
+        # give it the lane == position form _slice_prefix stores.
+        c1 = _dc.replace(
+            c1, pos=jnp.arange(c1.max_len, dtype=jnp.int32),
+            length=jnp.asarray(len(key), jnp.int32),
+        )
+        if self._kv_sh is not None:
+            c1_sh = KVCache(k=self._kv_sh, v=self._kv_sh, pos=self._rep,
+                            length=self._rep, ring=False,
+                            k_scale=self._kv_sh if self.kv_quant else None,
+                            v_scale=self._kv_sh if self.kv_quant else None)
+            c1 = jax.device_put(c1, c1_sh)
+        self._prefix_cache.insert(key, c1)
+        return key in self._prefix_cache._entries
 
     def _result_locked(self, req: Request) -> dict[str, Any]:
         out = {

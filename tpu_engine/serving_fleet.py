@@ -411,11 +411,22 @@ class FleetRouter:
     ``affinity_tokens`` match a previously routed prompt stick to that
     replica while it has a free slot, so a shared system prompt keeps
     hitting the replica whose prefix cache already holds it.
+
+    When a :class:`~tpu_engine.prefix_plane.PrefixPlane` is attached, the
+    plane's radix index outranks the fixed-width pin: the route goes to
+    the longest-prefix-HOLDING replica with a free slot (the plane knows
+    which replicas actually retain the KV, the pin only remembers who was
+    sent it last), and the pin re-anchors to the plane's pick. Every
+    cache-steered pick — plane or pin — still pays its smooth-WRR weight
+    share, so cache-heavy traffic cannot skew the fair rotation of the
+    remaining (cold) traffic.
     """
 
-    def __init__(self, affinity_tokens: int = 32, affinity_max: int = 512):
+    def __init__(self, affinity_tokens: int = 32, affinity_max: int = 512,
+                 prefix_plane: Any = None):
         self.affinity_tokens = int(affinity_tokens)
         self.affinity_max = int(affinity_max)
+        self.prefix_plane = prefix_plane
         self._weights: dict[str, float] = {}
         self._current: dict[str, float] = {}
         self._free: dict[str, int] = {}
@@ -423,6 +434,7 @@ class FleetRouter:
             collections.OrderedDict()
         )
         self.affinity_hits = 0
+        self.plane_hits = 0
         self.routed_total = 0
 
     def update(self, replica_stats: dict[str, dict[str, Any]]) -> None:
@@ -451,6 +463,39 @@ class FleetRouter:
                 k for k, rid in self._affinity.items() if rid in dead
             ]:
                 self._affinity.pop(key, None)
+            if self.prefix_plane is not None:
+                for rid in died:
+                    self.prefix_plane.drop_replica(rid)
+
+    def _charge(self, pick: str) -> None:
+        """Smooth-WRR accounting for one dispatch landing on ``pick``:
+        everyone accrues their weight, the pick pays the total. Cache-
+        steered picks (plane/affinity) run the SAME ledger as fair
+        rotation — skipping it would permanently skew later WRR picks
+        toward whichever replicas the cache never favors."""
+        total = sum(self._weights.values())
+        for rid, w in self._weights.items():
+            self._current[rid] = self._current.get(rid, 0.0) + w
+        self._current[pick] -= total
+
+    def _pin(self, key: Optional[tuple], pick: str,
+             overwrite: bool = True) -> None:
+        if key is None:
+            return
+        if not overwrite:
+            cur = self._affinity.get(key)
+            # A live pin survives a busy fall-through: the pinned replica
+            # still HOLDS the prefix KV — re-pinning to this dispatch's
+            # pick would scatter one prefix across the fleet, one replica
+            # per momentary slot-full blip. Only a dead/unknown target
+            # releases the pin.
+            if cur is not None and cur in self._weights:
+                self._affinity.move_to_end(key)
+                return
+        self._affinity[key] = pick
+        self._affinity.move_to_end(key)
+        while len(self._affinity) > self.affinity_max:
+            self._affinity.popitem(last=False)
 
     def route(self, prompt: Any = None) -> Optional[str]:
         """Pick a replica id for this prompt; None when the fleet has no
@@ -461,33 +506,51 @@ class FleetRouter:
         key = None
         if prompt is not None and self.affinity_tokens > 0:
             key = tuple(prompt[: self.affinity_tokens])
+            # Fleet prefix plane first: the radix index knows who HOLDS
+            # the longest prefix (affinity only remembers who was sent it).
+            if self.prefix_plane is not None:
+                rid, matched = self.prefix_plane.route_hint(
+                    list(prompt), self._free
+                )
+                if rid is not None and matched > 0 and \
+                        self._free.get(rid, 0) > 0:
+                    self.plane_hits += 1
+                    self._charge(rid)
+                    self._free[rid] -= 1
+                    self._pin(key, rid)
+                    return rid
             rid = self._affinity.get(key)
             if rid is not None and self._free.get(rid, 0) > 0:
                 self._affinity.move_to_end(key)
                 self.affinity_hits += 1
+                # Affinity picks pay their weight share too — the hit path
+                # skipping the ledger skewed subsequent WRR picks toward
+                # the unpinned replicas under affinity-heavy traffic.
+                self._charge(rid)
                 self._free[rid] -= 1
                 return rid
         # Smooth WRR: current += weight; pick the max; charge it the total.
-        total = sum(self._weights.values())
         for rid, w in self._weights.items():
             self._current[rid] = self._current.get(rid, 0.0) + w
         pick = max(self._current, key=lambda r: self._current[r])
-        self._current[pick] -= total
+        self._current[pick] -= sum(self._weights.values())
         self._free[pick] = max(self._free.get(pick, 0) - 1, 0)
-        if key is not None:
-            self._affinity[key] = pick
-            self._affinity.move_to_end(key)
-            while len(self._affinity) > self.affinity_max:
-                self._affinity.popitem(last=False)
+        # Busy fall-through must NOT overwrite a live pin (satellite of the
+        # prefix plane: the pinned replica still holds the KV).
+        self._pin(key, pick, overwrite=False)
         return pick
 
     def stats(self) -> dict[str, Any]:
-        return {
+        out = {
             "weights": {r: round(w, 4) for r, w in self._weights.items()},
             "affinity_entries": len(self._affinity),
             "affinity_hits": self.affinity_hits,
             "routed_total": self.routed_total,
+            "plane_hits": self.plane_hits,
         }
+        if self.prefix_plane is not None:
+            out["prefix_plane"] = self.prefix_plane.stats()
+        return out
 
 
 class AutoscalerConfig(BaseModel):
@@ -640,15 +703,25 @@ class ServingFleet:
         engine_factory: Callable[[ServingReplicaSpec], Any] = build_replica_engine,
         latency_window: int = 512,
         fault_injector: Optional[Any] = None,
+        prefix_plane: Optional[Any] = None,
     ):
         self.scheduler = scheduler
         self.spec = spec
         self.autoscaler = autoscaler or ReplicaAutoscaler()
-        self.router = router or FleetRouter()
+        self.router = router or FleetRouter(prefix_plane=prefix_plane)
         self.priority = priority
         self.submitter = submitter
         self.engine_factory = engine_factory
         self.fault_injector = fault_injector
+        # Fleet prefix plane (tpu_engine/prefix_plane.py): the router takes
+        # hints from it; dispatch below reports admissions back and spills
+        # replica-cache overflow to its host tier via export_prefix.
+        self.prefix_plane = prefix_plane
+        if prefix_plane is not None:
+            if self.router.prefix_plane is None:
+                self.router.prefix_plane = prefix_plane
+            if prefix_plane.spill is None:
+                prefix_plane.spill = self._spill_prefix
 
         self._lock = threading.RLock()
         self._replicas: dict[str, Submission] = {}  # submission_id → sub
@@ -832,6 +905,8 @@ class ServingFleet:
                 still.append((fid, req))
                 continue
             req["replica"], req["engine_rid"] = sid, rid
+            if self.prefix_plane is not None:
+                self._observe_plane(req["prompt"], sid, engines.get(sid))
             tracing.get_recorder().event(
                 "route",
                 kind="serving",
@@ -840,6 +915,34 @@ class ServingFleet:
                 attrs={"fid": fid, "replica": sid, "engine_rid": rid},
             )
         self._pending.extend(still)
+
+    def _observe_plane(self, prompt: list[int], sid: str, engine: Any) -> None:
+        """Report one admission to the prefix plane; a host-tier hit
+        rehydrates the payload into the replica's prefix cache. Plane
+        bookkeeping is an optimization — it must never fail a dispatch."""
+        try:
+            obs = self.prefix_plane.observe_admit(prompt, sid)
+            if (
+                obs["kind"] == "host"
+                and obs["payload"] is not None
+                and engine is not None
+                and hasattr(engine, "install_prefix")
+            ):
+                engine.install_prefix(list(obs["prefix"]), obs["payload"])
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _spill_prefix(self, prefix: tuple, rid: str) -> Optional[Any]:
+        """Default plane spill: export the evicted prefix's KV off the
+        replica that held it (None when the replica or its entry is gone —
+        the host tier then simply misses)."""
+        eng = self.running_replicas().get(rid)
+        if eng is None or not hasattr(eng, "export_prefix"):
+            return None
+        try:
+            return eng.export_prefix(list(prefix))
+        except Exception:  # noqa: BLE001
+            return None
 
     @staticmethod
     def _engine_router_stats(engine: Any) -> dict[str, Any]:
@@ -1057,4 +1160,8 @@ class ServingFleet:
                 "scale_downs_total": self.scale_downs_total,
                 "router": self.router.stats(),
                 "autoscaler": self.autoscaler.stats(),
+                "prefix_plane": (
+                    None if self.prefix_plane is None
+                    else self.prefix_plane.stats()
+                ),
             }

@@ -45,6 +45,7 @@ the dry-run HTTP entry (``backend/routers/twin.py``); ``bench.py`` and
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import heapq
 import json
@@ -104,6 +105,10 @@ __all__ = [
     "scale_lane",
     "ctl_scale_profile",
     "ctl_scale_bench_line",
+    "PrefixPlaneLaneParams",
+    "prefix_plane_lane",
+    "prefix_plane_ab",
+    "prefix_plane_bench_line",
     "twin_stats",
 ]
 
@@ -2705,4 +2710,311 @@ def ctl_scale_bench_line(seed: int = 0, profile: Optional[dict] = None) -> dict:
         "phases": prof["big"]["phases"],
         "gates": prof["gates"],
         "ok": prof["ok"],
+    }
+
+
+# -- fleet prefix plane lane ---------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PrefixPlaneLaneParams:
+    """Many-tenant shared-prefix serving scenario: more hot system
+    prompts than any one replica's prefix cache can retain, so the
+    fleet's TTFT is set by how prefix residency is managed — per-replica
+    LRU (baseline) vs the fleet prefix plane (radix index routing +
+    host-RAM tier)."""
+
+    duration_s: float = 480.0
+    dt_s: float = 0.05
+    control_period_s: float = 1.0
+    n_replicas: int = 4
+    slots: int = 8
+    tokens_per_slot_s: float = 30.0
+    chips_per_replica: int = 1
+    # Prefill legs: full prompt (cold), resident-prefix tail, and
+    # host-tier rehydration (host->HBM copy + tail) — between the two.
+    prefill_s: float = 1.2
+    prefill_hit_s: float = 0.15
+    prefill_host_s: float = 0.35
+    # 32 hot tenants vs 4 replicas x 4 resident prefixes: half the
+    # working set cannot be device-resident anywhere.
+    n_prefixes: int = 32
+    prefix_len: int = 32
+    replica_cache_prefixes: int = 4
+    # Host tier capacity model: one int8 KVHandoff wire payload per
+    # prefix (a 32-token llama-1b prefix is ~0.2 MiB; 1 MiB is a round
+    # conservative stand-in), budget big enough to absorb the overflow.
+    host_entry_bytes: int = 1 << 20
+    host_budget_entries: int = 64
+    base_rps: float = 4.0
+    burst_rps: float = 10.0
+    burst_every_s: float = 120.0
+    burst_len_s: float = 30.0
+    mean_new_tokens: float = 48.0
+    min_new_tokens: int = 8
+    warmup_s: float = 60.0
+
+
+class _PrefixLaneReplica:
+    """Capacity model of one decode replica for the prefix-plane lane.
+
+    The lane's dispatch loop decides each admission's prefill leg
+    (cold / resident / host-rehydrated) — in baseline mode from this
+    replica's own bounded LRU, in plane mode from
+    ``PrefixPlane.observe_admit`` — so the replica itself only runs
+    slots and stamps ``first_token_at`` when prefill drains."""
+
+    def __init__(self, rid: str, params: PrefixPlaneLaneParams):
+        self.rid = rid
+        self.params = params
+        self.rate = params.tokens_per_slot_s
+        self.active: List[dict] = []
+        # Baseline per-replica residency: LRU over prefix ids, capped at
+        # what the replica's device cache could actually hold.
+        self.cache: "collections.OrderedDict[int, None]" = (
+            collections.OrderedDict()
+        )
+        self.tokens_out = 0.0
+
+    def free_slots(self) -> int:
+        return self.params.slots - len(self.active)
+
+    def touch(self, pid: int) -> bool:
+        """Baseline residency: True on hit; a miss inserts and LRU-evicts
+        past the per-replica budget (the eviction is silent — per-replica
+        LRU has nowhere to put the overflow, which is the point)."""
+        if pid in self.cache:
+            self.cache.move_to_end(pid)
+            return True
+        self.cache[pid] = None
+        while len(self.cache) > self.params.replica_cache_prefixes:
+            self.cache.popitem(last=False)
+        return False
+
+    def admit(self, req: dict, prefill_s: float) -> None:
+        self.active.append({
+            "req": req,
+            "prefill_left": float(prefill_s),
+            "tokens_left": float(req["n_new"]),
+        })
+
+    def step(self, now: float, dt: float, done: List[dict]) -> None:
+        for sl in list(self.active):
+            if sl["prefill_left"] > 0:
+                sl["prefill_left"] -= dt
+                if sl["prefill_left"] <= 0:
+                    # First token lands as prefill drains (the prefill
+                    # logits seed it) — the TTFT stamp the A/B gates on.
+                    sl["req"]["first_token_at"] = now
+                continue
+            produced = min(self.rate * dt, sl["tokens_left"])
+            sl["tokens_left"] -= produced
+            self.tokens_out += produced
+            if sl["tokens_left"] <= 0:
+                sl["req"]["done_at"] = now
+                sl["req"]["replica"] = self.rid
+                done.append(sl["req"])
+                self.active.remove(sl)
+
+    def router_stats(self) -> dict:
+        busy = sum(1 for s in self.active if s["prefill_left"] <= 0)
+        return {
+            "tokens_per_sec": self.rate * max(busy, 0.2),
+            "free_slots": self.free_slots(),
+            "slots": self.params.slots,
+        }
+
+
+def prefix_plane_lane(
+    seed: int,
+    plane: bool,
+    params: PrefixPlaneLaneParams = PrefixPlaneLaneParams(),
+) -> dict:
+    """One seeded many-tenant shared-prefix run at fixed chips, through
+    the REAL :class:`~tpu_engine.serving_fleet.FleetRouter` — baseline
+    (``plane=False``: affinity pinning + per-replica LRU residency) or
+    with a real :class:`~tpu_engine.prefix_plane.PrefixPlane` attached
+    (radix-index routing, host-tier absorption of replica-cache
+    overflow, rehydration on host hits). Fully virtual-clock: same seed
+    and mode give a byte-identical report."""
+    from tpu_engine.prefix_plane import HostKVTier, PrefixPlane
+    from tpu_engine.serving_fleet import FleetRouter
+
+    clock = VirtualClock(0.0)
+    pplane = None
+    if plane:
+        hist = historian_mod.MetricHistorian()
+        host = HostKVTier(
+            budget_bytes=params.host_budget_entries * params.host_entry_bytes,
+            historian=hist, clock=clock, reuse_window_s=params.duration_s,
+        )
+        pplane = PrefixPlane(
+            prefix_tokens=params.prefix_len,
+            replica_prefix_budget=params.replica_cache_prefixes,
+            host=host, historian=hist, clock=clock,
+            # Capacity-model spill: the evicted entry's modeled wire bytes.
+            spill=lambda prefix, rid: params.host_entry_bytes,
+        )
+    router = FleetRouter(affinity_tokens=params.prefix_len,
+                         prefix_plane=pplane)
+    replicas = {
+        f"r{i}": _PrefixLaneReplica(f"r{i}", params)
+        for i in range(params.n_replicas)
+    }
+    trace = bursty_arrivals(
+        seed,
+        duration_s=params.duration_s,
+        base_rps=params.base_rps,
+        burst_rps=params.burst_rps,
+        burst_every_s=params.burst_every_s,
+        burst_len_s=params.burst_len_s,
+        n_prefixes=params.n_prefixes,
+        prefix_len=params.prefix_len,
+        mean_new_tokens=params.mean_new_tokens,
+        min_new_tokens=params.min_new_tokens,
+    )
+    queue: List[dict] = []
+    done: List[dict] = []
+    kinds = {"replica": 0, "host": 0, "cold": 0}
+
+    def control(t: float) -> None:
+        router.update({r.rid: r.router_stats() for r in replicas.values()})
+
+    def tick(t: float) -> None:
+        clock.set(t)
+        free_total = sum(r.free_slots() for r in replicas.values())
+        while queue and free_total > 0:
+            req = queue[0]
+            rid = router.route(req["prompt"])
+            rep = replicas.get(rid) if rid else None
+            if rep is None or rep.free_slots() <= 0:
+                break  # full pick: weights refresh next control period
+            queue.pop(0)
+            free_total -= 1
+            if pplane is not None:
+                obs = pplane.observe_admit(req["prompt"], rid, now=t)
+                kinds[obs["kind"]] += 1
+                prefill = {
+                    "replica": params.prefill_hit_s,
+                    "host": params.prefill_host_s,
+                    "cold": params.prefill_s,
+                }[obs["kind"]]
+            else:
+                hit = rep.touch(req["prefix_id"])
+                kinds["replica" if hit else "cold"] += 1
+                prefill = params.prefill_hit_s if hit else params.prefill_s
+            rep.admit(req, prefill)
+        for r in replicas.values():
+            r.step(t, params.dt_s, done)
+
+    run_open_loop(
+        trace,
+        dt=params.dt_s,
+        duration_s=params.duration_s,
+        pending=lambda: queue or any(r.active for r in replicas.values()),
+        arrive=queue.append,
+        tick=tick,
+        control=control,
+        control_period_s=params.control_period_s,
+        safety_factor=3.0,
+    )
+
+    total_chips = params.n_replicas * params.chips_per_replica
+    metrics = serving_metrics(done, [], warmup_s=params.warmup_s,
+                              total_chips=total_chips, dt_s=params.dt_s)
+    out = {
+        "mode": "plane" if plane else "baseline",
+        "metrics": metrics,
+        "admission_kinds": dict(kinds),
+        "router": {
+            k: v for k, v in router.stats().items() if k != "prefix_plane"
+        },
+    }
+    if pplane is not None:
+        st = pplane.stats()
+        out["plane"] = st
+        out["host_occupancy"] = st["host"]["occupancy"]
+    return out
+
+
+def prefix_plane_ab(
+    seed: int = 0,
+    params: PrefixPlaneLaneParams = PrefixPlaneLaneParams(),
+) -> dict:
+    """The prefix-plane exit gate: baseline vs plane at EQUAL chips on
+    the same seeded trace, a byte-identical plane repeat (determinism),
+    and the estimator's structured host-budget rejection."""
+    from tpu_engine.hbm_estimate import HostBudgetExceeded, estimate_serving_hbm
+
+    base = prefix_plane_lane(seed, plane=False, params=params)
+    plane = prefix_plane_lane(seed, plane=True, params=params)
+    repeat = prefix_plane_lane(seed, plane=True, params=params)
+
+    b, p = base["metrics"], plane["metrics"]
+    improvement = round(b["ttft_p99_ms"] / max(p["ttft_p99_ms"], 1e-9), 2)
+    tps_ratio = round(p["tokens_per_sec"] / max(b["tokens_per_sec"], 1e-9), 4)
+
+    # Admission honesty: a sane host tier budgets through the estimator;
+    # an oversubscribed one is refused with a structured reason.
+    est = estimate_serving_hbm(
+        "llama-1b", params.slots, 2048,
+        host_prefix_tokens=params.host_budget_entries * params.prefix_len,
+        host_budget_gib=4.0,
+    )
+    rejection = None
+    try:
+        estimate_serving_hbm(
+            "llama-1b", params.slots, 2048,
+            host_prefix_tokens=1 << 30, host_budget_gib=1.0,
+        )
+    except HostBudgetExceeded as e:
+        rejection = e.reason
+
+    gates = {
+        "plane_beats_baseline_p99_ttft_2x": improvement >= 2.0,
+        "tokens_per_sec_no_worse": tps_ratio >= 0.99,
+        "deterministic_repeat": plane == repeat,
+        "host_tier_absorbs_overflow": (
+            plane.get("plane", {}).get("host", {}).get("stores", 0) > 0
+            and plane.get("plane", {}).get("host_rehydrations", 0) > 0
+        ),
+        "host_budget_rejected": (
+            rejection is not None
+            and rejection.get("kind") == "host_budget_exceeded"
+            and est is not None and est.host_gib > 0
+        ),
+    }
+    return {
+        "baseline": base,
+        "plane": plane,
+        "ttft_p99_improvement": improvement,
+        "tokens_per_sec_ratio": tps_ratio,
+        "host_tier_gib": None if est is None else est.host_gib,
+        "host_budget_rejection": rejection,
+        "gates": gates,
+        "ok": all(gates.values()),
+    }
+
+
+def prefix_plane_bench_line(seed: int = 0, ab: Optional[dict] = None) -> dict:
+    """The prefix plane's deterministic bench line, shared by ``bench.py``
+    and ``tools/bench_sentinel.py``. The gated value is the baseline/plane
+    p99 TTFT ratio on the seeded shared-prefix trace — deterministic under
+    the virtual clock, so the sentinel gates it like the disagg A/B."""
+    res = ab if ab is not None else prefix_plane_ab(seed=seed)
+    plane = res["plane"]
+    return {
+        "metric": "prefix_plane",
+        "value": res["ttft_p99_improvement"],
+        "unit": "baseline/plane p99 TTFT ratio, shared-prefix trace",
+        "baseline_ttft_p99_ms": res["baseline"]["metrics"]["ttft_p99_ms"],
+        "plane_ttft_p99_ms": plane["metrics"]["ttft_p99_ms"],
+        "tokens_per_sec_ratio": res["tokens_per_sec_ratio"],
+        "host_occupancy": plane.get("host_occupancy", 0.0),
+        "host_stores": plane.get("plane", {}).get("host", {}).get("stores", 0),
+        "host_rehydrations": plane.get("plane", {}).get("host_rehydrations", 0),
+        "admission_kinds": plane["admission_kinds"],
+        "host_tier_gib": res["host_tier_gib"],
+        "gates": res["gates"],
+        "ok": res["ok"],
     }
