@@ -1,0 +1,58 @@
+"""Reshard plane A/B: topology-changing resume vs topology-locked restart.
+
+Runs :func:`tpu_engine.twin.reshard_ab` — the same seeded chip-fault
+trace through same-topology warm self-heal (PR 10's MTTR reference,
+re-derived in-process), the reshard-resume policy that lands every
+recovery on a *different* mesh factorization (data4×fsdp2 ↔ data2×fsdp4,
+shrunk 3×2), and the topology-locked die-and-restart baseline that loses
+steps waiting for the exact mesh — plus the REAL-executor Orbax restore
+round trip (byte-parity leaves across factorizations on the 8-device
+host grid) and the REAL gpt-tiny held-KV / prefix-payload pool migration
+(``JAX_PLATFORMS=cpu python -m benchmarks.reshard_sim``).
+
+Exit gates (process exits 1 when any fails):
+
+- ``zero_lost_steps`` — reshard resume replays no step twice;
+- ``mttr_within_budget`` — topology-changing MTTR <= 1.5x the warm
+  same-topology mean on the same trace;
+- ``beats_topology_locked`` — lower wall clock than the policy that
+  waits for the saved topology (which also loses steps);
+- ``roundtrip_byte_parity`` — every restored leaf's bytes match the
+  source on both alternate factorizations;
+- ``held_requests_complete`` — 100% of held ``hold_kv`` requests finish
+  decode on the destination pool, none left behind;
+- ``int8_parity_within_bound`` — stitched streams within the documented
+  one-token-per-request int8 bound vs the unified baseline;
+- ``prefix_migrates_both_paths`` — the resident prefix crosses both the
+  replica→replica and host-tier rehydration legs;
+- ``deterministic_repeat`` — a second seeded replay is byte-identical.
+"""
+
+from __future__ import annotations
+
+import json
+
+from tpu_engine.twin import reshard_ab, reshard_bench_line
+
+
+def main() -> None:
+    res = reshard_ab(seed=0)
+    print(json.dumps({
+        "same_topology": res["same_topology"],
+        "reshard": res["reshard"],
+        "topology_locked": res["topology_locked"],
+        "roundtrip": res["roundtrip"],
+        "migration": res["migration"],
+        "mttr_ratio": res["mttr_ratio"],
+        "mttr_budget_s": res["mttr_budget_s"],
+        "gates": res["gates"],
+        "ok": res["ok"],
+    }, indent=2))
+    line = reshard_bench_line(seed=0, ab=res)
+    print(json.dumps(line))
+    if not (res["ok"] and line["ok"]):
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

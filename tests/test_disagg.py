@@ -30,6 +30,7 @@ from tpu_engine.disagg import (
     _np_quantize,
     extract_slot_kv,
     handoff_to_cache,
+    rebucket_handoff,
 )
 from tpu_engine.faults import FaultInjector, FaultKind, FaultPlan, FaultSpec
 from tpu_engine.hbm_estimate import estimate_serving_hbm
@@ -298,6 +299,62 @@ def test_handoff_to_cache_rejects_overlong_payload():
     with pytest.raises(ValueError, match="exceeds destination pool lanes"):
         handoff_to_cache(h, dtype=jnp.float32, kv_quant=False,
                          chunk=4, max_lanes=4)
+
+
+def _quant_bound(a):
+    return np.max(np.abs(a)) / 127 + 1e-6
+
+
+def test_rebucket_fp_wire_fp_pool_unequal_geometry():
+    # chunk 4/16 lanes → chunk 7/21 lanes: values survive exactly.
+    h, k, v = _fake_handoff(T=5)
+    out = rebucket_handoff(h, chunk=7, max_lanes=21, kv_quant=False)
+    assert out.dtype == "float32" and not out.quantized
+    assert out.length == h.length
+    assert (out.prompt, out.emitted) == (h.prompt, h.emitted)
+    np.testing.assert_allclose(out.k, k, rtol=1e-6)
+    np.testing.assert_allclose(out.v, v, rtol=1e-6)
+
+
+def test_rebucket_fp_wire_int8_pool_unequal_geometry():
+    # An fp wire landing on a kv_quant pool ships the pool's own codes.
+    h, k, v = _fake_handoff(T=6)
+    out = rebucket_handoff(h, chunk=4, max_lanes=12, kv_quant=True)
+    assert out.quantized and out.k.dtype == np.int8
+    assert out.k_scale is not None
+    deq_k = out.k.astype(np.float32) * out.k_scale
+    deq_v = out.v.astype(np.float32) * out.v_scale
+    assert np.max(np.abs(deq_k - k)) <= _quant_bound(k)
+    assert np.max(np.abs(deq_v - v)) <= _quant_bound(v)
+
+
+def test_rebucket_int8_wire_fp_pool_unequal_geometry():
+    # int8 wire dequantizes into an fp pool within the one-step bound.
+    h, k, v = _fake_handoff(T=5, quantized=True)
+    out = rebucket_handoff(h, chunk=3, max_lanes=9, kv_quant=False)
+    assert out.dtype == "float32" and not out.quantized
+    assert np.max(np.abs(out.k - k)) <= _quant_bound(k)
+    assert np.max(np.abs(out.v - v)) <= _quant_bound(v)
+
+
+def test_rebucket_int8_wire_int8_pool_unequal_geometry():
+    # Codes ship straight through the staging cache: byte-identical.
+    h, k, v = _fake_handoff(T=5, quantized=True)
+    out = rebucket_handoff(h, chunk=8, max_lanes=24, kv_quant=True)
+    assert out.quantized and out.k.dtype == np.int8
+    np.testing.assert_array_equal(out.k, h.k)
+    np.testing.assert_array_equal(out.v, h.v)
+    np.testing.assert_allclose(out.k_scale, h.k_scale, rtol=1e-6)
+    assert np.max(np.abs(out.k.astype(np.float32) * out.k_scale - k)) \
+        <= _quant_bound(k)
+    assert np.max(np.abs(out.v.astype(np.float32) * out.v_scale - v)) \
+        <= _quant_bound(v)
+
+
+def test_rebucket_rejects_overlong_payload():
+    h, _k, _v = _fake_handoff(T=5)
+    with pytest.raises(ValueError, match="exceeds destination pool lanes"):
+        rebucket_handoff(h, chunk=4, max_lanes=4, kv_quant=False)
 
 
 def test_extract_rejects_ring_pools():

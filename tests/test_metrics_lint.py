@@ -311,3 +311,27 @@ def test_prefix_plane_families_always_present(client):
         "tpu_engine_prefix_plane_host_bytes",
     ):
         assert re.search(rf"^{family}[ {{]", text, re.M), family
+
+
+def test_reshard_families_always_present(client):
+    """The reshard plane exports even before anything reshards — the
+    counters render at zero from the first scrape so dashboards and
+    alerting rules never need absent()."""
+    text = _scrape(client)
+    for family in (
+        "tpu_engine_reshard_plans_built_total",
+        "tpu_engine_reshard_plans_applied_total",
+        "tpu_engine_reshard_bytes_remapped_total",
+        "tpu_engine_reshard_parity_checks_total",
+        "tpu_engine_reshard_parity_failures_total",
+        "tpu_engine_reshard_kv_rebuckets_total",
+        "tpu_engine_reshard_kv_rebucket_bytes_total",
+        "tpu_engine_reshard_migrations_total",
+        "tpu_engine_reshard_held_requests_migrated_total",
+        "tpu_engine_reshard_held_requests_completed_total",
+        "tpu_engine_reshard_prefix_payloads_migrated_total",
+        "tpu_engine_reshard_last_plan_bytes",
+        "tpu_engine_reshard_last_plan_leaves",
+        "tpu_engine_reshard_last_migration_mttr_seconds",
+    ):
+        assert re.search(rf"^{family}[ {{]", text, re.M), family

@@ -100,6 +100,7 @@ def collect_quick() -> list[dict]:
         ctl_scale_bench_line,
         historian_bench_line,
         prefix_plane_bench_line,
+        reshard_bench_line,
         twin_bench_line,
     )
 
@@ -176,6 +177,7 @@ def collect_quick() -> list[dict]:
         autopilot_bench_line(seed=0),
         ctl_scale_bench_line(seed=0),
         prefix_plane_bench_line(seed=0),
+        reshard_bench_line(seed=0),
     ]
 
 

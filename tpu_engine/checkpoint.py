@@ -325,6 +325,27 @@ class TrainCheckpointManager:
             attrs={"step": step, "quarantined": list(quarantined)},
         )
 
+    def restore_resharded(
+        self,
+        abstract_state: Any,
+        step: Optional[int] = None,
+        fall_back: bool = True,
+        saved_topology: Optional[dict] = None,
+    ) -> tuple[Optional[int], Any]:
+        """Restore onto a mesh factorization the checkpoint was NOT saved
+        under: Orbax single-replica (host-form) restore, then broadcast
+        each leaf onto ``abstract_state``'s shardings behind a leaf-level
+        checksum parity gate — the reshard plane's training executor
+        (:func:`tpu_engine.reshard.restore_resharded`). Same return shape
+        as :meth:`restore`."""
+        from tpu_engine import reshard
+
+        s, state, _report = reshard.restore_resharded(
+            self, abstract_state, step=step, fall_back=fall_back,
+            saved_topology=saved_topology,
+        )
+        return s, state
+
     def restore_stable(self, abstract_state: Any, before_step: Optional[int] = None):
         """Restore the last *stable* checkpoint (optionally strictly before a step)."""
         stable = self.last_stable_step()

@@ -257,6 +257,47 @@ def handoff_to_cache(
     )
 
 
+def rebucket_handoff(
+    handoff: KVHandoff,
+    *,
+    chunk: int,
+    max_lanes: int,
+    kv_quant: bool,
+) -> KVHandoff:
+    """Re-bucket a wire payload to a *different* destination pool
+    geometry (chunk multiple / lane budget) and storage mode, returning
+    a new wire payload ready for that pool.
+
+    The reshard plane's serving primitive: a replica migrating across
+    pools re-buckets its resident KV through the destination's own
+    ingestion layout (:func:`handoff_to_cache`) and re-extracts
+    (:func:`extract_slot_kv`), so the round trip exercises exactly the
+    lanes/padding/conversion path the destination will decode from —
+    all four fp/int8 wire × pool cases, unequal geometries included. A
+    payload longer than the destination's lane budget raises the same
+    structured ``ValueError`` ingestion would.
+    """
+    import types
+
+    import jax.numpy as jnp
+
+    cache = handoff_to_cache(
+        handoff, dtype=jnp.float32, kv_quant=kv_quant,
+        chunk=chunk, max_lanes=max_lanes,
+    )
+    cfg = types.SimpleNamespace(
+        n_layers=handoff.n_layers,
+        n_kv_heads=handoff.n_kv_heads,
+        head_dim=handoff.head_dim,
+    )
+    return extract_slot_kv(
+        cache, 0, handoff.length, cfg=cfg,
+        prompt=handoff.prompt, emitted=handoff.emitted,
+        quantize=False,  # a kv_quant staging cache already ships codes
+        model_name=handoff.model_name,
+    )
+
+
 # ---------------------------------------------------------------------------
 # Disaggregated fleet
 # ---------------------------------------------------------------------------

@@ -100,6 +100,11 @@ class PlacementPlan(BaseModel):
     # cold compiles — see tpu_engine/compile_index.py).
     compile_warm: Optional[bool] = None
     expected_compile_s: float = 0.0
+    # Reshard verdict (0/None without a resume topology): one-time cost of
+    # remapping the saved checkpoint onto THIS plan's factorization
+    # (tpu_engine/reshard.py cost model) — 0 for a same-topology resume.
+    predicted_reshard_s: float = 0.0
+    reshard_same_topology: Optional[bool] = None
     # Mean relative throughput the cost model assumed for this gang (1.0 =
     # every chip at nominal speed; < 1 when the heterogeneity plane reports
     # degraded hosts — see tpu_engine/hetero.py). Observability only: the
@@ -158,6 +163,7 @@ class PlannerResult(BaseModel):
                 "predicted_comm_s": round(p.predicted_comm_s, 6),
                 "compile_warm": p.compile_warm,
                 "expected_compile_s": round(p.expected_compile_s, 3),
+                "predicted_reshard_s": round(p.predicted_reshard_s, 3),
                 "hbm_gib_per_device": (
                     round(p.hbm_estimate.device_total_gib, 3)
                     if p.hbm_estimate else None
@@ -306,6 +312,12 @@ class PlacementPlanner:
         # plan's one-time compile usually dwarfs that step-time edge).
         self.compile_index = compile_index
         self.prefer_warm_max_slowdown_pct = prefer_warm_max_slowdown_pct
+        # Reshard awareness: when ``plan(saved_topology=...)`` names the
+        # factorization a resume candidate's checkpoints were saved under,
+        # a same-topology plan within this band of the fastest feasible
+        # one outranks every topology-changing plan — the remap is a
+        # one-time cost, so only a real step-time edge justifies it.
+        self.prefer_same_topology_max_slowdown_pct = prefer_warm_max_slowdown_pct
         # Heterogeneity input: a callable returning per-device relative
         # throughputs (1.0 = nominal). The compute term is divided by the
         # gang's mean, so a 25%-degraded host raises the predicted step
@@ -320,6 +332,8 @@ class PlacementPlanner:
         self.plans_chosen_total = 0
         self.no_estimate_refusals_total = 0
         self.warm_tiebreaks_total = 0
+        self.topology_rejected_total = 0
+        self.reshard_tiebreaks_total = 0
         self.prune_reasons: dict[str, int] = {}
         self.last_feasible = 0
         self.last_chosen_predicted_s: Optional[float] = None
@@ -675,6 +689,7 @@ class PlacementPlanner:
         reserved: Optional[dict[int, float]] = None,
         gang: Optional[int] = None,
         n_avail: Optional[int] = None,
+        saved_topology: Optional[dict] = None,
         **enum_kw: Any,
     ) -> PlannerResult:
         """Ranked feasible plans for ``config`` against the live fleet.
@@ -687,6 +702,14 @@ class PlacementPlanner:
         admissible size up to the available device count ("best
         available") — predicted-fastest wins, which naturally prefers the
         largest gang unless its layouts are HBM-infeasible.
+        ``saved_topology``: the mesh factorization a resume candidate's
+        checkpoints were saved under (``tpu_engine.reshard`` manifest).
+        Plans the reshard plane cannot bridge to (pipe extent change) are
+        marked infeasible with a ``no_topology_compatible_checkpoint``
+        skip reason; every other plan is priced with
+        ``predicted_reshard_s`` and ranking prefers a same-topology
+        resume within ``prefer_same_topology_max_slowdown_pct`` of the
+        fastest — the remap only wins on a real step-time edge.
         """
         t_search0 = time.time()
         if config.model_name not in tfm.MODEL_CONFIGS:
@@ -721,6 +744,8 @@ class PlacementPlanner:
                     est = None
                 p.hbm_estimate = est
                 ok, reason = self._hbm_feasible(est, g, devices, reserved)
+                if ok and saved_topology is not None:
+                    ok, reason = self._annotate_reshard(p, saved_topology)
                 p.feasible = ok
                 p.skip_reason = reason
                 (feasible if ok else infeasible).append(p)
@@ -743,16 +768,33 @@ class PlacementPlanner:
         # warm plan more than the knob slower never wins on warmth alone.
         best_ps = min(map(_per_sample, feasible), default=0.0)
         warm_band = best_ps * (1.0 + self.prefer_warm_max_slowdown_pct / 100.0)
+        reshard_band = best_ps * (
+            1.0 + self.prefer_same_topology_max_slowdown_pct / 100.0
+        )
 
-        # Tiebreak equal predicted throughput by expected compile cost
-        # (0 when warm), then projected HBM: when two layouts cost the
-        # same (fully-overlapped comm makes e.g. fsdp16 and data2xfsdp8
-        # identical), the warm one resumes without a compile and the one
+        # Same-topology band (only bites with ``saved_topology``): a plan
+        # resuming without a remap and predicted within the band of the
+        # fastest outranks every topology-changing plan — mirroring the
+        # warm-first band, because both costs are one-time admission taxes
+        # a small step-time edge never amortizes.
+        def _reshard_rank(p: PlacementPlan) -> int:
+            if p.reshard_same_topology is None:
+                return 0  # no resume topology: the term is inert
+            return 0 if (
+                p.reshard_same_topology and _per_sample(p) <= reshard_band
+            ) else 1
+
+        # Tiebreak equal predicted throughput by expected one-time
+        # admission cost (compile when cold + reshard when topology
+        # changes), then projected HBM: when two layouts cost the same
+        # (fully-overlapped comm makes e.g. fsdp16 and data2xfsdp8
+        # identical), the cheaper-to-enter one resumes faster and the one
         # with more headroom is strictly safer to admit.
         feasible.sort(key=lambda p: (
             0 if (p.compile_warm and _per_sample(p) <= warm_band) else 1,
+            _reshard_rank(p),
             _per_sample(p),
-            p.expected_compile_s,
+            p.expected_compile_s + p.predicted_reshard_s,
             p.hbm_estimate.device_total_gib if p.hbm_estimate else float("inf"),
             -p.gang,
         ))
@@ -761,11 +803,18 @@ class PlacementPlanner:
             and feasible[0].compile_warm
             and _per_sample(feasible[0]) > best_ps
         )
+        reshard_tiebreak = bool(
+            feasible
+            and feasible[0].reshard_same_topology
+            and _per_sample(feasible[0]) > best_ps
+        )
         with self._lock:
             self.plans_hbm_rejected_total += len(infeasible)
             self.last_feasible = len(feasible)
             if warm_tiebreak:
                 self.warm_tiebreaks_total += 1
+            if reshard_tiebreak:
+                self.reshard_tiebreaks_total += 1
         return PlannerResult(
             plans=feasible, infeasible=infeasible, pruned=pruned,
             evaluated=evaluated, search_s=time.time() - t_search0,
@@ -785,6 +834,30 @@ class PlacementPlanner:
             sizes.add(p)
             p *= 2
         return sorted(sizes, reverse=True)
+
+    def _annotate_reshard(
+        self, p: PlacementPlan, saved_topology: dict
+    ) -> tuple[bool, Optional[str]]:
+        """Price resuming saved checkpoints onto this plan's mesh.
+
+        Same-topology → zero remap; a bridgeable change → the reshard
+        cost model over the model's params+optimizer bytes; a pipe
+        extent change → infeasible with the structured skip reason the
+        scheduler surfaces verbatim."""
+        from tpu_engine import reshard
+
+        ok, why = reshard.topology_compatible(saved_topology, p.mesh)
+        if not ok:
+            with self._lock:
+                self.topology_rejected_total += 1
+            return False, f"no_topology_compatible_checkpoint: {why}"
+        p.reshard_same_topology = reshard.same_topology(saved_topology, p.mesh)
+        if not p.reshard_same_topology:
+            state_bytes = reshard.state_bytes_for_model(
+                p.config.model_name if p.config is not None else ""
+            )
+            p.predicted_reshard_s = reshard.reshard_cost_s(state_bytes or 0)
+        return True, None
 
     def _hbm_feasible(
         self,
@@ -974,6 +1047,8 @@ class PlacementPlanner:
                 "plans_chosen_total": self.plans_chosen_total,
                 "no_estimate_refusals_total": self.no_estimate_refusals_total,
                 "warm_tiebreaks_total": self.warm_tiebreaks_total,
+                "topology_rejected_total": self.topology_rejected_total,
+                "reshard_tiebreaks_total": self.reshard_tiebreaks_total,
                 "compile_index_attached": self.compile_index is not None,
                 "prefer_warm_max_slowdown_pct": self.prefer_warm_max_slowdown_pct,
                 "last_feasible": self.last_feasible,

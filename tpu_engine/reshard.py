@@ -1,0 +1,574 @@
+"""Reshard plane: topology-changing resume for training state and serving KV.
+
+A checkpoint (or a serving replica's resident KV) is a *layout-indexed
+view* of the job's logical state (ZeRO's partitioned-state formulation,
+arxiv 1910.02054): the bytes are mesh-free, only their placement is not.
+Until now the stack treated the saved layout as part of the state — a
+job preempted on ``data4×fsdp2`` could only resume on ``data4×fsdp2``,
+so grow-back and drain were all-or-nothing per topology (ROADMAP
+"Topology-changing live migration"). This module closes the gap between
+"planner-feasible mesh" and "resumable mesh":
+
+- **Topology manifest** — :func:`write_topology` records the
+  (data×fsdp×pipe×sequence×model) factorization checkpoints were saved
+  under (``reshard_topology.json`` next to the Orbax steps, object-store
+  safe via ``etils.epath``); :func:`read_topology` gives the scheduler
+  and supervisor the saved coordinate without opening a checkpoint.
+
+- **Training executor** — :func:`restore_resharded` extends
+  :class:`~tpu_engine.checkpoint.TrainCheckpointManager`'s
+  restore/abstract-pytree seam: Orbax restores every leaf in the
+  *single-replica host form* (:func:`host_abstract_like` — no target
+  shardings, so the read succeeds regardless of the saved mesh), then
+  each leaf is broadcast onto the target mesh's shardings with
+  ``jax.device_put`` and gated by a **leaf-level checksum parity check**
+  (:func:`leaf_checksums` before vs after placement — a re-placement
+  that changed a single byte raises :class:`ReshardParityError` and
+  quarantines the step instead of silently resuming corrupt state).
+  Injected restore corruption rides the manager's existing
+  quarantine-and-fall-back path untouched.
+
+- **Reshard cost model** — :func:`build_reshard_plan` /
+  :func:`reshard_cost_s` price the remap (bytes staged through host +
+  re-broadcast) so :meth:`tpu_engine.placement.PlacementPlanner.plan`
+  can weigh "resume same-topology, zero remap" against "resume on the
+  predicted-faster mesh, pay the remap once".
+
+- **Serving executor** — :func:`migrate_held_requests` drains a
+  replica's held ``hold_kv`` slots over the existing
+  ``request_handoff``/``submit_prefilled`` machinery into a destination
+  pool of *different* chunk/lane geometry and storage mode (re-bucketing
+  rides :func:`tpu_engine.disagg.rebucket_handoff`), and
+  :func:`migrate_prefix` / :func:`rehydrate_from_host` move
+  prefix-plane payloads (replica-resident or host-tier) across pools.
+
+Compatibility rule: data/fsdp/sequence/model refactorizations are
+always bridgeable (every leaf is a plain array the host form
+re-places); a **pipe extent change is not** — pipeline state is
+stage-stacked, so re-chunking layer stacks across a different stage
+count is a model-surgery problem, not a placement one. The scheduler
+surfaces that case as the structured skip
+``no_topology_compatible_checkpoint:<model>``.
+
+Module-level counters back the always-rendered ``tpu_engine_reshard_*``
+Prometheus families (``backend/routers/metrics.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import threading
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "MESH_AXES",
+    "TOPOLOGY_FILE",
+    "ReshardPlan",
+    "ReshardParityError",
+    "build_reshard_plan",
+    "host_abstract_like",
+    "leaf_checksums",
+    "mesh_topology",
+    "migrate_held_requests",
+    "migrate_prefix",
+    "read_topology",
+    "rehydrate_from_host",
+    "reshard_cost_s",
+    "reshard_stats",
+    "restore_resharded",
+    "same_topology",
+    "topology_compatible",
+    "write_topology",
+]
+
+# The planner's coordinate system (placement-semantics framing): every
+# topology dict is normalized over exactly these axes, missing axes = 1.
+MESH_AXES = ("data", "fsdp", "pipe", "sequence", "model")
+
+TOPOLOGY_FILE = "reshard_topology.json"
+
+# Remap pricing: checkpoint bytes stream host → device over PCIe/ICI at
+# roughly this aggregate rate during a resharded restore (host staging +
+# broadcast); the fixed term covers plan build + parity hashing. Absolute
+# values only scale the planner's tiebreak — ranking needs the ratio to
+# step time, which holds across generations.
+RESHARD_BANDWIDTH_BYTES_S = 2.0e10
+RESHARD_FIXED_OVERHEAD_S = 0.5
+
+
+# -- module health counters (tpu_engine_reshard_* families) -------------------
+
+_STATS_LOCK = threading.Lock()
+_STATS: Dict[str, float] = {
+    "plans_built_total": 0,
+    "plans_applied_total": 0,
+    "bytes_remapped_total": 0,
+    "parity_checks_total": 0,
+    "parity_failures_total": 0,
+    "kv_rebuckets_total": 0,
+    "kv_rebucket_bytes_total": 0,
+    "migrations_total": 0,
+    "held_requests_migrated_total": 0,
+    "held_requests_completed_total": 0,
+    "prefix_payloads_migrated_total": 0,
+    # Gauges: the most recent plan/migration snapshot.
+    "last_plan_bytes": 0,
+    "last_plan_leaves": 0,
+    "last_migration_mttr_s": 0,
+}
+
+
+def reshard_stats() -> Dict[str, float]:
+    """Snapshot of the plane's monotonic counters + last-seen gauges."""
+    with _STATS_LOCK:
+        return dict(_STATS)
+
+
+def _reset_stats_for_tests() -> None:
+    with _STATS_LOCK:
+        for k in _STATS:
+            _STATS[k] = 0
+
+
+def _bump(**deltas: float) -> None:
+    with _STATS_LOCK:
+        for k, v in deltas.items():
+            _STATS[k] += v
+
+
+def _gauge(**values: float) -> None:
+    with _STATS_LOCK:
+        _STATS.update(values)
+
+
+# -- topology manifest --------------------------------------------------------
+
+
+def normalize_topology(topology: Dict[str, Any]) -> Dict[str, int]:
+    """Clamp a topology dict onto :data:`MESH_AXES` (missing axes = 1)."""
+    return {ax: int(topology.get(ax, 1) or 1) for ax in MESH_AXES}
+
+
+def mesh_topology(mesh: Any) -> Dict[str, int]:
+    """The (data×fsdp×pipe×sequence×model) coordinate of a live
+    ``jax.sharding.Mesh`` (axes the mesh does not name count as 1)."""
+    shape = dict(getattr(mesh, "shape", {}) or {})
+    return normalize_topology(shape)
+
+
+def same_topology(a: Dict[str, Any], b: Dict[str, Any]) -> bool:
+    return normalize_topology(a) == normalize_topology(b)
+
+
+def topology_compatible(
+    saved: Dict[str, Any], target: Dict[str, Any]
+) -> Tuple[bool, str]:
+    """Can a checkpoint saved under ``saved`` resume under ``target``?
+
+    data/fsdp/sequence/model extents may differ freely — the host-form
+    restore re-places plain arrays onto any factorization. A ``pipe``
+    extent change re-chunks stage-stacked state and is refused.
+    """
+    s, t = normalize_topology(saved), normalize_topology(target)
+    if s["pipe"] != t["pipe"]:
+        return False, (
+            f"pipe extent {s['pipe']} (saved) != {t['pipe']} (target): "
+            "stage-stacked state cannot be re-chunked"
+        )
+    return True, ""
+
+
+def _topology_path(directory: str):
+    from etils import epath
+
+    from tpu_engine.checkpoint import resolve_checkpoint_dir
+
+    return epath.Path(resolve_checkpoint_dir(directory)) / TOPOLOGY_FILE
+
+
+def write_topology(
+    directory: str,
+    topology: Dict[str, Any],
+    extra: Optional[Dict[str, Any]] = None,
+) -> None:
+    """Record the factorization checkpoints in ``directory`` were saved
+    under. Same path discipline as the stable pointer: ``etils.epath``
+    so ``gs://`` directories work; best-effort (a manifest write must
+    never fail a save)."""
+    payload = {"topology": normalize_topology(topology)}
+    if extra:
+        payload.update(extra)
+    try:
+        _topology_path(directory).write_text(json.dumps(payload))
+    except Exception:
+        log.debug("reshard: topology manifest write failed", exc_info=True)
+
+
+def read_topology(directory: str) -> Optional[Dict[str, int]]:
+    """The saved factorization, or None (no manifest / unreadable)."""
+    try:
+        doc = json.loads(_topology_path(directory).read_text())
+        return normalize_topology(doc["topology"])
+    except Exception:
+        return None
+
+
+# -- reshard plan + cost model ------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class LeafMove:
+    """One leaf's source→target remap entry."""
+
+    path: str
+    shape: Tuple[int, ...]
+    dtype: str
+    nbytes: int
+    dst_spec: str
+
+
+@dataclasses.dataclass
+class ReshardPlan:
+    """How saved shards map onto a target factorization.
+
+    ``bytes_to_remap`` is 0 for a same-topology restore (Orbax places
+    shards directly); a topology change stages every leaf through the
+    host form and re-broadcasts, so the whole state remaps once.
+    """
+
+    src_topology: Dict[str, int]
+    dst_topology: Dict[str, int]
+    moves: List[LeafMove]
+    total_bytes: int
+    bytes_to_remap: int
+    compatible: bool
+    reason: str = ""
+
+    @property
+    def leaves(self) -> int:
+        return len(self.moves)
+
+    @property
+    def is_same_topology(self) -> bool:
+        return self.src_topology == self.dst_topology
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "src_topology": dict(self.src_topology),
+            "dst_topology": dict(self.dst_topology),
+            "leaves": self.leaves,
+            "total_bytes": self.total_bytes,
+            "bytes_to_remap": self.bytes_to_remap,
+            "same_topology": self.is_same_topology,
+            "compatible": self.compatible,
+            "reason": self.reason,
+            "predicted_reshard_s": reshard_cost_s(self.bytes_to_remap),
+        }
+
+
+def _leaf_nbytes(leaf: Any) -> int:
+    import numpy as np
+
+    shape = tuple(getattr(leaf, "shape", ()) or ())
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * int(np.dtype(getattr(leaf, "dtype", "float32")).itemsize)
+
+
+def build_reshard_plan(
+    abstract_target: Any,
+    saved_topology: Dict[str, Any],
+    target_topology: Dict[str, Any],
+) -> ReshardPlan:
+    """Plan the remap of saved shards onto ``abstract_target``'s layout
+    (a pytree of ``jax.ShapeDtypeStruct`` with target shardings)."""
+    import jax
+
+    src = normalize_topology(saved_topology)
+    dst = normalize_topology(target_topology)
+    ok, why = topology_compatible(src, dst)
+    leaves_with_path, _ = jax.tree_util.tree_flatten_with_path(abstract_target)
+    moves: List[LeafMove] = []
+    total = 0
+    for path, leaf in leaves_with_path:
+        nb = _leaf_nbytes(leaf)
+        total += nb
+        sharding = getattr(leaf, "sharding", None)
+        spec = str(getattr(sharding, "spec", "")) if sharding is not None else ""
+        moves.append(LeafMove(
+            path=jax.tree_util.keystr(path),
+            shape=tuple(leaf.shape),
+            dtype=str(leaf.dtype),
+            nbytes=nb,
+            dst_spec=spec,
+        ))
+    plan = ReshardPlan(
+        src_topology=src,
+        dst_topology=dst,
+        moves=moves,
+        total_bytes=total,
+        bytes_to_remap=0 if src == dst else total,
+        compatible=ok,
+        reason=why,
+    )
+    _bump(plans_built_total=1)
+    _gauge(last_plan_bytes=plan.bytes_to_remap, last_plan_leaves=plan.leaves)
+    return plan
+
+
+def reshard_cost_s(
+    bytes_to_remap: int,
+    bandwidth_bytes_s: float = RESHARD_BANDWIDTH_BYTES_S,
+    fixed_s: float = RESHARD_FIXED_OVERHEAD_S,
+) -> float:
+    """Predicted wall seconds a resharded restore adds over a direct
+    same-topology restore. 0 when nothing remaps — the planner's new
+    ranking term is exactly this asymmetry."""
+    if bytes_to_remap <= 0:
+        return 0.0
+    return fixed_s + float(bytes_to_remap) / float(bandwidth_bytes_s)
+
+
+def state_bytes_for_model(model_name: str) -> Optional[int]:
+    """Rough params+optimizer footprint (fp32 master + two Adam moments)
+    the planner prices a remap with; None for models outside the zoo."""
+    from tpu_engine.models import transformer as tfm
+
+    cfg = tfm.MODEL_CONFIGS.get(model_name)
+    if cfg is None:
+        return None
+    return int(tfm.param_count(cfg)) * 12
+
+
+# -- training executor --------------------------------------------------------
+
+
+class ReshardParityError(RuntimeError):
+    """A re-placed leaf's bytes differ from the restored host bytes."""
+
+
+def host_abstract_like(abstract_state: Any) -> Any:
+    """The single-replica restore form of a sharded abstract pytree:
+    same shapes/dtypes, no shardings — Orbax reads every leaf whole on
+    host regardless of the mesh it was saved under."""
+    import jax
+
+    return jax.tree.map(
+        lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype),
+        abstract_state,
+    )
+
+
+def leaf_checksums(state: Any) -> Dict[str, int]:
+    """crc32 of every leaf's host bytes, keyed by tree path. The parity
+    gate hashes the same gathered representation before and after
+    re-placement, so any byte the broadcast corrupted shows up."""
+    import jax
+    import numpy as np
+
+    leaves_with_path, _ = jax.tree_util.tree_flatten_with_path(state)
+    out: Dict[str, int] = {}
+    for path, leaf in leaves_with_path:
+        arr = np.ascontiguousarray(jax.device_get(leaf))
+        out[jax.tree_util.keystr(path)] = zlib.crc32(arr.tobytes())
+    return out
+
+
+def restore_resharded(
+    mgr: Any,
+    abstract_target: Any,
+    *,
+    step: Optional[int] = None,
+    fall_back: bool = True,
+    saved_topology: Optional[Dict[str, Any]] = None,
+    target_topology: Optional[Dict[str, Any]] = None,
+) -> Tuple[Optional[int], Any, Dict[str, Any]]:
+    """Restore a checkpoint onto a *different* mesh factorization.
+
+    ``mgr`` is a :class:`~tpu_engine.checkpoint.TrainCheckpointManager`
+    (duck-typed: ``restore``/``quarantine``/``directory``). The read
+    rides ``mgr.restore`` with the host abstract form — injected restore
+    corruption takes the manager's existing quarantine-and-fall-back
+    path — then every leaf is ``jax.device_put`` onto its target
+    sharding and checksum-parity-gated. Returns ``(step, state,
+    report)``; ``(None, None, report)`` when no checkpoint loads.
+
+    Raises :class:`ReshardParityError` (after quarantining the step)
+    when the re-placement corrupted any leaf.
+    """
+    import jax
+
+    if saved_topology is None:
+        saved_topology = read_topology(getattr(mgr, "directory", "")) or {}
+    if target_topology is None:
+        mesh = _mesh_of(abstract_target)
+        target_topology = mesh_topology(mesh) if mesh is not None else {}
+    plan = build_reshard_plan(abstract_target, saved_topology, target_topology)
+    report: Dict[str, Any] = {"plan": plan.summary(), "step": None,
+                              "parity_ok": None}
+    if not plan.compatible:
+        report["error"] = f"incompatible topology: {plan.reason}"
+        return None, None, report
+
+    s, host_state = mgr.restore(
+        host_abstract_like(abstract_target), step=step, fall_back=fall_back
+    )
+    if host_state is None:
+        report["error"] = "no restorable checkpoint"
+        return None, None, report
+
+    pre = leaf_checksums(host_state)
+    placed = jax.tree.map(
+        lambda leaf, a: (
+            jax.device_put(leaf, a.sharding)
+            if getattr(a, "sharding", None) is not None
+            else jax.device_put(leaf)
+        ),
+        host_state,
+        abstract_target,
+    )
+    post = leaf_checksums(placed)
+    _bump(parity_checks_total=1)
+    if pre != post:
+        bad = sorted(k for k in pre if pre.get(k) != post.get(k))
+        _bump(parity_failures_total=1)
+        try:
+            mgr.quarantine(s)
+        except Exception:
+            pass
+        raise ReshardParityError(
+            f"reshard parity failure at step {s}: {len(bad)} leaf/leaves "
+            f"changed bytes across re-placement (first: {bad[:3]})"
+        )
+    _bump(plans_applied_total=1, bytes_remapped_total=plan.bytes_to_remap)
+    report.update(step=int(s), parity_ok=True, leaves=plan.leaves,
+                  bytes_remapped=plan.bytes_to_remap)
+    return s, placed, report
+
+
+def _mesh_of(abstract_state: Any) -> Any:
+    import jax
+
+    for leaf in jax.tree.leaves(abstract_state):
+        sharding = getattr(leaf, "sharding", None)
+        mesh = getattr(sharding, "mesh", None)
+        if mesh is not None:
+            return mesh
+    return None
+
+
+# -- serving executor ---------------------------------------------------------
+
+
+def rebucket_for_pool(
+    handoff: Any,
+    *,
+    chunk: int,
+    max_lanes: int,
+    kv_quant: bool,
+) -> Any:
+    """Re-bucket a wire payload for a destination pool's geometry and
+    storage mode (counted wrapper over
+    :func:`tpu_engine.disagg.rebucket_handoff`)."""
+    from tpu_engine.disagg import rebucket_handoff
+
+    out = rebucket_handoff(
+        handoff, chunk=chunk, max_lanes=max_lanes, kv_quant=kv_quant
+    )
+    _bump(kv_rebuckets_total=1, kv_rebucket_bytes_total=out.wire_bytes())
+    return out
+
+
+def migrate_held_requests(
+    src_engine: Any,
+    dst_engine: Any,
+    req_ids: Optional[List[int]] = None,
+    *,
+    max_new_tokens: int = 16,
+    quantize: bool = False,
+    pump_steps: int = 200,
+    now_s: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Move every held ``hold_kv`` request from ``src_engine`` onto
+    ``dst_engine`` without dropping any: extract each held slot over the
+    existing ``request_handoff`` path, then re-admit the wire payload
+    through ``submit_prefilled`` (the destination's ``handoff_to_cache``
+    re-buckets to its own chunk/lane geometry and storage mode). Both
+    engines must be caller-stepped (the test/twin drive mode). Returns
+    ``{"mapping": {src_id: dst_id}, "migrated", "wire_bytes"}``.
+    """
+    import time as _time
+
+    if req_ids is None:
+        req_ids = src_engine.held_requests()
+    t0 = _time.time() if now_s is None else None
+    mapping: Dict[int, int] = {}
+    wire_bytes = 0
+    for rid in req_ids:
+        src_engine.request_handoff(rid, quantize=quantize)
+        handoff = None
+        for _ in range(pump_steps):
+            src_engine.step()
+            handoff = src_engine.take_handoff(rid)
+            if handoff is not None:
+                break
+        if handoff is None:
+            raise RuntimeError(
+                f"migration stalled: request {rid} never produced a handoff"
+            )
+        wire_bytes += int(handoff.wire_bytes())
+        mapping[rid] = dst_engine.submit_prefilled(
+            handoff, max_new_tokens=max_new_tokens
+        )
+    mttr = (now_s if now_s is not None
+            else max(_time.time() - t0, 0.0))
+    _bump(migrations_total=1, held_requests_migrated_total=len(mapping))
+    _gauge(last_migration_mttr_s=float(mttr))
+    return {
+        "mapping": mapping,
+        "migrated": len(mapping),
+        "wire_bytes": wire_bytes,
+        "mttr_s": float(mttr),
+    }
+
+
+def note_migrated_completions(n: int) -> None:
+    """Count migrated requests that finished decode on the destination
+    (the caller drives the destination engine and reports back)."""
+    _bump(held_requests_completed_total=int(n))
+
+
+def migrate_prefix(src_engine: Any, dst_engine: Any,
+                   prefix: List[int]) -> bool:
+    """Ship a replica-resident prefix-cache entry across pools:
+    ``export_prefix`` on the source, ``install_prefix`` on the
+    destination (all four wire × pool dtype conversions ride
+    ``handoff_to_cache``). False when the source does not hold the
+    prefix or the destination refuses it."""
+    payload = src_engine.export_prefix(list(prefix))
+    if payload is None:
+        return False
+    ok = bool(dst_engine.install_prefix(list(prefix), payload))
+    if ok:
+        _bump(prefix_payloads_migrated_total=1)
+    return ok
+
+
+def rehydrate_from_host(tier: Any, prefix: List[int], dst_engine: Any,
+                        now: Optional[float] = None) -> bool:
+    """Move a prefix-plane *host-tier* payload into a destination pool's
+    prefix cache — the cross-pool leg of a replica drain (the source
+    replica spilled to host; the replacement pool pulls from it)."""
+    payload = tier.get(prefix, now=now)
+    if payload is None:
+        return False
+    ok = bool(dst_engine.install_prefix(list(prefix), payload))
+    if ok:
+        _bump(prefix_payloads_migrated_total=1)
+    return ok

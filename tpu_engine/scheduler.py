@@ -1007,6 +1007,21 @@ class FleetScheduler:
             return bool(eligible)
         return gang_size(sub.config, len(eligible)) <= len(eligible)
 
+    def _saved_topology(self, sub: Submission) -> Optional[dict]:
+        """The mesh factorization ``sub``'s checkpoints were saved under
+        (reshard-plane manifest next to the Orbax steps), or None: no
+        checkpoint_dir, no manifest yet (fresh job), or unreadable —
+        admission must never block on manifest I/O."""
+        directory = getattr(sub.config, "checkpoint_dir", None)
+        if not directory:
+            return None
+        try:
+            from tpu_engine import reshard
+
+            return reshard.read_topology(directory)
+        except Exception:
+            return None
+
     def _plan_auto(self, sub: Submission, eligible, n_avail: int):
         """Pick the predicted-fastest feasible plan for an auto-placed
         submission. Returns the chosen :class:`PlacementPlan` (its config
@@ -1018,20 +1033,25 @@ class FleetScheduler:
         # and only falls back to smaller gangs when nothing at the
         # requested size is feasible (HBM) or the fleet is degraded.
         requested = gang_size(sub.config, n_avail)
+        # Resume-aware planning: the factorization this submission's
+        # checkpoints were saved under (reshard plane manifest) prices a
+        # remap into every candidate and rejects the ones the plane
+        # cannot bridge (pipe extent changes).
+        saved_topo = self._saved_topology(sub)
         if requested <= n_avail:
             result = self.planner.plan(
                 sub.config, devices=eligible, reserved=self._reserved,
-                gang=requested,
+                gang=requested, saved_topology=saved_topo,
             )
             if not result.plans and not result.skip_reason:
                 result = self.planner.plan(
                     sub.config, devices=eligible, reserved=self._reserved,
-                    n_avail=requested,
+                    n_avail=requested, saved_topology=saved_topo,
                 )
         else:
             result = self.planner.plan(
                 sub.config, devices=eligible, reserved=self._reserved,
-                n_avail=n_avail,
+                n_avail=n_avail, saved_topology=saved_topo,
             )
         if result.skip_reason:  # no_estimate:<model>
             self._note_skip(sub, result.skip_reason)
@@ -1041,6 +1061,21 @@ class FleetScheduler:
             reasons = sorted(
                 {p.skip_reason for p in result.infeasible if p.skip_reason}
             )
+            if any(
+                r.startswith("no_topology_compatible_checkpoint")
+                for r in reasons
+            ):
+                # Some otherwise-admissible layout was refused because the
+                # saved checkpoints only exist for a factorization the
+                # reshard plane cannot bridge (every other rejection here
+                # is HBM/headroom, i.e. could not run regardless) — the
+                # structured skip the queue surface reports instead of a
+                # generic restore failure downstream.
+                self._note_skip(
+                    sub,
+                    f"no_topology_compatible_checkpoint:{sub.config.model_name}",
+                )
+                return None
             self._note_skip(
                 sub,
                 "auto-placement: no feasible layout"
@@ -1108,6 +1143,26 @@ class FleetScheduler:
             sub.estimate = est
         else:
             gang = gang_size(sub.config, n_avail)
+            saved_topo = self._saved_topology(sub)
+            if saved_topo is not None and sub.workload == "training":
+                from tpu_engine import reshard
+
+                target = {
+                    ax: int(getattr(sub.config.mesh, ax, 1) or 1)
+                    for ax in ("fsdp", "pipe", "sequence", "model")
+                }
+                ok, _why = reshard.topology_compatible(saved_topo, target)
+                if not ok:
+                    # A fixed-mesh resume candidate whose checkpoints only
+                    # exist for a factorization the reshard plane cannot
+                    # bridge: refuse with the structured reason instead of
+                    # admitting into a guaranteed restore failure.
+                    self._note_skip(
+                        sub,
+                        "no_topology_compatible_checkpoint:"
+                        f"{sub.config.model_name}",
+                    )
+                    return False
             try:
                 est = estimate_fn(sub.config, n_avail)
             except Exception:  # estimator must never block admission

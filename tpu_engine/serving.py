@@ -931,6 +931,12 @@ class ContinuousBatcher:
         with self._lock:
             self._handoff_requests.append((req_id, None))
 
+    def held_requests(self) -> list[int]:
+        """Request ids currently pinning a held slot — the reshard
+        plane's drain worklist (``tpu_engine.reshard.migrate_held_requests``)."""
+        with self._lock:
+            return sorted(self._held)
+
     def take_handoff(self, req_id: int) -> Any:
         """Non-blocking collect: the extracted :class:`KVHandoff`, or None
         if the engine has not processed the order yet. Raises RuntimeError

@@ -183,6 +183,8 @@ class TrainingJob:
         self.error: Optional[str] = None
         self.rollback_count = 0
         self.resumed_from_step: Optional[int] = None
+        self.resumed_via_reshard: Optional[dict] = None
+        self._topology_written = False
         self.preemption_reason: Optional[str] = None
         self.started_at: Optional[float] = None
         self.finished_at: Optional[float] = None
@@ -347,6 +349,24 @@ class TrainingJob:
         self.preemption_reason = f"self-heal: unhealthy device(s) {bad}"
         self._stop.set()
 
+    def _note_saved_topology(self) -> None:
+        """Best-effort: record the live mesh factorization next to the
+        checkpoints (once per attempt) so a future resume on a different
+        mesh knows it must route through the reshard plane."""
+        if self._topology_written or self.ckpt is None or self.program is None:
+            return
+        try:
+            from tpu_engine import reshard
+
+            reshard.write_topology(
+                self.ckpt.directory,
+                reshard.mesh_topology(self.program.runtime.mesh),
+                extra={"job_id": self.job_id},
+            )
+            self._topology_written = True
+        except Exception:  # noqa: BLE001 — manifest is advisory, never fatal
+            pass
+
     def _final_save(self, step: int) -> bool:
         """Final/emergency checkpoint with bounded retry; never raises.
 
@@ -365,6 +385,8 @@ class TrainingJob:
                 "save-retry", step, f"attempt {attempt}: {err}"
             ),
         )
+        if ok:
+            self._note_saved_topology()
         if self.recovery_state is not None:
             self.recovery_state = "saved" if ok else "save-failed"
             self._record_recovery(
@@ -625,10 +647,30 @@ class TrainingJob:
                 lambda: self.status.value,
             )
 
-            # Resume if checkpoints exist (auto-resume; MTTR path).
+            # Resume if checkpoints exist (auto-resume; MTTR path). When the
+            # saved topology manifest disagrees with the live mesh, route
+            # through the reshard plane so any planner-feasible factorization
+            # is a valid resume target (parity-gated; PR 18).
             start_step = 0
             if self.ckpt is not None and self.ckpt.latest_step() is not None:
-                step, state = self.ckpt.restore(self._abstract_state())
+                from tpu_engine import reshard
+
+                saved_topo = reshard.read_topology(self.ckpt.directory)
+                live_topo = reshard.mesh_topology(prog.runtime.mesh)
+                resharded = (
+                    saved_topo is not None
+                    and not reshard.same_topology(saved_topo, live_topo)
+                )
+                if resharded:
+                    step, state, report = reshard.restore_resharded(
+                        self.ckpt,
+                        self._abstract_state(),
+                        saved_topology=saved_topo,
+                        target_topology=live_topo,
+                    )
+                    self.resumed_via_reshard = report
+                else:
+                    step, state = self.ckpt.restore(self._abstract_state())
                 if state is not None:
                     self._state = state
                     start_step = int(step)
@@ -638,9 +680,13 @@ class TrainingJob:
                         kind="supervisor",
                         trace_id=self.trace_id,
                         parent=attempt_span,
-                        attrs={"from_step": start_step},
+                        attrs={"from_step": start_step, "resharded": resharded},
                     )
-                    log.info("job %s: resumed from checkpoint step %d", self.job_id, start_step)
+                    log.info(
+                        "job %s: resumed from checkpoint step %d%s",
+                        self.job_id, start_step,
+                        " (resharded across topologies)" if resharded else "",
+                    )
             if self._state is None:
                 self._state = prog.init(jax.random.PRNGKey(self.config.seed))
 
@@ -990,6 +1036,7 @@ class TrainingJob:
                         with self._state_lock:  # disk-overlap: saved params
                             self._flush_state()  # must include every update
                         self.ckpt.save(step, self._state, metrics={"loss": host["loss"]})
+                        self._note_saved_topology()
                         self._pending_stable.append(step)
                     self._advance_stable(step)
 
@@ -1461,6 +1508,7 @@ class TrainingJob:
             "current_step": self.current_step,
             "rollback_count": self.rollback_count,
             "resumed_from_step": self.resumed_from_step,
+            "resumed_via_reshard": self.resumed_via_reshard,
             "elastic_mesh": self.elastic_mesh,
             "preemption_reason": self.preemption_reason,
             "recovery_state": self.recovery_state,

@@ -109,6 +109,12 @@ __all__ = [
     "prefix_plane_lane",
     "prefix_plane_ab",
     "prefix_plane_bench_line",
+    "ReshardLaneParams",
+    "replay_reshard_resume",
+    "reshard_roundtrip_report",
+    "reshard_migration_report",
+    "reshard_ab",
+    "reshard_bench_line",
     "twin_stats",
 ]
 
@@ -3015,6 +3021,427 @@ def prefix_plane_bench_line(seed: int = 0, ab: Optional[dict] = None) -> dict:
         "host_rehydrations": plane.get("plane", {}).get("host_rehydrations", 0),
         "admission_kinds": plane["admission_kinds"],
         "host_tier_gib": res["host_tier_gib"],
+        "gates": res["gates"],
+        "ok": res["ok"],
+    }
+
+
+# -- reshard lane: topology-changing resume vs topology-locked restart --------
+
+
+@dataclasses.dataclass(frozen=True)
+class ReshardLaneParams:
+    """The reshard exit-gate scenario knobs. ``state_bytes`` prices the
+    remap leg through :func:`tpu_engine.reshard.reshard_cost_s` — the
+    default is a ~1B-param job (fp32 master + two Adam moments); the
+    MTTR budget is the ratio against the same-trace same-topology warm
+    self-heal mean (PR 10's number re-derived in-process)."""
+
+    train: TrainTwinParams = TrainTwinParams(layout_prefix="reshard")
+    n_faults: int = 12
+    state_bytes: int = 12_000_000_000
+    mttr_budget_ratio: float = 1.5
+
+
+def _reshard_layout_key(use: int, flipped: bool, params: TrainTwinParams) -> str:
+    """Layout key for ``use`` chips under one of its two factorizations:
+    canonical ``data(use/model_axis)×fsdp(model_axis)`` or the flipped
+    alternate — the topology change every reshard resume bridges."""
+    d, m = use // params.model_axis, params.model_axis
+    if flipped:
+        d, m = m, d
+    return f"{params.layout_prefix}|data{d}xfsdp{m}"
+
+
+def _keyed_compile(
+    index: Optional[CompileCacheIndex],
+    key: str,
+    params: TrainTwinParams,
+    precompile: bool,
+) -> Tuple[float, bool]:
+    """Compile leg for an explicit layout key. With ``precompile`` the
+    scheduler compiled the target layout in the background before the
+    cutover (the grow-back discipline), so only the warm relink lands on
+    the critical path."""
+    if index is None:
+        return params.cold_compile_s, False
+    if precompile and not index.is_warm(key):
+        index.record(key, params.cold_compile_s, cache_hit=False,
+                     label=key.split("|", 1)[1], model=params.layout_prefix,
+                     via="precompile")
+    if index.is_warm(key):
+        index.record(key, params.warm_compile_s, cache_hit=True,
+                     via=params.layout_prefix)
+        return params.warm_compile_s, True
+    index.record(key, params.cold_compile_s, cache_hit=False,
+                 label=key.split("|", 1)[1], model=params.layout_prefix,
+                 via=params.layout_prefix)
+    return params.cold_compile_s, False
+
+
+def replay_reshard_resume(
+    events: List[dict],
+    params: TrainTwinParams = TrainTwinParams(layout_prefix="reshard"),
+    state_bytes: int = 12_000_000_000,
+    compile_index: Optional[CompileCacheIndex] = None,
+) -> dict:
+    """Self-heal where every resume lands on a *different factorization*
+    of the surviving chips (data4×fsdp2 → data2×fsdp4 and back), so each
+    recovery pays the reshard plane's remap leg
+    (:func:`tpu_engine.reshard.reshard_cost_s` over ``state_bytes``) on
+    top of save + admit + compile. Zero lost steps, like
+    :func:`replay_self_heal`; the A/B against that lane isolates what
+    topology freedom costs."""
+    from tpu_engine import reshard as reshard_mod
+
+    reshard_s_per = reshard_mod.reshard_cost_s(state_bytes)
+    clock = 0.0
+    healthy = params.n_chips
+    flipped = False  # which factorization the job currently runs under
+    pending: List[float] = []
+    mttrs: List[float] = []
+    grow_backs = 0
+    degraded_s = 0.0
+    warm_resumes = 0
+    cold_resumes = 0
+    compile_s_total = 0.0
+    reshard_s_total = 0.0
+    topology_changes = 0
+    i = 0
+    for step in range(1, params.total_steps + 1):
+        # Grow back onto the canonical factorization of the larger mesh —
+        # a topology change too, so the remap leg rides the cutover.
+        while pending and pending[0] <= clock and healthy < params.n_chips:
+            pending.pop(0)
+            healthy += 1
+            if _usable(healthy, params) > _usable(healthy - 1, params):
+                key = _reshard_layout_key(_usable(healthy, params), False, params)
+                g_compile_s, g_warm = _keyed_compile(
+                    compile_index, key, params, precompile=True
+                )
+                clock += (params.ckpt_save_s + params.resume_admit_s
+                          + g_compile_s + reshard_s_per)
+                compile_s_total += g_compile_s
+                reshard_s_total += reshard_s_per
+                topology_changes += 1
+                flipped = False
+                warm_resumes += 1 if g_warm else 0
+                cold_resumes += 0 if g_warm else 1
+                grow_backs += 1
+        use = _usable(healthy, params)
+        step_t = params.step_time_s * params.n_chips / use
+        clock += step_t
+        if use < params.n_chips:
+            degraded_s += step_t
+        if step % params.ckpt_interval_steps == 0:
+            clock += params.ckpt_save_s
+        if i < len(events) and step >= events[i]["step"]:
+            i += 1
+            healthy -= 1
+            # Shrink-resume onto the ALTERNATE factorization of what
+            # survives: emergency save, re-admit, compile (warm iff the
+            # index has seen that layout), then the state remap.
+            flipped = not flipped
+            key = _reshard_layout_key(_usable(healthy, params), flipped, params)
+            compile_s, warm = _keyed_compile(
+                compile_index, key, params, precompile=False
+            )
+            down = (params.ckpt_save_s + params.resume_admit_s
+                    + compile_s + reshard_s_per)
+            clock += down
+            compile_s_total += compile_s
+            reshard_s_total += reshard_s_per
+            topology_changes += 1
+            warm_resumes += 1 if warm else 0
+            cold_resumes += 0 if warm else 1
+            mttrs.append(step_t + down)
+            pending.append(clock + events[i - 1]["recovery_s"])
+            pending.sort()
+    wall = clock
+    return {
+        "policy": "reshard-resume",
+        "compile_index": compile_index is not None,
+        "wall_s": round(wall, 1),
+        "steps_run": params.total_steps,
+        "lost_steps": 0,
+        "faults": len(mttrs),
+        "grow_backs": grow_backs,
+        "topology_changes": topology_changes,
+        "reshard_s_per_resume": round(reshard_s_per, 2),
+        "reshard_s_total": round(reshard_s_total, 1),
+        "degraded_step_s": round(degraded_s, 1),
+        "warm_resumes": warm_resumes,
+        "cold_resumes": cold_resumes,
+        "compile_s_total": round(compile_s_total, 1),
+        "mttr_mean_s": round(sum(mttrs) / len(mttrs), 2) if mttrs else 0.0,
+        "mttr_max_s": round(max(mttrs), 2) if mttrs else 0.0,
+        "goodput": round(params.total_steps * params.step_time_s / wall, 4),
+    }
+
+
+def reshard_roundtrip_report(seed: int = 0) -> dict:
+    """REAL-executor reshard round trip on the host-platform device grid:
+    a train-style sharded pytree saved under ``data4×fsdp2`` through the
+    real Orbax manager restores — via
+    :func:`tpu_engine.reshard.restore_resharded` — onto ``data2×fsdp4``
+    and a *shrunk* 6-chip ``data3×fsdp2`` mesh, byte-parity-gated leaf
+    by leaf against the source bytes."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from tpu_engine import reshard as reshard_mod
+    from tpu_engine.checkpoint import TrainCheckpointManager
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        return {"skipped": f"needs 8 devices, have {len(devs)}", "ok": False}
+    rng = np.random.default_rng(seed)
+    host = {
+        "params": {
+            "w": rng.standard_normal((16, 8)).astype(np.float32),
+            "b": rng.standard_normal((8,)).astype(np.float32),
+        },
+        "opt": {
+            "mu": rng.standard_normal((16, 8)).astype(np.float32),
+            "nu": rng.standard_normal((16, 8)).astype(np.float32),
+        },
+    }
+    specs = {
+        "params": {"w": PartitionSpec("fsdp"), "b": PartitionSpec("fsdp")},
+        "opt": {"mu": PartitionSpec("fsdp"), "nu": PartitionSpec("fsdp")},
+    }
+    want = reshard_mod.leaf_checksums(host)
+
+    def mesh_for(data: int, fsdp: int) -> Mesh:
+        grid = np.array(devs[: data * fsdp]).reshape(data, fsdp)
+        return Mesh(grid, ("data", "fsdp"))
+
+    src_mesh = mesh_for(4, 2)
+    placed = jax.tree.map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(src_mesh, spec)),
+        host, specs,
+    )
+    out: dict = {"targets": []}
+    with tempfile.TemporaryDirectory() as tmp:
+        mgr = TrainCheckpointManager(tmp, async_save=False)
+        saved = mgr.save(100, placed, wait=True)
+        reshard_mod.write_topology(tmp, reshard_mod.mesh_topology(src_mesh))
+        out["saved"] = bool(saved)
+        out["saved_topology"] = reshard_mod.read_topology(tmp)
+        for d, f in ((2, 4), (3, 2)):
+            tgt_mesh = mesh_for(d, f)
+            abstract = jax.tree.map(
+                lambda leaf, spec: jax.ShapeDtypeStruct(
+                    leaf.shape, leaf.dtype,
+                    sharding=NamedSharding(tgt_mesh, spec),
+                ),
+                host, specs,
+            )
+            _s, state, report = reshard_mod.restore_resharded(
+                mgr, abstract, saved_topology=out["saved_topology"]
+            )
+            got = reshard_mod.leaf_checksums(state) if state is not None else {}
+            out["targets"].append({
+                "topology": reshard_mod.mesh_topology(tgt_mesh),
+                "step": report.get("step"),
+                "parity_ok": bool(report.get("parity_ok")),
+                "leaves": report.get("leaves"),
+                "bytes_remapped": report.get("bytes_remapped"),
+                "byte_parity_vs_source": got == want,
+            })
+    out["ok"] = bool(out["targets"]) and all(
+        t["parity_ok"] and t["byte_parity_vs_source"] and t["step"] == 100
+        for t in out["targets"]
+    )
+    return out
+
+
+def _pump_until_done(engine: Any, rids: List[int], steps: int = 600) -> List[list]:
+    for _ in range(steps):
+        if all(engine.result(r)["status"] == "done" for r in rids):
+            break
+        engine.step()
+    return [engine.result(r)["tokens"] for r in rids]
+
+
+def reshard_migration_report(seed: int = 0) -> dict:
+    """REAL gpt-tiny pool migration: a source replica holding live
+    ``hold_kv`` requests and a resident shared prefix drains onto a
+    destination pool of *different* chunk/lane geometry and int8 storage
+    via :func:`tpu_engine.reshard.migrate_held_requests`. Every held
+    request must complete on the destination (stitched streams within
+    the documented one-token int8 bound of the unified baseline), and
+    the prefix payload must cross both replica→replica and host-tier
+    legs. Engines are caller-stepped; same seed → same weights → a
+    deterministic report (the virtual migration MTTR is the cost model
+    over the actual wire bytes, not wall clock)."""
+    import numpy as np
+
+    from tpu_engine import reshard as reshard_mod
+    from tpu_engine.prefix_plane import HostKVTier
+    from tpu_engine.serving_fleet import ServingReplicaSpec, build_replica_engine
+
+    prompts = [[11, 7, 23, 42, 5], [3, 1, 4, 15, 9, 2]]
+    max_new = 8
+    src = build_replica_engine(ServingReplicaSpec(
+        model_name="gpt-tiny", max_slots=4, max_len=96, prefill_chunk=16,
+        prefix_cache_tokens=256,
+    ))
+    dst = build_replica_engine(ServingReplicaSpec(
+        model_name="gpt-tiny", max_slots=4, max_len=128, prefill_chunk=32,
+        kv_quant=True, prefix_cache_tokens=256,
+    ))
+    ref = build_replica_engine(ServingReplicaSpec(
+        model_name="gpt-tiny", max_slots=2, max_len=96, prefill_chunk=16,
+    ))
+
+    # Unified baseline: the whole request on one replica.
+    refs = [
+        _pump_until_done(ref, [ref.submit(p, max_new_tokens=max_new)])[0]
+        for p in prompts
+    ]
+
+    # Live requests: first token on the source, KV held for migration.
+    first: List[int] = []
+    for p in prompts:
+        rid = src.submit(p, max_new_tokens=1, hold_kv=True)
+        first.append(_pump_until_done(src, [rid])[0][0])
+
+    # A shared prefix resident in the source cache (and spilled to the
+    # host tier) — the prefix-plane payloads a drain must carry along.
+    sys_tokens = np.random.default_rng(seed + 1).integers(1, 250, 64).tolist()
+    _pump_until_done(src, [
+        src.submit(sys_tokens + [9, 9], max_new_tokens=2),
+        src.submit(sys_tokens + [8, 8], max_new_tokens=2),
+    ])
+    key = max(src._prefix_cache._entries, key=len)
+    tier = HostKVTier(budget_bytes=64 << 20,
+                      historian=historian_mod.MetricHistorian(),
+                      clock=VirtualClock(0.0))
+    tier.put(key, handoff=src.export_prefix(list(key)), now=0.0)
+
+    migration = reshard_mod.migrate_held_requests(
+        src, dst, max_new_tokens=max_new - 1
+    )
+    prefix_replica = reshard_mod.migrate_prefix(src, dst, list(key))
+    prefix_host = reshard_mod.rehydrate_from_host(tier, list(key), dst, now=1.0)
+
+    dst_tokens = _pump_until_done(dst, list(migration["mapping"].values()))
+    completed = sum(1 for t in dst_tokens if len(t) == max_new - 1)
+    reshard_mod.note_migrated_completions(completed)
+    mismatches = sum(
+        a != b
+        for f0, tail, want in zip(first, dst_tokens, refs)
+        for a, b in zip([f0, *tail], want)
+    )
+    return {
+        "migrated": int(migration["migrated"]),
+        "completed": int(completed),
+        "held_left_on_src": len(src.held_requests()),
+        "wire_bytes": int(migration["wire_bytes"]),
+        "migration_mttr_s": round(
+            reshard_mod.reshard_cost_s(migration["wire_bytes"]), 3
+        ),
+        "parity_mismatches": int(mismatches),
+        "parity_tokens": sum(len(r) for r in refs),
+        "prefix_replica_migrated": bool(prefix_replica),
+        "prefix_host_rehydrated": bool(prefix_host),
+        "prefix_tokens": len(key),
+        "dst_kv_quant": True,
+    }
+
+
+def reshard_ab(
+    seed: int = 0, params: ReshardLaneParams = ReshardLaneParams()
+) -> dict:
+    """The reshard exit gate: same seeded chip-fault trace through (a)
+    same-topology warm self-heal (PR 10's MTTR reference, re-derived
+    in-process), (b) topology-changing reshard resume, (c) the
+    topology-locked die-and-restart baseline that loses steps waiting
+    for the exact mesh — plus the real-executor restore round trip and
+    the real-engine KV/prefix migration, and a byte-identical repeat."""
+    events = chip_fault_timeline(seed, n_faults=params.n_faults,
+                                 params=params.train)
+
+    idx_same = CompileCacheIndex()
+    seed_initial_compile(idx_same, params.train)
+    same = replay_self_heal(events, params.train, compile_index=idx_same)
+
+    idx_rs = CompileCacheIndex()
+    seed_initial_compile(idx_rs, params.train)
+    rs = replay_reshard_resume(events, params.train,
+                               state_bytes=params.state_bytes,
+                               compile_index=idx_rs)
+    idx_rep = CompileCacheIndex()
+    seed_initial_compile(idx_rep, params.train)
+    repeat = replay_reshard_resume(events, params.train,
+                                   state_bytes=params.state_bytes,
+                                   compile_index=idx_rep)
+
+    locked = replay_die_and_restart(events, params.train)
+    roundtrip = reshard_roundtrip_report(seed)
+    migration = reshard_migration_report(seed)
+
+    budget = round(params.mttr_budget_ratio * same["mttr_mean_s"], 2)
+    ratio = round(rs["mttr_mean_s"] / max(same["mttr_mean_s"], 1e-9), 3)
+    gates = {
+        "zero_lost_steps": rs["lost_steps"] == 0,
+        "mttr_within_budget": rs["mttr_mean_s"] <= budget,
+        "beats_topology_locked": (
+            rs["wall_s"] < locked["wall_s"] and locked["lost_steps"] > 0
+        ),
+        "roundtrip_byte_parity": bool(roundtrip.get("ok")),
+        "held_requests_complete": (
+            migration["completed"] == migration["migrated"] > 0
+            and migration["held_left_on_src"] == 0
+        ),
+        "int8_parity_within_bound": (
+            migration["parity_mismatches"] <= migration["migrated"]
+        ),
+        "prefix_migrates_both_paths": (
+            migration["prefix_replica_migrated"]
+            and migration["prefix_host_rehydrated"]
+        ),
+        "deterministic_repeat": rs == repeat,
+    }
+    return {
+        "same_topology": same,
+        "reshard": rs,
+        "topology_locked": locked,
+        "roundtrip": roundtrip,
+        "migration": migration,
+        "mttr_ratio": ratio,
+        "mttr_budget_s": budget,
+        "gates": gates,
+        "ok": all(gates.values()),
+    }
+
+
+def reshard_bench_line(seed: int = 0, ab: Optional[dict] = None) -> dict:
+    """The reshard plane's deterministic bench line, shared by ``bench.py``
+    and ``tools/bench_sentinel.py``. The gated value is the
+    topology-changing / same-topology-warm MTTR ratio on the seeded
+    chip-fault trace — the exit criterion is that topology freedom costs
+    at most 1.5× the warm same-topology recovery, with zero lost steps
+    and every held serving request completing."""
+    res = ab if ab is not None else reshard_ab(seed=seed)
+    rs = res["reshard"]
+    return {
+        "metric": "reshard",
+        "value": res["mttr_ratio"],
+        "unit": "topology-changing / same-topology warm MTTR ratio",
+        "reshard_mttr_mean_s": rs["mttr_mean_s"],
+        "same_topology_mttr_mean_s": res["same_topology"]["mttr_mean_s"],
+        "mttr_budget_s": res["mttr_budget_s"],
+        "lost_steps": rs["lost_steps"],
+        "locked_lost_steps": res["topology_locked"]["lost_steps"],
+        "topology_changes": rs["topology_changes"],
+        "reshard_s_per_resume": rs["reshard_s_per_resume"],
+        "roundtrip_targets": len(res["roundtrip"].get("targets", [])),
+        "held_migrated": res["migration"]["migrated"],
+        "held_completed": res["migration"]["completed"],
+        "parity_mismatches": res["migration"]["parity_mismatches"],
         "gates": res["gates"],
         "ok": res["ok"],
     }
