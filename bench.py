@@ -216,6 +216,9 @@ def main() -> None:
     reshard_line = _reshard_metric()
     if reshard_line is not None:
         print(json.dumps(reshard_line))
+    spec_pool_line = _spec_pool_metric()
+    if spec_pool_line is not None:
+        print(json.dumps(spec_pool_line))
 
 
 def _comm_compress_metric(n_dev: int) -> dict | None:
@@ -665,6 +668,25 @@ def _reshard_metric() -> dict | None:
         from tpu_engine.twin import reshard_bench_line
 
         return reshard_bench_line(seed=0)
+    except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
+        return None
+
+
+def _spec_pool_metric() -> dict | None:
+    """Sixteenth JSON line: fleet speculative decoding pools A/B —
+    tokens/sec/chip on the seeded bursty multi-tenant trace with paired
+    draft/verify pools vs plain chunked decode at equal chips, gating a
+    >=1.2x win with p99 no worse, the sustained-low-acceptance tenant
+    spilled back to plain decode by the audited historian rule (and no
+    worse off than the baseline), the estimator's structured
+    oversubscribed-draft rejection, a feasible propose-latency-ranked
+    draft placement, and byte-identical repeats (tpu_engine/spec_pool.py
+    via twin.spec_pool_bench_line). Never fails the bench: any error
+    degrades to None."""
+    try:
+        from tpu_engine.twin import spec_pool_bench_line
+
+        return spec_pool_bench_line(seed=0)
     except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
         return None
 

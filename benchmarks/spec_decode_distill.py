@@ -18,6 +18,11 @@ egress, the way a production draft is actually made:
    tok/s; serve plain chunked decode (chunk = gamma+1 — the same tokens
    per dispatch) as the honest baseline.
 
+The whole recipe lives in :func:`run`, parameterized so the tier-1 suite
+can drive a tiny-dims / few-steps pass on CPU (``tests/test_spec_pool.py``
+— the only end-to-end draft-production path must not silently rot);
+``main()`` keeps the measured full-size run TPU-gated.
+
 Run: ``python benchmarks/spec_decode_distill.py``.
 """
 
@@ -41,28 +46,29 @@ TRAIN_STEPS = 300
 DISTILL_STEPS = 300
 
 
-def _bigram_sampler(seed: int):
+def _bigram_sampler(seed: int, vocab: int = VOCAB):
     """A peaked bigram language: every token has one dominant successor
     (p = 0.85), mass elsewhere uniform. Entropy is low but not zero —
     the target will be confidently right most of the time, like natural
     text under a good LM."""
     rng = np.random.default_rng(seed)
-    succ = rng.permutation(VOCAB)
+    succ = rng.permutation(vocab)
 
     def sample(n_rows: int, seq: int, seed2: int) -> np.ndarray:
         r = np.random.default_rng(seed2)
         out = np.empty((n_rows, seq), np.int32)
-        tok = r.integers(0, VOCAB, n_rows)
+        tok = r.integers(0, vocab, n_rows)
         for j in range(seq):
             out[:, j] = tok
             follow = r.random(n_rows) < 0.85
-            tok = np.where(follow, succ[tok], r.integers(0, VOCAB, n_rows))
+            tok = np.where(follow, succ[tok], r.integers(0, vocab, n_rows))
         return out
 
     return sample
 
 
-def _train(model_kw: dict, data: "callable", steps: int, seed: int):
+def _train(model_kw: dict, data: "callable", steps: int, seed: int,
+           seq: int = SEQ, micro_batch: int = 32):
     from tpu_engine.mesh_runtime import MeshConfig, MeshRuntime
     from tpu_engine.models import transformer as tfm
     from tpu_engine.sharding import ShardingStage, TPUTrainConfig
@@ -70,9 +76,13 @@ def _train(model_kw: dict, data: "callable", steps: int, seed: int):
 
     cfg = TPUTrainConfig(
         model_name="gpt-tiny", sharding_stage=ShardingStage.DISABLED,
-        mesh=MeshConfig(data=1), micro_batch_size=32,
-        gradient_accumulation_steps=1, seq_len=SEQ, precision="bf16",
-        learning_rate=3e-4, warmup_steps=20, total_steps=steps,
+        # data=-1 absorbs however many devices the host exposes (1 on a
+        # plain CPU run, 8 under the test suite's forced host devices) —
+        # micro_batch just has to stay divisible by the device count.
+        mesh=MeshConfig(data=-1), micro_batch_size=micro_batch,
+        gradient_accumulation_steps=1, seq_len=seq, precision="bf16",
+        learning_rate=3e-4, warmup_steps=min(20, max(steps // 4, 1)),
+        total_steps=steps,
         activation_checkpointing=False, seed=seed,
     )
     mc = tfm.ModelConfig(**model_kw)
@@ -82,18 +92,18 @@ def _train(model_kw: dict, data: "callable", steps: int, seed: int):
     loss = None
     for i in range(steps):
         batch = jax.numpy.asarray(
-            data(cfg.micro_batch_size, SEQ, 1000 * seed + i)[None]
+            data(cfg.micro_batch_size, seq, 1000 * seed + i)[None]
         )
         state, metrics = prog.step(state, batch)
         loss = metrics["loss"]
     return jax.device_get(state["params"]), mc, float(loss)
 
 
-def _serve_collect(params, mc, prompts, max_new, **kw):
+def _serve_collect(params, mc, prompts, max_new, max_len: int = SEQ, **kw):
     """Run every prompt through a batcher; returns (streams, tok/s, stats)."""
     from tpu_engine.serving import ContinuousBatcher
 
-    srv = ContinuousBatcher(params, mc, max_slots=8, max_len=SEQ,
+    srv = ContinuousBatcher(params, mc, max_slots=8, max_len=max_len,
                             **kw)
     rids = [srv.submit(list(p), max_new_tokens=max_new) for p in prompts]
     t0 = time.perf_counter()
@@ -108,68 +118,105 @@ def _serve_collect(params, mc, prompts, max_new, **kw):
     return streams, toks / dt, srv.stats()
 
 
-def main() -> None:
-    if jax.devices()[0].platform != "tpu":
-        print(json.dumps({"skipped": "needs a local TPU"}))
-        return
-    sample = _bigram_sampler(7)
+def run(
+    *,
+    vocab: int = VOCAB,
+    seq: int = SEQ,
+    gamma: int = GAMMA,
+    train_steps: int = TRAIN_STEPS,
+    distill_steps: int = DISTILL_STEPS,
+    target_kw: dict = None,
+    draft_kw: dict = None,
+    micro_batch: int = 32,
+    prompt_len: int = 16,
+    n_kd_prompts: int = 64,
+    n_eval_prompts: int = 16,
+    max_new: int = 128,
+) -> dict:
+    """The full distill recipe (train target → KD corpus → distill draft
+    → spec-vs-chunked measurement) at caller-chosen scale. Defaults are
+    the measured benchmark; the tier-1 smoke passes tiny dims/steps and
+    runs the identical code path on CPU."""
+    sample = _bigram_sampler(7, vocab)
 
-    target_kw = dict(name="spec-target", vocab_size=VOCAB, d_model=256,
-                     n_layers=4, n_heads=8, n_kv_heads=8, d_ff=1024,
-                     max_seq_len=SEQ)
-    draft_kw = dict(name="spec-draft", vocab_size=VOCAB, d_model=128,
-                    n_layers=1, n_heads=4, n_kv_heads=4, d_ff=512,
-                    max_seq_len=SEQ)
+    target_kw = target_kw or dict(
+        name="spec-target", vocab_size=vocab, d_model=256,
+        n_layers=4, n_heads=8, n_kv_heads=8, d_ff=1024,
+        max_seq_len=seq)
+    draft_kw = draft_kw or dict(
+        name="spec-draft", vocab_size=vocab, d_model=128,
+        n_layers=1, n_heads=4, n_kv_heads=4, d_ff=512,
+        max_seq_len=seq)
 
     t0 = time.time()
-    tgt_params, tgt_cfg, tgt_loss = _train(target_kw, sample, TRAIN_STEPS, 0)
+    tgt_params, tgt_cfg, tgt_loss = _train(
+        target_kw, sample, train_steps, 0, seq=seq, micro_batch=micro_batch)
     t_target = time.time() - t0
 
     # -- sequence-level KD corpus: the target's own greedy streams -------
-    kd_prompts = [sample(1, 16, 10_000 + i)[0].tolist() for i in range(64)]
+    kd_prompts = [sample(1, prompt_len, 10_000 + i)[0].tolist()
+                  for i in range(n_kd_prompts)]
     kd_streams, _, _ = _serve_collect(
-        tgt_params, tgt_cfg, kd_prompts, max_new=SEQ - 16, chunk_steps=16,
+        tgt_params, tgt_cfg, kd_prompts, max_new=seq - prompt_len,
+        max_len=seq, chunk_steps=16,
     )
     kd_rows = np.stack([
         np.concatenate([np.asarray(p, np.int32), np.asarray(s, np.int32)])
         for p, s in zip(kd_prompts, kd_streams)
-    ])  # [64, SEQ]
+    ])  # [n_kd_prompts, seq]
 
-    def kd_data(n_rows: int, seq: int, seed2: int) -> np.ndarray:
+    def kd_data(n_rows: int, seq2: int, seed2: int) -> np.ndarray:
         r = np.random.default_rng(seed2)
-        return kd_rows[r.integers(0, kd_rows.shape[0], n_rows), :seq]
+        return kd_rows[r.integers(0, kd_rows.shape[0], n_rows), :seq2]
 
     t0 = time.time()
-    dr_params, dr_cfg, dr_loss = _train(draft_kw, kd_data, DISTILL_STEPS, 1)
+    dr_params, dr_cfg, dr_loss = _train(
+        draft_kw, kd_data, distill_steps, 1, seq=seq,
+        micro_batch=micro_batch)
     t_draft = time.time() - t0
 
     # -- measurement: same held-out prompts, spec vs chunked -------------
-    prompts = [sample(1, 16, 99_000 + i)[0].tolist() for i in range(16)]
-    max_new = 128
+    prompts = [sample(1, prompt_len, 99_000 + i)[0].tolist()
+               for i in range(n_eval_prompts)]
     spec_streams, spec_tps, spec_stats = _serve_collect(
-        tgt_params, tgt_cfg, prompts, max_new,
-        draft_params=dr_params, draft_cfg=dr_cfg, spec_gamma=GAMMA,
+        tgt_params, tgt_cfg, prompts, max_new, max_len=seq,
+        draft_params=dr_params, draft_cfg=dr_cfg, spec_gamma=gamma,
     )
     plain_streams, plain_tps, _ = _serve_collect(
-        tgt_params, tgt_cfg, prompts, max_new, chunk_steps=GAMMA + 1,
+        tgt_params, tgt_cfg, prompts, max_new, max_len=seq,
+        chunk_steps=gamma + 1,
     )
     agree = np.mean([
         np.mean(np.asarray(a[: len(b)]) == np.asarray(b[: len(a)]))
         for a, b in zip(spec_streams, plain_streams)
     ])
-    print(json.dumps({
+    return {
         "metric": "spec_decode_distilled_draft",
-        "target": {"layers": 4, "d_model": 256, "final_loss": round(tgt_loss, 3),
+        "target": {"layers": target_kw["n_layers"],
+                   "d_model": target_kw["d_model"],
+                   "final_loss": round(tgt_loss, 3),
                    "train_s": round(t_target, 1)},
-        "draft": {"layers": 1, "d_model": 128, "final_loss": round(dr_loss, 3),
+        "draft": {"layers": draft_kw["n_layers"],
+                  "d_model": draft_kw["d_model"],
+                  "final_loss": round(dr_loss, 3),
                   "distill_s": round(t_draft, 1)},
-        "gamma": GAMMA,
+        "gamma": gamma,
         "alpha_accept_rate": spec_stats.get("spec_accept_rate"),
+        "spec_rounds": spec_stats.get("spec_rounds"),
+        "spec_tokens_accepted": spec_stats.get("spec_tokens_accepted"),
+        "spec_tokens_proposed": spec_stats.get("spec_tokens_proposed"),
         "spec_tokens_per_sec": round(spec_tps, 1),
         "chunked_baseline_tokens_per_sec": round(plain_tps, 1),
         "spec_vs_chunked": round(spec_tps / plain_tps, 2),
         "stream_agreement": round(float(agree), 3),
-    }))
+    }
+
+
+def main() -> None:
+    if jax.devices()[0].platform != "tpu":
+        print(json.dumps({"skipped": "needs a local TPU"}))
+        return
+    print(json.dumps(run()))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,62 @@
+"""Fleet speculative decoding pools A/B: plain chunked decode vs
+draft/verify pools with acceptance-aware spill.
+
+Runs :func:`tpu_engine.twin.spec_pool_ab` — the twin serving lane with a
+seeded bursty multi-tenant trace at EQUAL chips through the REAL
+:class:`~tpu_engine.serving_fleet.FleetRouter`, a real
+:class:`~tpu_engine.historian.MetricHistorian` carrying the per-tenant
+``serving.spec.accept_rate`` series, and a real
+:class:`~tpu_engine.spec_pool.SpecSpillController` consulting it on the
+control cadence — and prints the A/B plus the bench line
+(``JAX_PLATFORMS=cpu python -m benchmarks.spec_pool_sim``).
+
+Exit gates (process exits 1 when any fails):
+
+- ``spec_beats_plain_tokens_per_chip`` — tokens/sec/chip improves >=
+  1.2x at equal chips on the bursty trace (offered load saturates plain
+  decode; the speculative pools absorb it);
+- ``p99_no_worse`` — end-to-end p99 latency no worse than plain;
+- ``low_alpha_tenant_spilled`` — the junk-draft tenant (sustained α far
+  below the floor) is spilled back to plain chunked decode by the
+  historian-consulting rule, with an audited fired DecisionRecord;
+- ``spilled_tenant_not_below_plain_baseline`` — the spilled tenant's
+  p99 is no worse than it would have been without speculation (a bad
+  draft can never make serving slower than the baseline);
+- ``deterministic_repeat`` — a second spec run is byte-identical;
+- ``draft_hbm_rejected`` — ``estimate_serving_hbm`` refuses an
+  oversubscribed colocated draft with a structured reason;
+- ``draft_plan_feasible`` — ``plan_serving_pool(role="draft")`` finds a
+  propose-latency-ranked layout inside small fragmented headroom.
+"""
+
+from __future__ import annotations
+
+import json
+
+from tpu_engine.twin import spec_pool_ab, spec_pool_bench_line
+
+
+def main() -> None:
+    res = spec_pool_ab(seed=0)
+    print(json.dumps({
+        "plain": res["plain"],
+        "spec": res["spec"],
+        "tokens_per_sec_per_chip_ratio": res["tokens_per_sec_per_chip_ratio"],
+        "p99_ratio": res["p99_ratio"],
+        "low_alpha_tenant": res["low_alpha_tenant"],
+        "low_alpha_tenant_p99_ratio": res["low_alpha_tenant_p99_ratio"],
+        "spill_decisions_fired": res["spill_decisions_fired"],
+        "draft_hbm_rejection": res["draft_hbm_rejection"],
+        "spec_replica_gib": res["spec_replica_gib"],
+        "draft_plan_label": res["draft_plan_label"],
+        "gates": res["gates"],
+        "ok": res["ok"],
+    }, indent=2))
+    line = spec_pool_bench_line(seed=0, ab=res)
+    print(json.dumps(line))
+    if not (res["ok"] and line["ok"]):
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

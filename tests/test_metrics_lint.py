@@ -335,3 +335,41 @@ def test_reshard_families_always_present(client):
         "tpu_engine_reshard_last_migration_mttr_seconds",
     ):
         assert re.search(rf"^{family}[ {{]", text, re.M), family
+
+
+def test_serving_spec_families_always_present(client):
+    """Per-replica speculative telemetry exports even with no serving
+    engine registered (and with a non-speculative one) — rendered at
+    zero so fleet acceptance dashboards never need absent()."""
+    text = _scrape(client)
+    for family in (
+        "tpu_engine_serving_spec_decoding",
+        "tpu_engine_serving_spec_accept_rate",
+        "tpu_engine_serving_spec_rounds_total",
+        "tpu_engine_serving_spec_accepted_tokens_total",
+        "tpu_engine_serving_spec_proposed_tokens_total",
+    ):
+        assert re.search(rf"^{family}[ {{]", text, re.M), family
+
+
+def test_spec_pool_families_always_present(client):
+    """The speculative pool plane exports even before any spec fleet
+    exists — the counters render at zero from the first scrape so
+    dashboards and alerting rules never need absent()."""
+    text = _scrape(client)
+    for family in (
+        "tpu_engine_spec_pool_requests_total",
+        "tpu_engine_spec_pool_draft_legs_total",
+        "tpu_engine_spec_pool_verify_legs_total",
+        "tpu_engine_spec_pool_plain_legs_total",
+        "tpu_engine_spec_pool_canary_probes_total",
+        "tpu_engine_spec_pool_accepted_tokens_total",
+        "tpu_engine_spec_pool_proposed_tokens_total",
+        "tpu_engine_spec_pool_spills_total",
+        "tpu_engine_spec_pool_restores_total",
+        "tpu_engine_spec_pool_spill_decisions_total",
+        "tpu_engine_spec_pool_draft_cache_invalidations_total",
+        "tpu_engine_spec_pool_tenants_total",
+        "tpu_engine_spec_pool_tenants_spilled",
+    ):
+        assert re.search(rf"^{family}[ {{]", text, re.M), family

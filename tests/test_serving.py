@@ -347,6 +347,44 @@ def test_speculative_serving_guards():
         ContinuousBatcher(params, cfg, draft_params=dparams)
 
 
+def test_speculative_geometry_errors_are_structured():
+    """Construction-time draft geometry failures carry a machine-readable
+    ``.reason`` (kind + offending dims) so fleet admission (spec_pool /
+    placement) can reject plans without string-matching messages. They
+    stay ``ValueError`` subclasses — existing ``match=`` guards hold."""
+    from tpu_engine.serving import SpecGeometryError
+
+    cfg = tfm.MODEL_CONFIGS["gpt-tiny"]
+    params = tfm.init_params(jax.random.PRNGKey(3), cfg, dtype=jnp.float32)
+    draft_cfg = cfg.with_(name="d", n_layers=1)
+    dparams = tfm.init_params(jax.random.PRNGKey(4), draft_cfg,
+                              dtype=jnp.float32)
+
+    with pytest.raises(SpecGeometryError) as ei:
+        ContinuousBatcher(params, cfg, draft_params=dparams)
+    assert ei.value.reason["kind"] == "draft_cfg_missing"
+
+    with pytest.raises(SpecGeometryError) as ei:
+        ContinuousBatcher(params, cfg, draft_params=dparams,
+                          draft_cfg=draft_cfg.with_(vocab_size=64))
+    assert ei.value.reason == {
+        "kind": "draft_vocab_mismatch", "draft_vocab": 64,
+        "target_vocab": cfg.vocab_size,
+    }
+
+    with pytest.raises(SpecGeometryError) as ei:
+        ContinuousBatcher(params, cfg.with_(sliding_window=8),
+                          draft_params=dparams, draft_cfg=draft_cfg)
+    assert ei.value.reason["kind"] == "draft_ring_window"
+    assert ei.value.reason["target_window"] == 8
+
+    with pytest.raises(SpecGeometryError) as ei:
+        ContinuousBatcher(params, cfg, draft_params=dparams,
+                          draft_cfg=draft_cfg, spec_gamma=0)
+    assert ei.value.reason == {"kind": "spec_gamma_invalid",
+                               "spec_gamma": 0}
+
+
 def test_mesh_sharded_serving_matches_single_device():
     """Round-4 headline: the batcher runs under a mesh — params TP/FSDP
     sharded, the KV pool's kv-heads dim sharded over the ``model`` axis —

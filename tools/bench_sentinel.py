@@ -101,6 +101,7 @@ def collect_quick() -> list[dict]:
         historian_bench_line,
         prefix_plane_bench_line,
         reshard_bench_line,
+        spec_pool_bench_line,
         twin_bench_line,
     )
 
@@ -178,6 +179,7 @@ def collect_quick() -> list[dict]:
         ctl_scale_bench_line(seed=0),
         prefix_plane_bench_line(seed=0),
         reshard_bench_line(seed=0),
+        spec_pool_bench_line(seed=0),
     ]
 
 

@@ -69,6 +69,35 @@ class HostBudgetExceeded(ValueError):
         )
 
 
+class SpecHBMOversubscribed(ValueError):
+    """A speculative replica (target + colocated draft weights and draft KV
+    pool) was asked to fit in less device HBM than the projection needs.
+    Structured so the spec-pool placement plane can surface the rejection
+    without parsing the message — same shape as :class:`HostBudgetExceeded`."""
+
+    def __init__(self, model_name: str, draft_model_name: str,
+                 required_gib: float, budget_gib: float, draft_gib: float):
+        self.model_name = model_name
+        self.draft_model_name = draft_model_name
+        self.required_gib = round(float(required_gib), 4)
+        self.budget_gib = round(float(budget_gib), 4)
+        self.draft_gib = round(float(draft_gib), 4)
+        self.reason = {
+            "kind": "spec_hbm_oversubscribed",
+            "model_name": self.model_name,
+            "draft_model_name": self.draft_model_name,
+            "required_gib": self.required_gib,
+            "budget_gib": self.budget_gib,
+            "draft_gib": self.draft_gib,
+        }
+        super().__init__(
+            f"speculative replica {model_name}+{draft_model_name}: needs "
+            f"{self.required_gib} GiB/device ({self.draft_gib} GiB of it "
+            f"draft weights + draft KV) but the budget is "
+            f"{self.budget_gib} GiB"
+        )
+
+
 # ---------------------------------------------------------------------------
 # Exact plane: state accounting from a built program (ex benchmarks/
 # hbm_projection.run_table — the benchmark now imports this).
@@ -342,6 +371,8 @@ def estimate_serving_hbm(
     inflight_handoffs: Optional[int] = None,
     host_prefix_tokens: int = 0,
     host_budget_gib: Optional[float] = None,
+    draft_model_name: Optional[str] = None,
+    device_budget_gib: Optional[float] = None,
 ) -> Optional[HBMEstimate]:
     """Per-device HBM projection for one decode replica.
 
@@ -381,6 +412,19 @@ def estimate_serving_hbm(
     :class:`HostBudgetExceeded` with a structured reason — the plane can
     never promise KV the host cannot hold.
 
+    ``pool_role="draft"`` estimates like ``"unified"`` (a draft pool's
+    replicas are ordinary decode pools, just tiny — the role exists so the
+    spec-pool planner can rank/backfill them separately). Independently,
+    ``draft_model_name`` sizes a **speculative** replica: the target model
+    plus a colocated draft — draft weights at the compute dtype (unsharded:
+    speculative serving is single-chip, ``serving.py`` rejects ``mesh=``)
+    and a second full-slot KV pool at the draft's geometry, exactly what
+    ``ContinuousBatcher(draft_params=...)`` allocates. When
+    ``device_budget_gib`` is given the draft-augmented total is checked
+    against it and oversubscription raises :class:`SpecHBMOversubscribed`
+    with a structured reason — a draft can never be promised HBM the
+    verify pool does not actually have spare.
+
     Returns None for unknown model names — the scheduler then degrades the
     serving submission to capacity-only admission, same as training.
     """
@@ -390,9 +434,9 @@ def estimate_serving_hbm(
     cfg = tfm.MODEL_CONFIGS.get(model_name)
     if cfg is None:
         return None
-    if pool_role not in ("unified", "prefill", "decode"):
+    if pool_role not in ("unified", "prefill", "decode", "draft"):
         raise ValueError(
-            f"pool_role must be unified|prefill|decode, got {pool_role!r}"
+            f"pool_role must be unified|prefill|decode|draft, got {pool_role!r}"
         )
 
     tp = max(int(tensor_parallel), 1)
@@ -451,6 +495,24 @@ def estimate_serving_hbm(
         )
     logits = slots * cfg.vocab_size * 4 / tp
 
+    draft_bytes = 0.0
+    if draft_model_name is not None:
+        draft_cfg = tfm.MODEL_CONFIGS.get(draft_model_name)
+        if draft_cfg is None:
+            return None
+        # Colocated draft: weights at the compute dtype, unsharded (the
+        # speculative engine is single-chip), plus a second full-slot KV
+        # pool at the draft's geometry — init_slot_cache(draft_cfg, ...)
+        # in ContinuousBatcher, always unquantized.
+        draft_lanes = ring_lanes(draft_cfg, int(max_len), int(prefill_chunk))
+        draft_kv = (2 * draft_cfg.n_layers * slots * draft_lanes
+                    * draft_cfg.n_kv_heads * draft_cfg.head_dim * compute_b)
+        draft_bytes = tfm.param_count(draft_cfg) * compute_b + draft_kv
+        notes.append(
+            f"speculative: draft {draft_model_name} colocated "
+            f"({draft_bytes / _GIB:.3f} GiB weights + draft KV, unsharded)"
+        )
+
     host_bytes = 0.0
     if host_prefix_tokens > 0:
         # Host tier stores KVHandoff wire payloads: int8 k/v codes plus one
@@ -470,7 +532,14 @@ def estimate_serving_hbm(
                 budget_gib=host_budget_gib,
             )
 
-    total = params_dev + kv_pool + working + logits
+    total = params_dev + kv_pool + working + logits + draft_bytes
+    if device_budget_gib is not None and total > device_budget_gib * _GIB:
+        raise SpecHBMOversubscribed(
+            model_name, draft_model_name or "<none>",
+            required_gib=total / _GIB,
+            budget_gib=device_budget_gib,
+            draft_gib=draft_bytes / _GIB,
+        )
     return HBMEstimate(
         model_name=model_name,
         gang_devices=tp,

@@ -1087,7 +1087,7 @@ class ServingPoolPlan(BaseModel):
 
     model_config = ConfigDict(arbitrary_types_allowed=True)
 
-    role: str  # "prefill" | "decode"
+    role: str  # "prefill" | "decode" | "draft"
     tensor_parallel: int
     replicas: int
     max_slots: int
@@ -1096,6 +1096,7 @@ class ServingPoolPlan(BaseModel):
     weight_quant: Optional[str] = None
     predicted_prefill_s: float = 0.0  # one max_len prompt through one replica
     predicted_decode_tok_s: float = 0.0  # pool-aggregate steady-state tokens/s
+    predicted_propose_s: float = 0.0  # gamma sequential draft steps (draft role)
     hbm_estimate: Optional[HBMEstimate] = None
     feasible: bool = True
     skip_reason: Optional[str] = None
@@ -1132,6 +1133,7 @@ def plan_serving_pool(
     kv_quant: bool = False,
     weight_quant: Optional[str] = None,
     prefill_chunk: int = 256,
+    spec_gamma: int = 4,
 ) -> list[ServingPoolPlan]:
     """Enumerate → HBM-filter → rank layouts for ONE disaggregated serving
     pool over ``n_devices`` chips. The same enumerate/filter/rank recipe as
@@ -1151,7 +1153,15 @@ def plan_serving_pool(
       step streams the weight shard once for the whole batch plus one
       resident KV row per slot, so bigger pools amortize the weight read
       until the KV term (or HBM) bites. This is exactly the
-      "decode ranked by KV-pool capacity" axis.
+      "decode ranked by KV-pool capacity" axis;
+    - **draft** rank (``tpu_engine/spec_pool.py``): latency of one
+      draft-propose leg — ``spec_gamma`` *sequential* memory-bound decode
+      steps, each streaming the draft weight shard + resident KV rows.
+      Tie-break toward SMALLER tensor parallelism: draft pools exist to
+      backfill the fragmented single-chip headroom the verify pools leave
+      behind, and callers express that by passing the fragmented
+      ``hbm_free_gib`` as the filter. Slots come from ``candidate_slots``
+      like decode.
 
     Returns ALL candidates, feasible first in rank order (infeasible tail
     carries ``skip_reason``) — callers record ``plans[0].label`` as the
@@ -1159,8 +1169,8 @@ def plan_serving_pool(
     """
     from tpu_engine.hbm_estimate import estimate_serving_hbm
 
-    if role not in ("prefill", "decode"):
-        raise ValueError(f"role must be prefill|decode, got {role!r}")
+    if role not in ("prefill", "decode", "draft"):
+        raise ValueError(f"role must be prefill|decode|draft, got {role!r}")
     model_cfg = tfm.MODEL_CONFIGS.get(model_name)
     if model_cfg is None:
         return []
@@ -1209,12 +1219,17 @@ def plan_serving_pool(
                 + slots * (max_len / 2) * kv_row_bytes / kv_shard
             )
             tok_s = replicas * slots / (step_bytes / NOMINAL_HBM_BYTES_S)
+            # Draft: one propose leg = spec_gamma SEQUENTIAL decode steps
+            # (all slots share each step's weight stream, so the leg's
+            # latency is per-step time, not per-token).
+            propose_s = max(int(spec_gamma), 1) * step_bytes / NOMINAL_HBM_BYTES_S
             plan = ServingPoolPlan(
                 role=role, tensor_parallel=tp, replicas=replicas,
                 max_slots=slots, max_len=int(max_len), kv_quant=kv_quant,
                 weight_quant=weight_quant,
                 predicted_prefill_s=prefill_s,
                 predicted_decode_tok_s=tok_s,
+                predicted_propose_s=propose_s,
                 hbm_estimate=est,
             )
             if est is not None and est.device_total_gib > hbm_free_gib:
@@ -1230,6 +1245,10 @@ def plan_serving_pool(
             # Fastest single-prompt prefill; tie-break toward more
             # parallel lanes (replicas) for burst absorption.
             return (p.predicted_prefill_s, -p.replicas, p.tensor_parallel)
+        if role == "draft":
+            # Fastest propose leg; tie-break toward SMALLER tp — draft
+            # pools backfill fragmented single-chip headroom.
+            return (p.predicted_propose_s, p.tensor_parallel, -p.max_slots)
         return (-p.predicted_decode_tok_s, p.tensor_parallel, -p.max_slots)
 
     feasible = sorted([p for p in plans if p.feasible], key=rank_key)

@@ -575,6 +575,19 @@ class Request:
     hold_kv: bool = False
 
 
+class SpecGeometryError(ValueError):
+    """A draft/target pairing whose geometry can never run a
+    ``speculative_round`` — rejected at construction, not mid-decode.
+    Structured (``.reason`` with a ``"kind"`` key) so fleet-level callers
+    (:mod:`tpu_engine.spec_pool`, admission planes) can surface the
+    rejection without parsing the message."""
+
+    def __init__(self, kind: str, message: str, **detail: object):
+        self.kind = kind
+        self.reason = {"kind": kind, **detail}
+        super().__init__(message)
+
+
 @dataclass
 class _PrefillState:
     """A prompt mid-ingestion: ``consumed`` of ``padded`` tokens are in
@@ -681,24 +694,37 @@ class ContinuousBatcher:
         self._draft_cache = None
         if draft_params is not None:
             if draft_cfg is None:
-                raise ValueError("draft_params requires draft_cfg")
+                raise SpecGeometryError(
+                    "draft_cfg_missing", "draft_params requires draft_cfg"
+                )
             if draft_cfg.vocab_size != cfg.vocab_size:
-                raise ValueError(
+                raise SpecGeometryError(
+                    "draft_vocab_mismatch",
                     f"draft vocab {draft_cfg.vocab_size} != target vocab "
-                    f"{cfg.vocab_size}: speculative verify compares token ids"
+                    f"{cfg.vocab_size}: speculative verify compares token ids",
+                    draft_vocab=draft_cfg.vocab_size,
+                    target_vocab=cfg.vocab_size,
                 )
             if self._cache.ring or cfg.sliding_window or draft_cfg.sliding_window:
-                raise ValueError(
+                raise SpecGeometryError(
+                    "draft_ring_window",
                     "speculative serving does not support sliding-window "
-                    "models (the verify chain's rewind assumes flat lanes)"
+                    "models (the verify chain's rewind assumes flat lanes)",
+                    target_window=cfg.sliding_window,
+                    draft_window=draft_cfg.sliding_window,
                 )
             if mesh is not None:
-                raise ValueError(
+                raise SpecGeometryError(
+                    "draft_mesh_sharded",
                     "speculative serving does not run mesh-sharded yet; "
-                    "drop draft_params or mesh"
+                    "drop draft_params or mesh",
                 )
             if self.spec_gamma < 1:
-                raise ValueError(f"spec_gamma must be >= 1, got {spec_gamma}")
+                raise SpecGeometryError(
+                    "spec_gamma_invalid",
+                    f"spec_gamma must be >= 1, got {spec_gamma}",
+                    spec_gamma=self.spec_gamma,
+                )
             self._draft_cache = init_slot_cache(
                 draft_cfg, self.max_slots, self.max_len, compute_dtype,
                 prefill_chunk=self.prefill_chunk,
@@ -1163,6 +1189,14 @@ class ContinuousBatcher:
             }
             if self._prefix_cache is not None:
                 out["prefix_cache"] = self._prefix_cache.stats()
+            if self._draft_params is not None:
+                # Fleet-wide speculative telemetry (backend/routers/
+                # metrics.py renders these as tpu_engine_serving_spec_*).
+                out["spec_rounds"] = self._spec_rounds
+                out["spec_tokens_accepted"] = self._spec_accepted
+                out["spec_tokens_proposed"] = (
+                    self._spec_rounds * (self.spec_gamma + 1)
+                )
             if self._spec_rounds:
                 # Mean accepted tokens per draft round, of gamma+1 possible.
                 out["spec_accept_rate"] = round(

@@ -95,7 +95,11 @@ class ServingReplicaSpec(BaseModel):
     # replicas hold KV only for in-flight handoffs (its admission estimate
     # sizes the pool to ``inflight_handoffs`` slots with the prefill
     # workspace dominant); "decode" pools estimate like "unified" ones.
-    pool_role: str = Field(default="unified", pattern="^(unified|prefill|decode)$")
+    # "draft" pools (tpu_engine/spec_pool.py) are tiny decode pools ranked
+    # by propose latency that backfill fragmented verify-pool headroom.
+    pool_role: str = Field(
+        default="unified", pattern="^(unified|prefill|decode|draft)$"
+    )
     inflight_handoffs: Optional[int] = Field(default=None, ge=1)
 
     def placement_config(self) -> TPUTrainConfig:

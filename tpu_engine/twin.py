@@ -3445,3 +3445,401 @@ def reshard_bench_line(seed: int = 0, ab: Optional[dict] = None) -> dict:
         "gates": res["gates"],
         "ok": res["ok"],
     }
+
+
+# -- fleet speculative decoding pool lane --------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecPoolLaneParams:
+    """Multi-tenant speculative serving scenario at EQUAL chips: the same
+    verify pool serves every request, drafts colocate in the fragmented
+    HBM headroom (validated by the estimator in the A/B, costing zero
+    extra chips), and each tenant's draft quality — its true acceptance
+    rate α — sets how much faster its slots decode. One tenant's draft is
+    junk (α far below the floor): without the spill rule it makes serving
+    SLOWER than plain decode; with it, the sustained-α consult spills the
+    tenant back to plain chunked decode and the fleet keeps the win."""
+
+    duration_s: float = 480.0
+    dt_s: float = 0.05
+    control_period_s: float = 1.0
+    n_replicas: int = 4
+    slots: int = 8
+    tokens_per_slot_s: float = 30.0
+    chips_per_replica: int = 1
+    prefill_s: float = 0.5
+    # Propose leg: gamma sequential draft steps through the draft pool
+    # (plan_serving_pool's predicted_propose_s axis) — a TTFT adder.
+    draft_leg_s: float = 0.1
+    spec_gamma: int = 4
+    # Draft step cost as a fraction of a target step: the standard
+    # speculative speedup model α(γ+1)/(1+γd) tokens per target-step.
+    draft_cost_frac: float = 0.15
+    # Four tenants, one with a junk draft (α = 0.06 → 0.19× plain speed
+    # until spilled — strictly worse than not speculating).
+    tenant_alphas: Tuple[float, ...] = (0.72, 0.65, 0.58, 0.06)
+    alpha_jitter: float = 0.06
+    # Offered load sits ~1.35x the plain pool's effective capacity (the
+    # speculative pools' remains comfortably above it): plain decode
+    # saturates and its makespan stretches, which IS the fleet-level
+    # tokens/sec/chip gap the A/B gates on at equal chips.
+    base_rps: float = 9.0
+    burst_rps: float = 20.0
+    burst_every_s: float = 120.0
+    burst_len_s: float = 30.0
+    mean_new_tokens: float = 96.0
+    min_new_tokens: int = 8
+    warmup_s: float = 120.0
+    ema_beta: float = 0.25
+    # Spill rule (SpecSpillConfig): floors/hysteresis tuned so the junk
+    # tenant spills well inside warmup and a hovering tenant cannot flap.
+    accept_floor: float = 0.35
+    recover_margin: float = 0.15
+    spill_window_s: float = 20.0
+    sustain_consults: int = 3
+    cooldown_s: float = 60.0
+    canary_every: int = 8
+
+
+class _SpecLaneReplica:
+    """Capacity model of one verify replica for the spec-pool lane: a
+    slot pool where each admission carries its own decode-rate multiple
+    (the speculative speedup of its tenant's draft, or 1.0 for plain /
+    spilled / canary legs)."""
+
+    def __init__(self, rid: str, params: SpecPoolLaneParams):
+        self.rid = rid
+        self.params = params
+        self.rate = params.tokens_per_slot_s
+        self.active: List[dict] = []
+        self.tokens_out = 0.0
+
+    def free_slots(self) -> int:
+        return self.params.slots - len(self.active)
+
+    def admit(self, req: dict, prefill_s: float, rate_mult: float) -> None:
+        self.active.append({
+            "req": req,
+            "prefill_left": float(prefill_s),
+            "tokens_left": float(req["n_new"]),
+            "rate_mult": float(rate_mult),
+        })
+
+    def step(self, now: float, dt: float, done: List[dict]) -> None:
+        for sl in list(self.active):
+            if sl["prefill_left"] > 0:
+                sl["prefill_left"] -= dt
+                if sl["prefill_left"] <= 0:
+                    sl["req"]["first_token_at"] = now
+                continue
+            produced = min(self.rate * sl["rate_mult"] * dt,
+                           sl["tokens_left"])
+            sl["tokens_left"] -= produced
+            self.tokens_out += produced
+            if sl["tokens_left"] <= 0:
+                sl["req"]["done_at"] = now
+                sl["req"]["replica"] = self.rid
+                done.append(sl["req"])
+                self.active.remove(sl)
+
+    def router_stats(self) -> dict:
+        busy = sum(1 for s in self.active if s["prefill_left"] <= 0)
+        return {
+            "tokens_per_sec": self.rate * max(busy, 0.2),
+            "free_slots": self.free_slots(),
+            "slots": self.params.slots,
+        }
+
+
+def spec_pool_lane(
+    seed: int,
+    spec: bool,
+    params: SpecPoolLaneParams = SpecPoolLaneParams(),
+) -> dict:
+    """One seeded multi-tenant run at fixed chips through the REAL
+    :class:`~tpu_engine.serving_fleet.FleetRouter` — plain chunked decode
+    (``spec=False``) or speculative pools (``spec=True``) with a real
+    :class:`~tpu_engine.historian.MetricHistorian` carrying the
+    ``serving.spec.accept_rate`` series and a real
+    :class:`~tpu_engine.spec_pool.SpecSpillController` consulting it on
+    the control cadence. Fully virtual-clock: same seed and mode give a
+    byte-identical report."""
+    from tpu_engine.serving_fleet import FleetRouter
+    from tpu_engine.spec_pool import SpecSpillConfig, SpecSpillController
+
+    clock = VirtualClock(0.0)
+    rng = random.Random(seed + 7)
+    n_tenants = len(params.tenant_alphas)
+    spill = None
+    hist = historian_mod.MetricHistorian(clock=clock)
+    if spec:
+        spill = SpecSpillController(
+            hist,
+            SpecSpillConfig(
+                accept_floor=params.accept_floor,
+                recover_margin=params.recover_margin,
+                window_s=params.spill_window_s,
+                sustain_consults=params.sustain_consults,
+                cooldown_s=params.cooldown_s,
+                canary_every=params.canary_every,
+            ),
+            clock=clock,
+        )
+    router = FleetRouter()
+    replicas = {
+        f"r{i}": _SpecLaneReplica(f"r{i}", params)
+        for i in range(params.n_replicas)
+    }
+    trace = bursty_arrivals(
+        seed,
+        duration_s=params.duration_s,
+        base_rps=params.base_rps,
+        burst_rps=params.burst_rps,
+        burst_every_s=params.burst_every_s,
+        burst_len_s=params.burst_len_s,
+        n_prefixes=n_tenants,  # prefix id IS the tenant id
+        prefix_len=32,
+        mean_new_tokens=params.mean_new_tokens,
+        min_new_tokens=params.min_new_tokens,
+    )
+    speedup = {
+        f"t{i}": a * (params.spec_gamma + 1)
+        / (1.0 + params.spec_gamma * params.draft_cost_frac)
+        for i, a in enumerate(params.tenant_alphas)
+    }
+    true_alpha = {f"t{i}": a for i, a in enumerate(params.tenant_alphas)}
+    emas: Dict[str, float] = {}
+    canary_seq: Dict[str, int] = {}
+    legs = {"draft": 0, "plain": 0, "canary": 0}
+    queue: List[dict] = []
+    done: List[dict] = []
+    scored = 0
+
+    def control(t: float) -> None:
+        clock.set(t)
+        router.update({r.rid: r.router_stats() for r in replicas.values()})
+        if spill is not None:
+            spill.consult(sorted(emas), now=t)
+
+    def tick(t: float) -> None:
+        nonlocal scored
+        clock.set(t)
+        free_total = sum(r.free_slots() for r in replicas.values())
+        while queue and free_total > 0:
+            req = queue[0]
+            rid = router.route(req["prompt"])
+            rep = replicas.get(rid) if rid else None
+            if rep is None or rep.free_slots() <= 0:
+                break  # full pick: weights refresh next control period
+            queue.pop(0)
+            free_total -= 1
+            tenant = f"t{req['prefix_id']}"
+            req["tenant"] = tenant
+            if not spec:
+                rep.admit(req, params.prefill_s, 1.0)
+                continue
+            spilled = spill.is_spilled(tenant)
+            canary = False
+            if spilled:
+                canary_seq[tenant] = canary_seq.get(tenant, 0) + 1
+                canary = canary_seq[tenant] % params.canary_every == 0
+            if not spilled:
+                # Full speculative request: draft-propose leg then the
+                # verify stream at the tenant's α-speedup.
+                legs["draft"] += 1
+                req["speculated"] = True
+                rep.admit(req, params.prefill_s + params.draft_leg_s,
+                          speedup[tenant])
+            elif canary:
+                # Canary probe: a few speculative rounds re-measure α
+                # (the sample below), the bulk decodes plain.
+                legs["canary"] += 1
+                req["speculated"] = True
+                rep.admit(req, params.prefill_s + params.draft_leg_s, 1.0)
+            else:
+                legs["plain"] += 1
+                req["speculated"] = False
+                rep.admit(req, params.prefill_s, 1.0)
+        for r in replicas.values():
+            r.step(t, params.dt_s, done)
+        # Score newly-completed speculative legs: a jittered draw around
+        # the tenant's true α, folded into its EMA and recorded as the
+        # historian series the spill controller consults.
+        while scored < len(done):
+            req = done[scored]
+            scored += 1
+            if not spec or not req.get("speculated"):
+                continue
+            tenant = req["tenant"]
+            a = true_alpha[tenant] + params.alpha_jitter * (rng.random() - 0.5)
+            a = min(max(a, 0.0), 1.0)
+            prev = emas.get(tenant)
+            emas[tenant] = a if prev is None else (
+                params.ema_beta * a + (1.0 - params.ema_beta) * prev)
+            hist.record("serving.spec.accept_rate", round(emas[tenant], 6),
+                        ts=t, labels={"tenant": tenant})
+
+    run_open_loop(
+        trace,
+        dt=params.dt_s,
+        duration_s=params.duration_s,
+        pending=lambda: queue or any(r.active for r in replicas.values()),
+        arrive=queue.append,
+        tick=tick,
+        control=control,
+        control_period_s=params.control_period_s,
+        safety_factor=3.0,
+    )
+
+    total_chips = params.n_replicas * params.chips_per_replica
+    metrics = serving_metrics(done, [], warmup_s=params.warmup_s,
+                              total_chips=total_chips, dt_s=params.dt_s)
+    per_tenant: Dict[str, dict] = {}
+    for tenant in sorted(true_alpha):
+        lat = [(r["done_at"] - r["t"]) * 1000.0 for r in done
+               if r["tenant"] == tenant and r["t"] >= params.warmup_s]
+        per_tenant[tenant] = {
+            "completed": len(lat),
+            "p99_ms": round(percentile(lat, 0.99), 1),
+            "accept_ema": (None if tenant not in emas
+                           else round(emas[tenant], 4)),
+        }
+    out = {
+        "mode": "spec" if spec else "plain",
+        "total_chips": total_chips,
+        "metrics": metrics,
+        "legs": dict(legs),
+        "tenants": per_tenant,
+        "router": router.stats(),
+    }
+    if spill is not None:
+        out["spill"] = spill.status()
+        out["spill_decisions_fired"] = [
+            {"rule": d.rule, "target": d.target,
+             "ts": d.ts, "action": d.action}
+            for d in spill.decisions if d.outcome == "fired"
+        ]
+        out["accept_series_samples"] = hist.samples_total
+    return out
+
+
+def spec_pool_ab(
+    seed: int = 0,
+    params: SpecPoolLaneParams = SpecPoolLaneParams(),
+) -> dict:
+    """The spec-pool exit gate: plain chunked decode vs speculative pools
+    at EQUAL chips on the same seeded bursty trace, a byte-identical spec
+    repeat (determinism), the sustained-α spill of the junk-draft tenant
+    (audited DecisionRecord, fleet never below the plain baseline), and
+    the estimator's structured draft-HBM rejection + the draft-role
+    placement plan that backfills fragmented headroom."""
+    from tpu_engine.hbm_estimate import (
+        SpecHBMOversubscribed,
+        estimate_serving_hbm,
+    )
+    from tpu_engine.placement import plan_serving_pool
+
+    plain = spec_pool_lane(seed, spec=False, params=params)
+    pool = spec_pool_lane(seed, spec=True, params=params)
+    repeat = spec_pool_lane(seed, spec=True, params=params)
+
+    p, s = plain["metrics"], pool["metrics"]
+    tpsc_ratio = round(
+        s["tokens_per_sec_per_chip"] / max(p["tokens_per_sec_per_chip"], 1e-9),
+        4)
+    p99_ratio = round(s["p99_ms"] / max(p["p99_ms"], 1e-9), 4)
+    low_tenant = f"t{len(params.tenant_alphas) - 1}"
+    t_low_ratio = round(
+        pool["tenants"][low_tenant]["p99_ms"]
+        / max(plain["tenants"][low_tenant]["p99_ms"], 1e-9), 4)
+    spill_fired = [
+        d for d in pool.get("spill_decisions_fired", [])
+        if d["rule"] == "spill_low_acceptance" and d["target"] == low_tenant
+    ]
+
+    # Admission honesty: a draft that fits the verify pool's fragmented
+    # headroom estimates cleanly (with the colocated-draft terms); one
+    # that oversubscribes is refused with a structured reason.
+    est = estimate_serving_hbm(
+        "llama-1b", params.slots, 2048,
+        draft_model_name="gpt-tiny", device_budget_gib=16.0,
+    )
+    rejection = None
+    try:
+        estimate_serving_hbm(
+            "llama-1b", params.slots, 2048,
+            draft_model_name="gpt-tiny", device_budget_gib=0.5,
+        )
+    except SpecHBMOversubscribed as e:
+        rejection = e.reason
+    # Placement: the draft role ranks by propose latency and deliberately
+    # fits inside small fragmented headroom (2 GiB here).
+    draft_plans = plan_serving_pool(
+        "gpt-tiny", "draft", params.n_replicas, hbm_free_gib=2.0,
+        max_len=2048, spec_gamma=params.spec_gamma,
+    )
+
+    gates = {
+        "spec_beats_plain_tokens_per_chip": tpsc_ratio >= 1.2,
+        "p99_no_worse": p99_ratio <= 1.02,
+        "low_alpha_tenant_spilled": (
+            low_tenant in pool.get("spill", {}).get("spilled", [])
+            and len(spill_fired) > 0
+        ),
+        "spilled_tenant_not_below_plain_baseline": t_low_ratio <= 1.10,
+        "deterministic_repeat": pool == repeat,
+        "draft_hbm_rejected": (
+            rejection is not None
+            and rejection.get("kind") == "spec_hbm_oversubscribed"
+            and est is not None and est.device_total_gib > 0
+        ),
+        "draft_plan_feasible": (
+            len(draft_plans) > 0 and draft_plans[0].feasible
+            and draft_plans[0].predicted_propose_s > 0
+        ),
+    }
+    return {
+        "plain": plain,
+        "spec": pool,
+        "tokens_per_sec_per_chip_ratio": tpsc_ratio,
+        "p99_ratio": p99_ratio,
+        "low_alpha_tenant": low_tenant,
+        "low_alpha_tenant_p99_ratio": t_low_ratio,
+        "spill_decisions_fired": pool.get("spill_decisions_fired", []),
+        "draft_hbm_rejection": rejection,
+        "spec_replica_gib": None if est is None else est.device_total_gib,
+        "draft_plan_label": (
+            draft_plans[0].label if draft_plans else None),
+        "gates": gates,
+        "ok": all(gates.values()),
+    }
+
+
+def spec_pool_bench_line(seed: int = 0, ab: Optional[dict] = None) -> dict:
+    """The spec pool's deterministic bench line, shared by ``bench.py``
+    and ``tools/bench_sentinel.py``. The gated value is the spec/plain
+    tokens-per-sec-per-chip ratio at equal chips on the seeded bursty
+    trace — the headline fleet-level speculative win, with the junk-draft
+    tenant provably spilled by the sustained-α rule."""
+    res = ab if ab is not None else spec_pool_ab(seed=seed)
+    pool = res["spec"]
+    return {
+        "metric": "spec_pool",
+        "value": res["tokens_per_sec_per_chip_ratio"],
+        "unit": "spec/plain tokens-per-sec-per-chip ratio, equal chips",
+        "plain_tokens_per_sec_per_chip": (
+            res["plain"]["metrics"]["tokens_per_sec_per_chip"]),
+        "spec_tokens_per_sec_per_chip": (
+            pool["metrics"]["tokens_per_sec_per_chip"]),
+        "p99_ratio": res["p99_ratio"],
+        "low_alpha_tenant": res["low_alpha_tenant"],
+        "low_alpha_tenant_p99_ratio": res["low_alpha_tenant_p99_ratio"],
+        "tenants_spilled": pool.get("spill", {}).get("spilled", []),
+        "spill_decisions_fired": len(res["spill_decisions_fired"]),
+        "legs": pool["legs"],
+        "draft_plan_label": res["draft_plan_label"],
+        "spec_replica_gib": res["spec_replica_gib"],
+        "gates": res["gates"],
+        "ok": res["ok"],
+    }
