@@ -27,6 +27,7 @@ from backend.routers import (
     hetero,
     history,
     incidents,
+    journal,
     metrics,
     monitoring,
     profiling,
@@ -118,6 +119,11 @@ async def root(request: web.Request) -> web.Response:
                 "kick-precompile or a structured suppression — with "
                 "hysteresis, per-target cooldowns, a blast-radius budget "
                 "and a byte-identical dry-run shadow mode",
+                "durable control plane: bounded write-ahead journal "
+                "(JSONL, atomic rotation, torn-tail-tolerant ingest) "
+                "with snapshot+replay crash recovery — orphan job "
+                "re-adoption, vanished-replica re-dispatch, and an HBM "
+                "double-grant audit",
                 "OpenAPI 3.1 schema (/openapi.json) and self-contained "
                 "/docs page",
             ],
@@ -137,6 +143,7 @@ async def root(request: web.Request) -> web.Response:
                 "twin": "/api/v1/twin",
                 "history": "/api/v1/history",
                 "incidents": "/api/v1/incidents",
+                "journal": "/api/v1/journal",
                 "autopilot": "/api/v1/autopilot",
                 "metrics": "/metrics",
                 "openapi": "/openapi.json",
@@ -181,6 +188,7 @@ def create_app() -> web.Application:
     twin.setup(app)
     history.setup(app)
     incidents.setup(app)
+    journal.setup(app)
     autopilot.setup(app)
     serving.setup(app)
     metrics.setup(app)

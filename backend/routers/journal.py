@@ -1,0 +1,30 @@
+"""Durable-control-plane routes — the health surface for
+``tpu_engine/journal.py``:
+
+- ``GET /api/v1/journal`` — write-ahead journal counters (the same
+  numbers the ``tpu_engine_journal_*`` Prometheus families export) plus
+  the crash-recovery counters behind ``tpu_engine_ctl_recovery_*``.
+
+Everything here is O(1) counter reads: a scrape or poll of this route
+never opens or walks the journal files.
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from backend.http import json_response
+from tpu_engine import journal as journal_mod
+
+
+async def journal_status(request: web.Request) -> web.Response:
+    return json_response({
+        "journal": journal_mod.journal_stats(),
+        "recovery": journal_mod.recovery_stats(),
+        "schema_version": journal_mod.SCHEMA_VERSION,
+        "skip_reasons": list(journal_mod.SKIP_REASONS),
+    })
+
+
+def setup(app: web.Application, prefix: str = "/api/v1") -> None:
+    app.router.add_get(f"{prefix}/journal", journal_status)

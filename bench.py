@@ -219,6 +219,9 @@ def main() -> None:
     spec_pool_line = _spec_pool_metric()
     if spec_pool_line is not None:
         print(json.dumps(spec_pool_line))
+    ctl_crash_line = _ctl_crash_metric()
+    if ctl_crash_line is not None:
+        print(json.dumps(ctl_crash_line))
 
 
 def _comm_compress_metric(n_dev: int) -> dict | None:
@@ -687,6 +690,23 @@ def _spec_pool_metric() -> dict | None:
         from tpu_engine.twin import spec_pool_bench_line
 
         return spec_pool_bench_line(seed=0)
+    except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
+        return None
+
+
+def _ctl_crash_metric() -> dict | None:
+    """Seventeenth JSON line: durable control plane A/B — crash-recovery
+    MTTR vs the no-crash run of the same seeded storm, gating the 1.5x
+    budget with zero lost or duplicated submissions, every held serving
+    request answered, orphans re-adopted instead of re-launched, the
+    vanished replica re-dispatched, byte-identical double recovery from
+    the same journal bytes, and the torn journal tail skipped not raised
+    (tpu_engine/journal.py via twin.ctl_crash_bench_line). Never fails
+    the bench: any error degrades to None."""
+    try:
+        from tpu_engine.twin import ctl_crash_bench_line
+
+        return ctl_crash_bench_line(seed=0)
     except Exception:  # noqa: BLE001 — auxiliary metric must not fail bench
         return None
 

@@ -139,6 +139,37 @@ def test_sidecar_round_trip_and_merge(tmp_path):
     assert set(merged["entries"]) == {"k1", "k2"}
 
 
+def test_sidecar_tolerates_torn_and_garbage_files(tmp_path):
+    """A half-written or garbage sidecar (host died mid-write) must warn,
+    count, and start fresh — never raise."""
+    path = tmp_path / SIDECAR_NAME
+    # Torn file: valid prefix of a JSON document, cut mid-append.
+    path.write_text('{"version": 1, "entries": {"k1": {"warm": tr')
+    idx = CompileCacheIndex(path=str(path))
+    st = idx.stats()
+    assert st["entries"] == 0
+    assert st["sidecar_load_errors_total"] == 1
+    # The fresh index still works and persists over the torn file.
+    idx.record("k2", 2.0, cache_hit=False)
+    assert json.loads(path.read_text())["entries"].keys() == {"k2"}
+
+    # Valid JSON but not an object: same degradation path.
+    path.write_text('[1, 2, 3]')
+    idx2 = CompileCacheIndex(path=str(path))
+    assert idx2.stats()["sidecar_load_errors_total"] == 1
+    # Valid object whose "entries" is the wrong shape.
+    path.write_text('{"version": 1, "entries": "oops"}')
+    idx3 = CompileCacheIndex(path=str(path))
+    assert idx3.stats()["sidecar_load_errors_total"] == 1
+    # attach_dir over garbage also degrades to the counter.
+    path.write_text("\x00\x01 not json")
+    idx4 = CompileCacheIndex()
+    idx4.record("mine", 1.0, cache_hit=False)
+    idx4.attach_dir(str(tmp_path))
+    assert idx4.stats()["sidecar_load_errors_total"] == 1
+    assert idx4.is_warm("mine")  # own observations survive the bad merge
+
+
 def test_lru_bound_evicts_oldest(tmp_path):
     clock = iter(range(100))
     idx = CompileCacheIndex(

@@ -12,10 +12,12 @@ Everything here runs on the synthetic clock (tier 1, no sleeps).
 """
 
 import math
+import os
 import random
 
 from tpu_engine.autopilot import AutopilotConfig, DecisionRecord, FleetAutopilot
 from tpu_engine.historian import IncidentCorrelator, MetricHistorian
+from tpu_engine.journal import ControlPlaneJournal
 from tpu_engine.serving_fleet import _PercentileWindow
 from tpu_engine.tracing import FlightRecorder
 
@@ -239,3 +241,45 @@ def test_percentile_window_empty_and_degenerate():
     pw.add(0.0)
     pw.add(1e12)
     assert all(v is not None for v in pw.percentiles((0.5, 0.99)))
+
+
+# ---------------------------------------------------------------------------
+# Write-ahead journal ring at depth
+# ---------------------------------------------------------------------------
+
+
+def test_journal_rotation_bounded_at_depth(tmp_path):
+    """20k appends through a small journal: the live file never exceeds
+    ``max_bytes``, exactly one rotated generation exists (total disk
+    <= 2x the cap), and ``stats()`` is O(1) counters — it never opens or
+    walks the files."""
+    path = str(tmp_path / "journal.jsonl")
+    cap = 64 * 1024
+    clk = iter(range(10_000_000))
+    j = ControlPlaneJournal(path, max_bytes=cap, clock=lambda: float(next(clk)))
+    n = 20_000
+    for i in range(n):
+        j.append("depth.ev", {"i": i, "pad": "x" * 64})
+        if i % 200 == 0:
+            j.snapshot({"scheduler": {"seq": i}})
+    st = j.stats()
+    assert st["appends_total"] == n
+    assert st["snapshots_total"] == n // 200
+    assert st["rotations_total"] > 10
+    assert st["append_errors_total"] == 0
+    # Disk bound: one live file under the cap, exactly one .1 generation.
+    assert os.path.getsize(path) <= cap
+    assert os.path.getsize(path + ".1") <= cap
+    assert sorted(p.name for p in tmp_path.iterdir()) == [
+        "journal.jsonl", "journal.jsonl.1",
+    ]
+    assert st["bytes"] == os.path.getsize(path)
+    # A reader still gets a usable snapshot+suffix from the bounded pair.
+    got = j.read()
+    assert got["snapshot"] is not None
+    assert got["stats"]["skipped"] == 0
+    # stats() after the files vanish: pure counters, no file access.
+    os.remove(path)
+    os.remove(path + ".1")
+    st2 = j.stats()
+    assert st2["appends_total"] == n and st2["bytes"] == st["bytes"]

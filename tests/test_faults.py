@@ -47,6 +47,18 @@ def test_random_plan_is_reproducible():
     assert [s.model_dump() for s in a.specs] != [s.model_dump() for s in c.specs]
 
 
+def test_random_plan_never_draws_excluded_kinds():
+    """PRECOMPILE_ERROR and CONTROLPLANE_CRASH are injected only through
+    explicit specs — seeded chaos draws must never contain them, or every
+    historical seeded storm would change byte-for-byte."""
+    excluded = {FaultKind.PRECOMPILE_ERROR, FaultKind.CONTROLPLANE_CRASH}
+    assert faults._NON_RANDOM_KINDS == frozenset(excluded)
+    for seed in range(50):
+        plan = FaultPlan.random(seed=seed, n_faults=32, max_step=500)
+        drawn = {s.kind for s in plan.specs}
+        assert not (drawn & excluded), f"seed={seed} drew {drawn & excluded}"
+
+
 def test_spec_requires_a_trigger_and_chip_faults_a_device():
     with pytest.raises(ValueError):
         FaultSpec(kind=FaultKind.HOST_SLOW)  # neither at_step nor after_s

@@ -337,6 +337,40 @@ def test_reshard_families_always_present(client):
         assert re.search(rf"^{family}[ {{]", text, re.M), family
 
 
+def test_journal_and_ctl_recovery_families_always_present(client):
+    """The durable-control-plane families export even before any journal
+    is attached or restore has run — zeros from the first scrape so crash
+    dashboards never need absent(). Skip reasons render as labels."""
+    text = _scrape(client)
+    for family in (
+        "tpu_engine_journal_attached",
+        "tpu_engine_journal_bytes",
+        "tpu_engine_journal_max_bytes",
+        "tpu_engine_journal_appends_total",
+        "tpu_engine_journal_snapshots_total",
+        "tpu_engine_journal_rotations_total",
+        "tpu_engine_journal_append_errors_total",
+        "tpu_engine_journal_reads_total",
+        "tpu_engine_journal_read_lines_total",
+        "tpu_engine_journal_read_skipped_lines_total",
+        "tpu_engine_ctl_recovery_restores_total",
+        "tpu_engine_ctl_recovery_records_replayed_total",
+        "tpu_engine_ctl_recovery_jobs_readopted_total",
+        "tpu_engine_ctl_recovery_requeued_total",
+        "tpu_engine_ctl_recovery_double_grants_total",
+        "tpu_engine_ctl_recovery_replicas_readopted_total",
+        "tpu_engine_ctl_recovery_replicas_redispatched_total",
+        "tpu_engine_ctl_recovery_requests_recovered_total",
+        "tpu_engine_ctl_recovery_last_mttr_seconds",
+    ):
+        assert re.search(rf"^{family}[ {{]", text, re.M), family
+    for reason in ("torn_tail", "parse_error", "unknown_schema", "unknown_record"):
+        assert re.search(
+            rf'^tpu_engine_journal_read_skipped_lines_total\{{reason="{reason}"\}} ',
+            text, re.M,
+        ), reason
+
+
 def test_serving_spec_families_always_present(client):
     """Per-replica speculative telemetry exports even with no serving
     engine registered (and with a non-speculative one) — rendered at

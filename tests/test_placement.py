@@ -3,6 +3,7 @@ matrix, the HBM feasibility gate, cost-model ranking, and grow-back
 targets — all analytic (no JAX compute), so everything here is tier-1.
 """
 
+import json
 from types import SimpleNamespace
 
 import pytest
@@ -365,3 +366,29 @@ def test_calibration_sidecar_persists_and_reloads(tmp_path):
     fragile._calibration_path = str(blocker / "sub" / "x.json")
     fragile.record_observation(predicted_s=2.0, observed_s=1.0)
     assert fragile.stats()["calibration"]["persist_errors_total"] == 1
+
+
+def test_calibration_sidecar_tolerates_torn_and_garbage_files(tmp_path):
+    """Truncated / garbage calibration sidecars warn + count + start fresh."""
+    cache = str(tmp_path)
+    sidecar = tmp_path / PlacementPlanner.CALIBRATION_SIDECAR
+    # Torn mid-write: a prefix of a JSON document.
+    sidecar.write_text('{"version": 1, "ema_rel_error": 0.')
+    planner = PlacementPlanner(calibration_path=cache)
+    st = planner.stats()["calibration"]
+    assert st["load_errors_total"] == 1
+    assert st["ema_rel_error"] is None
+    # The planner still calibrates and re-persists an intact sidecar.
+    planner.record_observation(predicted_s=2.0, observed_s=1.0)
+    assert json.loads(sidecar.read_text())["observations_total"] == 1
+
+    # Valid JSON, wrong shape (not an object).
+    sidecar.write_text("[0.7, 2]")
+    p2 = PlacementPlanner(calibration_path=cache)
+    assert p2.stats()["calibration"]["load_errors_total"] == 1
+    # Valid object, garbage field types.
+    sidecar.write_text('{"ema_rel_error": "NaN-ish", "observations_total": "x"}')
+    p3 = PlacementPlanner(calibration_path=cache)
+    st3 = p3.stats()["calibration"]
+    assert st3["load_errors_total"] == 1
+    assert st3["ema_rel_error"] is None

@@ -97,6 +97,7 @@ def collect_quick() -> list[dict]:
     from tpu_engine.parallel.pipeline_zb import schedule_account
     from tpu_engine.twin import (
         autopilot_bench_line,
+        ctl_crash_bench_line,
         ctl_scale_bench_line,
         historian_bench_line,
         prefix_plane_bench_line,
@@ -180,6 +181,7 @@ def collect_quick() -> list[dict]:
         prefix_plane_bench_line(seed=0),
         reshard_bench_line(seed=0),
         spec_pool_bench_line(seed=0),
+        ctl_crash_bench_line(seed=0),
     ]
 
 

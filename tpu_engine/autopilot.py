@@ -689,6 +689,44 @@ class FleetAutopilot:
         with self._lock:
             self.dry_run = bool(dry_run)
 
+    # -- durability (control-plane journal snapshot section) -----------------
+
+    def export_state(self) -> Dict[str, Any]:
+        """Serialized guard state (streaks, per-rule cooldown clocks,
+        rate-limit window) for the control-plane journal. Tuple keys are
+        flattened to ``[key_parts, value]`` pairs for JSON."""
+        with self._lock:
+            return {
+                "dry_run": self.dry_run,
+                "streak": [
+                    [list(k), int(v)] for k, v in sorted(self._streak.items())
+                ],
+                "last_action": [
+                    [list(k), float(v)]
+                    for k, v in sorted(self._last_action.items())
+                ],
+                "action_times": [float(t) for t in self._action_times],
+            }
+
+    def load_state(self, state: Dict[str, Any]) -> None:
+        """Inverse of :meth:`export_state`; a restarted autopilot keeps
+        its hysteresis so cooldowns survive a control-plane crash instead
+        of refiring immediately. Tolerant of missing keys."""
+        if not isinstance(state, dict):
+            return
+        with self._lock:
+            if "dry_run" in state:
+                self.dry_run = bool(state["dry_run"])
+            self._streak = {
+                tuple(k): int(v) for k, v in state.get("streak") or []
+            }
+            self._last_action = {
+                tuple(k): float(v) for k, v in state.get("last_action") or []
+            }
+            self._action_times = deque(
+                float(t) for t in state.get("action_times") or []
+            )
+
 
 # -- process-wide autopilot (the backend/router default) -----------------------
 

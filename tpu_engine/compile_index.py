@@ -204,6 +204,7 @@ class CompileCacheIndex:
         self.cold_compile_s_total = 0.0
         self.evictions_total = 0
         self.persist_errors_total = 0
+        self.sidecar_load_errors_total = 0
         self._global_cold_ema: Optional[float] = None
         if path:
             self._load()
@@ -363,9 +364,11 @@ class CompileCacheIndex:
         try:
             with open(path) as f:
                 doc = json.load(f)
+            if not isinstance(doc, dict):
+                raise ValueError(f"sidecar is not a JSON object: {type(doc).__name__}")
             entries = doc.get("entries", {})
             if not isinstance(entries, dict):
-                return
+                raise ValueError("sidecar 'entries' is not a JSON object")
             with self._lock:
                 for k, v in entries.items():
                     if merge and k in self._entries:
@@ -377,6 +380,10 @@ class CompileCacheIndex:
                     self._global_cold_ema = float(g)
                 self._evict_locked()
         except Exception:
+            # A torn/garbage sidecar (host died mid-write, disk corruption)
+            # must never take the process down: warn, count, start fresh.
+            with self._lock:
+                self.sidecar_load_errors_total += 1
             log.warning("compile index sidecar unreadable: %s", path, exc_info=True)
 
     def _persist(self) -> None:
@@ -430,6 +437,7 @@ class CompileCacheIndex:
                 ),
                 "evictions_total": self.evictions_total,
                 "persist_errors_total": self.persist_errors_total,
+                "sidecar_load_errors_total": self.sidecar_load_errors_total,
                 "sidecar_path": self.path,
                 "max_entries": self.max_entries,
             }

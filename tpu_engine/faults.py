@@ -39,7 +39,7 @@ from tpu_engine import historian, tracing
 
 
 class FaultKind(str, enum.Enum):
-    """The seven injectable fault types (ISSUE archetype: robustness)."""
+    """The eight injectable fault types (ISSUE archetype: robustness)."""
 
     CHIP_UNHEALTHY = "chip-unhealthy"
     HOST_SLOW = "host-slow"
@@ -48,6 +48,7 @@ class FaultKind(str, enum.Enum):
     TELEMETRY_NAN = "telemetry-nan"
     PREEMPTION_SIGNAL = "preemption-signal"
     PRECOMPILE_ERROR = "precompile-error"
+    CONTROLPLANE_CRASH = "controlplane-crash"
 
 
 # Kinds that attach to a specific chip and stay active until healed/expired.
@@ -60,7 +61,15 @@ _CONSUMABLE_KINDS = frozenset(
         FaultKind.PREEMPTION_SIGNAL,
         FaultKind.HOST_SLOW,
         FaultKind.PRECOMPILE_ERROR,
+        FaultKind.CONTROLPLANE_CRASH,
     }
+)
+# Kinds never drawn by ``FaultPlan.random``: adding a kind to the enum must
+# not perturb existing seeded plans (chaos traces are gated byte-identical),
+# so anything introduced after the original seven is excluded from the draw
+# and injected only via an explicit FaultSpec.
+_NON_RANDOM_KINDS = frozenset(
+    {FaultKind.PRECOMPILE_ERROR, FaultKind.CONTROLPLANE_CRASH}
 )
 
 
@@ -121,14 +130,15 @@ class FaultPlan(BaseModel):
     ) -> "FaultPlan":
         """Reproducible random plan: same seed → identical specs.
 
-        ``precompile-error`` is a scheduler-side fault (the background
-        precompile worker's seam), not a per-training-step fault, and is
-        excluded from the draw so every seeded plan — and every chaos
-        trace derived from one — stays byte-identical across the kind's
-        introduction. Inject it with an explicit :class:`FaultSpec`.
+        Kinds in :data:`_NON_RANDOM_KINDS` (``precompile-error``,
+        ``controlplane-crash``) are control-plane faults, not
+        per-training-step faults, and are excluded from the draw so every
+        seeded plan — and every chaos trace derived from one — stays
+        byte-identical across each kind's introduction. Inject them with
+        an explicit :class:`FaultSpec`.
         """
         rng = random.Random(seed)
-        kinds = [k for k in FaultKind if k is not FaultKind.PRECOMPILE_ERROR]
+        kinds = [k for k in FaultKind if k not in _NON_RANDOM_KINDS]
         specs = []
         for _ in range(n_faults):
             kind = rng.choice(kinds)
@@ -279,6 +289,14 @@ class FaultInjector:
         before every background AOT attempt)."""
         with self._lock:
             return self._take_locked(FaultKind.PRECOMPILE_ERROR, step) is not None
+
+    def take_controlplane_crash(self, step: int) -> bool:
+        """Control-plane seam: consume one controlplane-crash fault if due.
+        The crash lane (``twin.ctl_crash_lane``) consults this per poll to
+        pick the kill point; a real deployment would wire it to a
+        supervisor that SIGKILLs the scheduler host."""
+        with self._lock:
+            return self._take_locked(FaultKind.CONTROLPLANE_CRASH, step) is not None
 
     def take_restore_fault(self, step: int) -> bool:
         """Checkpoint seam: consume one restore-corruption fault if due."""

@@ -344,6 +344,7 @@ class PlacementPlanner:
         # learned (same atomic tmp+rename discipline as compile_index.py).
         self.calibration_alpha = calibration_alpha
         self.calibration_persist_errors_total = 0
+        self.calibration_load_errors_total = 0
         self._calibration_path: Optional[str] = None
         self._calib_ema_rel_error: Optional[float] = None
         self._calib_observations_total = 0
@@ -996,20 +997,31 @@ class PlacementPlanner:
         try:
             with open(path) as f:
                 doc = json.load(f)
-        except (OSError, ValueError):
+            if not isinstance(doc, dict):
+                raise ValueError(f"sidecar is not a JSON object: {type(doc).__name__}")
+        except Exception:
+            # Torn/garbage sidecar (crash mid-write) — warn, count, start
+            # fresh; calibration rebuilds from live observations.
+            with self._lock:
+                self.calibration_load_errors_total += 1
             log.warning("placement calibration sidecar unreadable: %s", path)
             return
-        with self._lock:
-            ema = doc.get("ema_rel_error")
-            if ema is not None and self._calib_ema_rel_error is None:
-                self._calib_ema_rel_error = float(ema)
-            self._calib_observations_total += int(
-                doc.get("observations_total", 0)
-            )
-            last = doc.get("last")
-            if self._calib_last is None and isinstance(last, (list, tuple)):
-                if len(last) == 2:
-                    self._calib_last = (float(last[0]), float(last[1]))
+        try:
+            with self._lock:
+                ema = doc.get("ema_rel_error")
+                if ema is not None and self._calib_ema_rel_error is None:
+                    self._calib_ema_rel_error = float(ema)
+                self._calib_observations_total += int(
+                    doc.get("observations_total", 0)
+                )
+                last = doc.get("last")
+                if self._calib_last is None and isinstance(last, (list, tuple)):
+                    if len(last) == 2:
+                        self._calib_last = (float(last[0]), float(last[1]))
+        except (TypeError, ValueError):
+            with self._lock:
+                self.calibration_load_errors_total += 1
+            log.warning("placement calibration sidecar malformed: %s", path)
 
     def _persist_calibration(self) -> None:
         path = self._calibration_path
@@ -1062,6 +1074,7 @@ class PlacementPlanner:
                     "ema_rel_error": self._calib_ema_rel_error,
                     "observations_total": self._calib_observations_total,
                     "persist_errors_total": self.calibration_persist_errors_total,
+                    "load_errors_total": self.calibration_load_errors_total,
                 },
             }
         if obs:

@@ -598,6 +598,47 @@ class PrefixPlane:
         self.index.drop_holder(rid)
         self._publish()
 
+    # -- durability (control-plane journal snapshot section) -----------------
+
+    def export_host_index(self) -> Dict[str, Any]:
+        """Serialized host-tier *index* for the control-plane journal:
+        which prefixes the host tier holds and how many bytes each
+        charges. Payloads are deliberately NOT journaled — after
+        :meth:`load_host_index` the index is warm (routing and capacity
+        accounting work immediately) and payloads refetch on miss."""
+        entries = []
+        for prefix in sorted(self.index.prefixes(HOST_HOLDER)):
+            if self.host.contains(prefix):
+                entries.append({
+                    "prefix": [int(t) for t in prefix],
+                    "nbytes": int(self.host._bytes.get(tuple(prefix), 0)),
+                })
+        return {
+            "prefix_tokens": self.prefix_tokens,
+            "entries": entries,
+        }
+
+    def load_host_index(self, state: Dict[str, Any]) -> int:
+        """Inverse of :meth:`export_host_index` on a fresh plane: re-park
+        every journaled prefix as a capacity-model entry (``handoff=None``
+        — the bytes ledger and routing index are restored; the payload
+        itself rehydrates from a replica or refetches on first use).
+        Returns the number of entries restored."""
+        if not isinstance(state, dict):
+            return 0
+        restored = 0
+        for e in state.get("entries") or []:
+            try:
+                prefix = tuple(int(t) for t in e["prefix"])
+                nbytes = int(e.get("nbytes", 0))
+            except (KeyError, TypeError, ValueError):
+                continue
+            if self.host.put(prefix, nbytes=nbytes):
+                self.index.insert(prefix, HOST_HOLDER)
+                restored += 1
+        self._publish()
+        return restored
+
     def _publish(self) -> None:
         _gauge(index_prefixes=self.index.n_prefixes)
 

@@ -46,6 +46,7 @@ from tpu_engine import compile_index as compile_index_mod
 from tpu_engine import goodput as goodput_mod
 from tpu_engine import hetero as hetero_mod
 from tpu_engine import historian as historian_mod
+from tpu_engine import journal as journal_mod
 from tpu_engine import tracing
 from tpu_engine.hbm_estimate import (
     HBMEstimate,
@@ -462,6 +463,11 @@ class FleetScheduler:
         self._tenant_busy_s: dict[str, float] = {}
         self._tenant_completed: dict[str, int] = {}
 
+        # Durable control plane (tpu_engine/journal.py): when a journal is
+        # attached, every state-changing event below is written ahead so a
+        # crashed scheduler host can be reconstructed with restore().
+        self._journal: Optional[journal_mod.ControlPlaneJournal] = None
+
         self._shutdown = threading.Event()
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -562,6 +568,7 @@ class FleetScheduler:
         goodput_mod.get_ledger().track(
             sub.trace_id, tenant=submitter, workload=workload
         )
+        self._journal_event("sched.submit", self._serialize_sub(sub))
         self._ensure_thread()
         self._wake.set()
         return sub
@@ -592,10 +599,18 @@ class FleetScheduler:
                 sub.finished_at = time.time()
                 self.cancelled_total += 1
                 sub.finish_trace("cancelled")
+                self._journal_event("sched.finish", {
+                    "sid": sub.submission_id,
+                    "state": "cancelled",
+                    "finished_at": sub.finished_at,
+                })
                 return True
             self._set_state(sub, SubmissionState.CANCELLING)
             if sub.job is not None:
                 sub.job._stop.set()
+            self._journal_event(
+                "sched.cancelling", {"sid": sub.submission_id}
+            )
         self._wake.set()
         return True
 
@@ -631,6 +646,10 @@ class FleetScheduler:
             self._hetero_quarantined[idx] = {
                 "owner": owner, "ts": now, "source": "autopilot",
             }
+        self._journal_event("sched.quarantine", {
+            "device": idx,
+            "entry": {"owner": owner, "ts": now, "source": "autopilot"},
+        })
         tracing.get_recorder().event(
             "hetero_quarantine",
             kind="scheduler",
@@ -646,6 +665,7 @@ class FleetScheduler:
             if idx not in self._hetero_quarantined:
                 return False
             del self._hetero_quarantined[idx]
+        self._journal_event("sched.quarantine_release", {"device": idx})
         tracing.get_recorder().event(
             "hetero_quarantine_release",
             kind="hetero",
@@ -747,6 +767,372 @@ class FleetScheduler:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
         self.precompiler.shutdown()
+
+    # -- durability: write-ahead journal + crash recovery ----------------------
+
+    def attach_journal(
+        self, journal: Optional[journal_mod.ControlPlaneJournal]
+    ) -> None:
+        """Write-ahead every state-changing control event to ``journal``;
+        pair with :meth:`restore` on the replacement process after a
+        control-plane crash. The journal swallows its own I/O failures
+        (``append_errors_total``), so scheduling never blocks on it."""
+        self._journal = journal
+
+    def _journal_event(self, kind: str, payload: dict[str, Any]) -> None:
+        j = self._journal
+        if j is not None:
+            j.append(kind, payload)
+
+    @staticmethod
+    def _serialize_sub(sub: Submission) -> dict[str, Any]:
+        """JSON-safe full identity of one submission — the journal's
+        ``sched.submit`` payload and the snapshot's per-submission record.
+        Everything restore() needs to rebuild the Submission; the live
+        job handle and the un-serializable callables (estimate_fn,
+        job_factory) are reconciled against reality instead."""
+        return {
+            "sid": sub.submission_id,
+            "job_id": sub.job_id,
+            "seq": sub.seq,
+            "priority": int(sub.priority),
+            "submitter": sub.submitter,
+            "workload": sub.workload,
+            "state": sub.state.value,
+            "attempts": sub.attempts,
+            "preemptions": sub.preemptions,
+            "submitted_at": sub.submitted_at,
+            "first_admitted_at": sub.first_admitted_at,
+            "finished_at": sub.finished_at,
+            "last_admitted_at": sub.last_admitted_at,
+            "last_skip_reason": sub.last_skip_reason,
+            "placement": list(sub.placement),
+            "admitted_gang": sub.admitted_gang,
+            "shrunk_mesh": dict(sub.shrunk_mesh) if sub.shrunk_mesh else None,
+            "trace_id": sub.trace_id,
+            "hbm_estimate": (
+                sub.estimate.model_dump(mode="json") if sub.estimate else None
+            ),
+            "config": sub.config.model_dump(mode="json"),
+        }
+
+    def snapshot_state(self) -> dict[str, Any]:
+        """Full serialized scheduler state — the ``scheduler`` section of a
+        journal snapshot. Deterministically ordered (seq), so
+        ``json.dumps(snapshot_state(), sort_keys=True)`` is a state
+        digest: restoring the same journal twice must yield byte-identical
+        digests (the ctl_crash lane's double-recovery gate)."""
+        with self._lock:
+            subs = sorted(self._subs.values(), key=lambda s: s.seq)
+            return {
+                "seq": self._seq,
+                "draining": self._draining,
+                "submissions": [self._serialize_sub(s) for s in subs],
+                "reserved": {
+                    str(i): round(v, 6)
+                    for i, v in sorted(self._reserved.items())
+                },
+                "quarantine": {
+                    str(i): dict(e)
+                    for i, e in sorted(self._hetero_quarantined.items())
+                },
+                "counters": {
+                    "submitted_total": self.submitted_total,
+                    "admitted_total": self.admitted_total,
+                    "requeues_total": self.requeues_total,
+                    "preemptions_total": self.preemptions_total,
+                    "completed_total": self.completed_total,
+                    "failed_total": self.failed_total,
+                    "cancelled_total": self.cancelled_total,
+                },
+            }
+
+    def restore(
+        self,
+        journal: journal_mod.ControlPlaneJournal,
+        live_jobs: Optional[dict[str, Any]] = None,
+        now: Optional[float] = None,
+    ) -> dict[str, Any]:
+        """Reconstruct a crashed scheduler from its journal, then reconcile
+        against live reality. Call on a FRESHLY constructed scheduler.
+
+        Phase 1 — deterministic rebuild: apply the newest snapshot's
+        scheduler section, then replay the ``sched.*`` event suffix onto
+        it (submit/admit/requeue/finish/cancelling/quarantine), and
+        materialize every submission via the real constructor with its
+        journaled identity (submission_id, job_id, seq, timestamps,
+        trace_id) restored.
+
+        Phase 2 — reconcile: a journaled-RUNNING submission whose job is
+        still alive (``live_jobs[submission_id]``) is **re-adopted** — its
+        HBM reservation re-entered, never re-launched; a vanished training
+        job is requeued at its original seq (the default job factory and
+        estimator serve the re-admission); a vanished serving replica is
+        marked failed with reason ``vanished_at_recovery`` (the fleet's
+        ``re_adopt`` re-dispatches a fresh one); a re-reservation that
+        oversubscribes a device's HBM capacity is a **double grant** — the
+        youngest claimant is demoted back to the queue and the device
+        quarantined with reason ``ctl_recovery:double_grant``.
+
+        Does not start the pump thread and does not write to the journal,
+        so restoring the same journal twice is byte-identical
+        (``snapshot_state()`` digests compare equal). Attaches the journal
+        for subsequent write-ahead; the caller should write a fresh
+        snapshot once recovery settles. Counters without journaled events
+        (preemptions, hetero) restore from the snapshot only — bounded
+        drift between snapshots, by design."""
+        now = time.time() if now is None else float(now)
+        doc = journal.read()
+        snap = doc.get("snapshot") or {}
+        base = (snap.get("sections") or {}).get("scheduler") or {}
+        entries: dict[str, dict] = {
+            e["sid"]: dict(e)
+            for e in base.get("submissions", [])
+            if isinstance(e, dict) and e.get("sid")
+        }
+        counters = {
+            "submitted_total": 0,
+            "admitted_total": 0,
+            "requeues_total": 0,
+            "preemptions_total": 0,
+            "completed_total": 0,
+            "failed_total": 0,
+            "cancelled_total": 0,
+        }
+        counters.update({
+            k: int(v) for k, v in (base.get("counters") or {}).items()
+            if k in counters
+        })
+        quarantine: dict[int, dict] = {}
+        for k, v in (base.get("quarantine") or {}).items():
+            try:
+                quarantine[int(k)] = dict(v)
+            except (TypeError, ValueError):
+                continue
+
+        replayed = 0
+        for ev in doc.get("events", []):
+            kind = ev.get("kind") or ""
+            p = ev.get("payload")
+            if not kind.startswith("sched.") or not isinstance(p, dict):
+                continue
+            replayed += 1
+            sid = p.get("sid")
+            if kind == "sched.submit" and sid:
+                entries[sid] = dict(p)
+                counters["submitted_total"] += 1
+            elif kind == "sched.admit" and sid in entries:
+                e = entries[sid]
+                e["state"] = "running"
+                e["placement"] = list(p.get("placement") or [])
+                for f in (
+                    "admitted_gang", "shrunk_mesh", "attempts",
+                    "first_admitted_at", "last_admitted_at",
+                ):
+                    if p.get(f) is not None:
+                        e[f] = p[f]
+                if p.get("hbm_estimate") is not None:
+                    e["hbm_estimate"] = p["hbm_estimate"]
+                counters["admitted_total"] += 1
+            elif kind == "sched.requeue" and sid in entries:
+                e = entries[sid]
+                e["state"] = "queued"
+                e["placement"] = []
+                e["preemptions"] = p.get("preemptions", e.get("preemptions", 0))
+                counters["requeues_total"] += 1
+            elif kind == "sched.cancelling" and sid in entries:
+                entries[sid]["state"] = "cancelling"
+            elif kind == "sched.finish" and sid in entries:
+                e = entries[sid]
+                e["state"] = p.get("state") or "failed"
+                e["finished_at"] = p.get("finished_at")
+                bucket = {
+                    "completed": "completed_total",
+                    "cancelled": "cancelled_total",
+                    "failed": "failed_total",
+                }.get(e["state"])
+                if bucket:
+                    counters[bucket] += 1
+            elif kind == "sched.quarantine" and p.get("device") is not None:
+                quarantine[int(p["device"])] = dict(p.get("entry") or {})
+            elif kind == "sched.quarantine_release":
+                quarantine.pop(int(p.get("device", -1)), None)
+
+        restored = readopted = requeued = vanished_failed = dgrants = 0
+        live_jobs = live_jobs or {}
+        with self._lock:
+            for c, v in counters.items():
+                setattr(self, c, v)
+            self._draining = bool(base.get("draining", False))
+            self._hetero_quarantined = quarantine
+            # device index → re-adopted claimants in seq order, for the
+            # double-grant audit below.
+            claims: dict[int, list[Submission]] = {}
+            for e in sorted(entries.values(), key=lambda d: d.get("seq", 0)):
+                try:
+                    config = TPUTrainConfig.model_validate(e["config"])
+                    sub = Submission(
+                        config,
+                        JobPriority(int(e.get("priority", JobPriority.NORMAL))),
+                        e.get("submitter", "anonymous"),
+                        int(e.get("seq", 0)),
+                        workload=e.get("workload", "training"),
+                    )
+                except Exception:
+                    log.warning(
+                        "restore: could not rebuild submission %s",
+                        e.get("sid"), exc_info=True,
+                    )
+                    continue
+                sub.submission_id = e["sid"]
+                sub.job_id = e.get("job_id") or sub.job_id
+                sub.submitted_at = e.get("submitted_at") or sub.submitted_at
+                sub.attempts = int(e.get("attempts") or 0)
+                sub.preemptions = int(e.get("preemptions") or 0)
+                sub.first_admitted_at = e.get("first_admitted_at")
+                sub.finished_at = e.get("finished_at")
+                sub.last_admitted_at = e.get("last_admitted_at")
+                sub.last_skip_reason = e.get("last_skip_reason")
+                sub.admitted_gang = e.get("admitted_gang")
+                sub.shrunk_mesh = e.get("shrunk_mesh")
+                sub.trace_id = e.get("trace_id") or sub.trace_id
+                if e.get("hbm_estimate"):
+                    try:
+                        sub.estimate = HBMEstimate.model_validate(
+                            e["hbm_estimate"]
+                        )
+                    except Exception:
+                        sub.estimate = None
+                try:
+                    state = SubmissionState(e.get("state", "queued"))
+                except ValueError:
+                    state = SubmissionState.QUEUED
+                sub.state = state
+                self._subs[sub.submission_id] = sub
+                self._by_job_id[sub.job_id] = sub
+                self._tenants.add(sub.submitter)
+                self._index_add(sub)
+                restored += 1
+                if state in TERMINAL_STATES:
+                    sub.finish_trace(state.value)
+                    continue
+                self._active_by_submitter[sub.submitter] = (
+                    self._active_by_submitter.get(sub.submitter, 0) + 1
+                )
+                if state == SubmissionState.QUEUED:
+                    continue
+                # RUNNING / PREEMPTING / CANCELLING: reconcile vs reality.
+                job = live_jobs.get(sub.submission_id)
+                if job is not None:
+                    # Orphan re-adoption: the work kept running through the
+                    # control-plane crash — take it back, never re-launch.
+                    sub.job = job
+                    sub.placement = [int(i) for i in e.get("placement") or []]
+                    if sub.estimate is not None:
+                        for idx in sub.placement:
+                            self._reserved[idx] = (
+                                self._reserved.get(idx, 0.0)
+                                + sub.estimate.device_total_gib
+                            )
+                            claims.setdefault(idx, []).append(sub)
+                    if state == SubmissionState.CANCELLING:
+                        stop = getattr(job, "_stop", None)
+                        if stop is not None:
+                            stop.set()
+                    readopted += 1
+                elif sub.workload == "training":
+                    # Vanished with the crash (same host, or killed while
+                    # unsupervised): requeue at its ORIGINAL seq — its
+                    # checkpoints resume it on re-admission.
+                    self._set_state(sub, SubmissionState.QUEUED)
+                    sub.job = None
+                    sub.placement = []
+                    sub.last_skip_reason = "requeued_at_recovery"
+                    self.requeues_total += 1
+                    requeued += 1
+                else:
+                    # A vanished serving replica has nothing to resume —
+                    # mark it failed; ServingFleet.re_adopt re-dispatches a
+                    # fresh replica to meet the journaled desired count.
+                    self._set_state(sub, SubmissionState.FAILED)
+                    sub.finished_at = now
+                    sub.last_skip_reason = "vanished_at_recovery"
+                    self.failed_total += 1
+                    vanished_failed += 1
+                    sub.finish_trace("failed")
+            # Double-grant audit: the journal can over-promise (an admit
+            # whose crash-interrupted release never journaled). Where the
+            # re-entered reservations oversubscribe a device's HBM
+            # capacity, the youngest claimant's grant is the bogus one:
+            # demote it to the queue and quarantine the device with a
+            # structured reason.
+            fleet = self._fleet()
+            if fleet is not None and fleet.devices:
+                cap = {
+                    d.index: d.hbm_total_gb
+                    for d in fleet.devices if d.hbm_total_gb > 0
+                }
+                for idx in sorted(claims):
+                    if idx not in cap:
+                        continue
+                    claimants = sorted(claims[idx], key=lambda s: s.seq)
+                    while (
+                        self._reserved.get(idx, 0.0) > cap[idx] + 1e-9
+                        and len(claimants) > 1
+                    ):
+                        victim = claimants.pop()
+                        if victim.state != SubmissionState.RUNNING and (
+                            victim.state != SubmissionState.CANCELLING
+                        ):
+                            continue
+                        self._release(victim)
+                        stop = getattr(victim.job, "_stop", None)
+                        if stop is not None:
+                            stop.set()
+                        victim.job = None
+                        self._set_state(victim, SubmissionState.QUEUED)
+                        victim.last_skip_reason = "double_grant_at_recovery"
+                        self.requeues_total += 1
+                        dgrants += 1
+                        self._hetero_quarantined[idx] = {
+                            "owner": victim.submission_id,
+                            "ts": now,
+                            "source": "ctl_recovery:double_grant",
+                        }
+                        tracing.get_recorder().event(
+                            "ctl_recovery_double_grant",
+                            kind="scheduler",
+                            trace_id=victim.trace_id,
+                            attrs={
+                                "device": idx,
+                                "submission_id": victim.submission_id,
+                                "reason": "ctl_recovery:double_grant",
+                            },
+                        )
+            self._seq = max(
+                int(base.get("seq", 0)),
+                max((s.seq for s in self._subs.values()), default=0),
+            )
+        journal_mod.note_recovery(
+            restores_total=1,
+            records_replayed_total=replayed,
+            jobs_readopted_total=readopted,
+            requeued_vanished_total=requeued,
+            double_grants_total=dgrants,
+        )
+        self._journal = journal
+        summary = {
+            "restored_submissions": restored,
+            "events_replayed": replayed,
+            "had_snapshot": bool(snap),
+            "readopted": readopted,
+            "requeued_vanished": requeued,
+            "serving_vanished": vanished_failed,
+            "double_grants": dgrants,
+            "ingest": doc.get("stats", {}),
+        }
+        log.info("scheduler: restored from journal — %s", summary)
+        return summary
 
     # -- internals (all hold self._lock) --------------------------------------
 
@@ -904,6 +1290,10 @@ class FleetScheduler:
                         "preemptions": sub.preemptions,
                     },
                 )
+                self._journal_event("sched.requeue", {
+                    "sid": sub.submission_id,
+                    "preemptions": sub.preemptions,
+                })
                 log.info(
                     "scheduler: %s preempted at step %s — requeued",
                     sub.submission_id, job.current_step,
@@ -932,6 +1322,11 @@ class FleetScheduler:
                     self._set_state(sub, SubmissionState.FAILED)
                     self.failed_total += 1
                 sub.finish_trace(sub.state.value)
+                self._journal_event("sched.finish", {
+                    "sid": sub.submission_id,
+                    "state": sub.state.value,
+                    "finished_at": sub.finished_at,
+                })
 
     def _note_skip(self, sub: Submission, reason: str) -> None:
         """Set the structured skip reason; a CHANGED reason is mirrored to
@@ -1346,6 +1741,18 @@ class FleetScheduler:
             waits.append(wait)
             del waits[:-200]
         self.admitted_total += 1
+        self._journal_event("sched.admit", {
+            "sid": sub.submission_id,
+            "placement": list(placement),
+            "admitted_gang": sub.admitted_gang,
+            "shrunk_mesh": sub.shrunk_mesh,
+            "attempts": sub.attempts,
+            "first_admitted_at": sub.first_admitted_at,
+            "last_admitted_at": sub.last_admitted_at,
+            "hbm_estimate": (
+                est.model_dump(mode="json") if est is not None else None
+            ),
+        })
         job.start()
         log.info(
             "scheduler: admitted %s (%s, priority %s, attempt %d, gang %d)",
@@ -1457,6 +1864,10 @@ class FleetScheduler:
                 del self._hetero_quarantined[idx]
                 released.setdefault(reason, []).append(idx)
         for reason, idxs in released.items():
+            for idx in sorted(idxs):
+                self._journal_event(
+                    "sched.quarantine_release", {"device": idx}
+                )
             tracing.get_recorder().event(
                 "hetero_quarantine_release",
                 kind="hetero",
@@ -1580,6 +1991,11 @@ class FleetScheduler:
                 self._hetero_quarantined[idx] = {
                     "owner": sub.submission_id, "ts": now,
                 }
+            for idx in sorted(shed):
+                self._journal_event("sched.quarantine", {
+                    "device": idx,
+                    "entry": {"owner": sub.submission_id, "ts": now},
+                })
             self.hetero_shrinks_total += 1
             self.preemptions_total += 1
             self._set_state(sub, SubmissionState.PREEMPTING)

@@ -50,6 +50,7 @@ from typing import Any, Callable, Iterable, Optional
 
 from pydantic import BaseModel, ConfigDict, Field
 
+from tpu_engine import journal as journal_mod
 from tpu_engine import tracing
 from tpu_engine.hbm_estimate import HBMEstimate, estimate_serving_hbm
 from tpu_engine.mesh_runtime import MeshConfig
@@ -708,6 +709,10 @@ class ServingFleet:
         latency_window: int = 512,
         fault_injector: Optional[Any] = None,
         prefix_plane: Optional[Any] = None,
+        journal: Optional[journal_mod.ControlPlaneJournal] = None,
+        replica_job_factory: Optional[
+            Callable[[Submission, ServingReplicaSpec], Any]
+        ] = None,
     ):
         self.scheduler = scheduler
         self.spec = spec
@@ -717,6 +722,14 @@ class ServingFleet:
         self.submitter = submitter
         self.engine_factory = engine_factory
         self.fault_injector = fault_injector
+        # Durable control plane: replica roster, desired count and held
+        # requests are written ahead to the journal; re_adopt() rebuilds a
+        # crashed fleet object around the replicas that kept serving.
+        self._journal = journal
+        # Replica job construction seam (the ctl_crash lane swaps in a
+        # thread-free virtual-clock job); default is the real thread-backed
+        # ServingReplicaJob.
+        self.replica_job_factory = replica_job_factory
         # Fleet prefix plane (tpu_engine/prefix_plane.py): the router takes
         # hints from it; dispatch below reports admissions back and spills
         # replica-cache overflow to its host tier via export_prefix.
@@ -771,6 +784,19 @@ class ServingFleet:
         if self._fleet_span.t1 is None:
             self._fleet_span.end(stopped=True)
 
+    def _journal_event(self, kind: str, payload: dict[str, Any]) -> None:
+        j = self._journal
+        if j is not None:
+            j.append(kind, payload)
+
+    def _make_replica_job(self, s: Submission) -> Any:
+        if self.replica_job_factory is not None:
+            return self.replica_job_factory(s, self.spec)
+        return ServingReplicaJob(
+            s, self.spec, engine_factory=self.engine_factory,
+            fault_injector=self.fault_injector,
+        )
+
     def _submit_replica(self) -> Submission:
         spec = self.spec
         sub = self.scheduler.submit(
@@ -779,12 +805,10 @@ class ServingFleet:
             submitter=self.submitter,
             workload="serving",
             estimate_fn=spec.estimate,
-            job_factory=lambda s: ServingReplicaJob(
-                s, spec, engine_factory=self.engine_factory,
-                fault_injector=self.fault_injector,
-            ),
+            job_factory=self._make_replica_job,
         )
         self._replicas[sub.submission_id] = sub
+        self._journal_event("fleet.replica", {"sid": sub.submission_id})
         tracing.get_recorder().event(
             "replica_submit",
             kind="serving",
@@ -822,6 +846,8 @@ class ServingFleet:
 
                 for victim in sorted(live, key=load)[: len(live) - n]:
                     self.scheduler.cancel(victim.submission_id)
+            if n != self.desired_replicas:
+                self._journal_event("fleet.desired", {"n": n})
             self.desired_replicas = n
         return n
 
@@ -841,6 +867,172 @@ class ServingFleet:
                 ):
                     out[sid] = job.engine
         return out
+
+    # -- durability: journal snapshot + crash recovery -----------------------
+
+    def snapshot_state(self) -> dict[str, Any]:
+        """Serialized fleet state — the ``serving`` section of a journal
+        snapshot. Deterministically ordered so the digest is comparable
+        across double recoveries."""
+        with self._lock:
+            return {
+                "desired_replicas": self.desired_replicas,
+                "req_seq": self._req_seq,
+                "replicas": sorted(self._replicas),
+                "requests": {
+                    fid: {
+                        "submitted_at": r["submitted_at"],
+                        "prompt": list(r["prompt"]),
+                        "max_new_tokens": r["max_new_tokens"],
+                        "temperature": r["temperature"],
+                        "done": bool(r["done"]),
+                    }
+                    for fid, r in sorted(self._requests.items())
+                },
+                "counters": {
+                    "requests_total": self.requests_total,
+                    "completed_total": self.completed_total,
+                    "tokens_total": self.tokens_total,
+                },
+            }
+
+    def re_adopt(
+        self, journal: journal_mod.ControlPlaneJournal, redispatch: bool = True
+    ) -> dict[str, Any]:
+        """Rebuild a crashed fleet object from its journal. Call on a
+        freshly constructed fleet whose scheduler already ran
+        ``restore(journal, ...)``.
+
+        Journaled replicas whose submissions survived in the restored
+        scheduler (re-adopted live jobs, or still queued) are taken back
+        into the roster; vanished ones (marked ``vanished_at_recovery``
+        by the scheduler) are replaced by re-dispatching fresh replicas
+        up to the journaled desired count (``redispatch=False`` skips
+        that — used when comparing double-recovery digests, since fresh
+        submissions mint fresh ids). Every held (journaled, not done)
+        request is re-created and re-queued for dispatch — no request
+        accepted before the crash is lost. ``tokens_total`` restores from
+        the snapshot only (per-token progress is not journaled)."""
+        doc = journal.read()
+        snap = doc.get("snapshot") or {}
+        base = (snap.get("sections") or {}).get("serving") or {}
+        desired = int(base.get("desired_replicas", 0))
+        req_seq = int(base.get("req_seq", 0))
+        roster = set(base.get("replicas", []))
+        requests: dict[str, dict] = {
+            fid: dict(r)
+            for fid, r in (base.get("requests") or {}).items()
+            if isinstance(r, dict)
+        }
+        counters = {
+            "requests_total": 0, "completed_total": 0, "tokens_total": 0,
+        }
+        counters.update({
+            k: int(v) for k, v in (base.get("counters") or {}).items()
+            if k in counters
+        })
+        for ev in doc.get("events", []):
+            kind = ev.get("kind") or ""
+            p = ev.get("payload")
+            if not kind.startswith("fleet.") or not isinstance(p, dict):
+                continue
+            if kind == "fleet.desired":
+                desired = int(p.get("n", desired))
+            elif kind == "fleet.replica" and p.get("sid"):
+                roster.add(p["sid"])
+            elif kind == "fleet.request" and p.get("fid"):
+                requests[p["fid"]] = {
+                    "submitted_at": p.get("submitted_at"),
+                    "prompt": list(p.get("prompt") or []),
+                    "max_new_tokens": int(p.get("max_new_tokens", 64)),
+                    "temperature": float(p.get("temperature", 0.0)),
+                    "done": False,
+                }
+                counters["requests_total"] += 1
+                try:
+                    req_seq = max(req_seq, int(p["fid"].rsplit("_", 1)[-1]))
+                except (ValueError, IndexError):
+                    pass
+            elif kind == "fleet.request_done" and p.get("fid") in requests:
+                requests[p["fid"]]["done"] = True
+                counters["completed_total"] += 1
+
+        readopted = 0
+        held: list[str] = []
+        with self._lock:
+            self._req_seq = max(self._req_seq, req_seq)
+            self.requests_total = counters["requests_total"]
+            self.completed_total = counters["completed_total"]
+            self.tokens_total = counters["tokens_total"]
+            for sid in sorted(roster):
+                sub = self.scheduler.get(sid)
+                if sub is None or sub.state in TERMINAL_STATES:
+                    continue  # vanished — replaced by the re-dispatch below
+                self._replicas[sid] = sub
+                readopted += 1
+            # Re-create every held request, oldest first (fid order), with
+            # a fresh trace span — the original span died with the crash.
+            rec = tracing.get_recorder()
+            def _fid_key(fid: str) -> tuple:
+                try:
+                    return (0, int(fid.rsplit("_", 1)[-1]))
+                except (ValueError, IndexError):
+                    return (1, fid)
+            for fid in sorted(requests, key=_fid_key):
+                r = requests[fid]
+                if r.get("done"):
+                    continue
+                span = rec.start_span(
+                    f"request:{fid}",
+                    kind="serving_request",
+                    attrs={
+                        "fleet_trace_id": self.trace_id,
+                        "prompt_tokens": len(r["prompt"]),
+                        "max_new_tokens": int(r["max_new_tokens"]),
+                        "recovered": True,
+                    },
+                )
+                req = {
+                    "submitted_at": r.get("submitted_at") or time.time(),
+                    "prompt": list(r["prompt"]),
+                    "max_new_tokens": int(r["max_new_tokens"]),
+                    "temperature": float(r.get("temperature", 0.0)),
+                    "replica": None,
+                    "engine_rid": None,
+                    "done": False,
+                    "trace_id": span.trace_id,
+                    "_span": span,
+                }
+                self._requests[fid] = req
+                self._pending.append((fid, req))
+                held.append(fid)
+            self.desired_replicas = 0
+        # Attach before re-dispatching so the replacement replicas are
+        # themselves written ahead — they must survive a second crash.
+        self._journal = journal
+        redispatched = 0
+        if redispatch and desired > 0:
+            before = len(self._replicas)
+            self.scale_to(desired)
+            redispatched = len(self._replicas) - before
+        else:
+            with self._lock:
+                self.desired_replicas = desired
+        journal_mod.note_recovery(
+            replicas_readopted_total=readopted,
+            replicas_redispatched_total=redispatched,
+            requests_recovered_total=len(held),
+        )
+        summary = {
+            "desired_replicas": desired,
+            "replicas_readopted": readopted,
+            "replicas_redispatched": redispatched,
+            "requests_recovered": len(held),
+            "held_fids": held,
+            "ingest": doc.get("stats", {}),
+        }
+        log.info("serving fleet: re-adopted from journal — %s", summary)
+        return summary
 
     # -- request plane -------------------------------------------------------
 
@@ -881,6 +1073,13 @@ class ServingFleet:
                 "enqueue", kind="serving", trace_id=span.trace_id, parent=span,
                 attrs={"fid": fid},
             )
+            self._journal_event("fleet.request", {
+                "fid": fid,
+                "prompt": list(prompt),
+                "max_new_tokens": int(max_new_tokens),
+                "temperature": float(temperature),
+                "submitted_at": self._requests[fid]["submitted_at"],
+            })
             self._pending.append((fid, self._requests[fid]))
             self._flush_pending()
             return fid
@@ -1014,6 +1213,7 @@ class ServingFleet:
             if out.get("status") in ("done", "failed") and not req["done"]:
                 req["done"] = True
                 self.completed_total += 1
+                self._journal_event("fleet.request_done", {"fid": fid})
                 n_new = len(out.get("tokens", []) or [])
                 self.tokens_total += n_new
                 latency_ms = (time.time() - req["submitted_at"]) * 1000.0

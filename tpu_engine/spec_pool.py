@@ -301,6 +301,34 @@ class SpecSpillController:
             },
         }
 
+    # -- durability (control-plane journal snapshot section) -----------------
+
+    def export_state(self) -> Dict[str, Any]:
+        """Serialized guard state (spilled set, streaks, cooldown clocks)
+        for the control-plane journal; restored via :meth:`load_state` so
+        a restarted controller keeps its hysteresis instead of re-spilling
+        every tenant from scratch."""
+        return {
+            "spilled": self.spilled(),
+            "streak": {t: int(n) for t, n in sorted(self._streak.items())},
+            "last_fired": {
+                t: float(ts) for t, ts in sorted(self._last_fired.items())
+            },
+        }
+
+    def load_state(self, state: Dict[str, Any]) -> None:
+        """Inverse of :meth:`export_state`; tolerant of missing keys."""
+        if not isinstance(state, dict):
+            return
+        self._spilled = {str(t) for t in state.get("spilled") or []}
+        self._streak = {
+            str(t): int(n) for t, n in (state.get("streak") or {}).items()
+        }
+        self._last_fired = {
+            str(t): float(ts)
+            for t, ts in (state.get("last_fired") or {}).items()
+        }
+
 
 # ---------------------------------------------------------------------------
 # The paired fleet

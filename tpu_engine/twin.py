@@ -115,6 +115,10 @@ __all__ = [
     "reshard_migration_report",
     "reshard_ab",
     "reshard_bench_line",
+    "CtlCrashLaneParams",
+    "ctl_crash_lane",
+    "ctl_crash_ab",
+    "ctl_crash_bench_line",
     "twin_stats",
 ]
 
@@ -3840,6 +3844,543 @@ def spec_pool_bench_line(seed: int = 0, ab: Optional[dict] = None) -> dict:
         "legs": pool["legs"],
         "draft_plan_label": res["draft_plan_label"],
         "spec_replica_gib": res["spec_replica_gib"],
+        "gates": res["gates"],
+        "ok": res["ok"],
+    }
+
+
+# -- durable control plane: crash / restore / re-adoption lane -----------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CtlCrashLaneParams:
+    """One control-plane crash scenario: a storm of training submissions,
+    chaos preemptions and serving traffic, with the scheduler/fleet host
+    killed mid-storm (``crash_at_poll``) and rebuilt from its write-ahead
+    journal. The no-crash run of the SAME workload, measured from the
+    same poll, is the MTTR reference the 1.5× budget gates against."""
+
+    n_train_jobs: int = 24
+    n_requests: int = 36
+    n_replicas: int = 2
+    max_concurrent: int = 8
+    submit_chunk: int = 6
+    requests_per_poll: int = 3
+    poll_dt_s: float = 2.0
+    snapshot_every_polls: int = 8
+    n_chaos_faults: int = 8
+    crash_at_poll: int = 10
+    job_base_s: float = 20.0
+    job_spread_s: float = 6.0
+    job_spread_mod: int = 7
+    # Offered decode load (requests_per_poll × tokens_per_request) runs
+    # ~2× the fleet's per-poll token capacity, so a standing backlog of
+    # held/pending requests exists at the kill point — the crash must
+    # catch requests in every phase: done, in-flight, and still queued.
+    tokens_per_request: int = 40
+    engine_tokens_per_poll: int = 32
+    replica_slots: int = 8
+    mttr_budget_ratio: float = 1.5
+
+
+class _CtlTrainJob(_ScaleJob):
+    """:class:`_ScaleJob` plus the chaos seam the storm needs: a running
+    attempt can be preempted (the scheduler then requeues it at its
+    original seq) or simply vanish with the crashed control-plane host."""
+
+    __slots__ = ()
+
+    def preempt(self, reason: str = "chaos-storm") -> None:
+        if self.status == self._st.RUNNING:
+            self.status = self._st.PREEMPTED
+            self.preemption_reason = reason
+            self.current_step = max(
+                0, int(self._sim_s - max(self._done_at - self._clock(), 0.0))
+            )
+
+
+class _CtlLaneEngine:
+    """Slot-model decode engine for the crash lane: each control poll
+    grants it a token budget, spread round-robin over active requests —
+    deterministic, thread-free, and it survives its control plane (the
+    whole point: the data plane keeps decoding while the brain is dead)."""
+
+    def __init__(self, slots: int):
+        self.slots = int(slots)
+        self._reqs: Dict[int, dict] = {}
+        self._seq = 0
+
+    def submit(self, prompt: Any, max_new_tokens: int = 64,
+               temperature: float = 0.0) -> int:
+        self._seq += 1
+        self._reqs[self._seq] = {"need": int(max_new_tokens), "tokens": []}
+        return self._seq
+
+    def step(self, budget: int) -> None:
+        active = [r for r in self._reqs.values()
+                  if len(r["tokens"]) < r["need"]]
+        while budget > 0 and active:
+            for r in list(active):
+                if budget <= 0:
+                    break
+                r["tokens"].append(1)
+                budget -= 1
+                if len(r["tokens"]) >= r["need"]:
+                    active.remove(r)
+
+    def result(self, rid: int) -> dict:
+        r = self._reqs[rid]  # KeyError IS the fleet's redispatch signal
+        done = len(r["tokens"]) >= r["need"]
+        return {"status": "done" if done else "running",
+                "tokens": list(r["tokens"])}
+
+    def stats(self) -> dict:
+        active = sum(1 for r in self._reqs.values()
+                     if len(r["tokens"]) < r["need"])
+        return {"slots": self.slots, "active_slots": active, "prefilling": 0,
+                "queued": 0, "queued_handoffs": 0,
+                "tokens_per_sec_recent": 100.0}
+
+
+class _CtlReplicaJob:
+    """Thread-free serving replica job: the engine is built synchronously
+    and ready the moment the scheduler admits the replica."""
+
+    __slots__ = ("_st", "status", "engine", "engine_ready", "current_step",
+                 "watcher", "preemption_reason", "_stop")
+
+    def __init__(self, slots: int, status_enum):
+        self._st = status_enum
+        self.status = status_enum.PENDING
+        self.engine = _CtlLaneEngine(slots)
+        self.engine_ready = threading.Event()
+        self.current_step = 0
+        self.watcher = None
+        self.preemption_reason = None
+        self._stop = threading.Event()
+
+    def start(self) -> None:
+        self.status = self._st.RUNNING
+        self.engine_ready.set()
+
+    @property
+    def is_alive(self) -> bool:
+        if self.status == self._st.RUNNING and self._stop.is_set():
+            self.status = self._st.STOPPED
+        return self.status in (self._st.PENDING, self._st.RUNNING)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        return None
+
+    def describe(self) -> dict:
+        return {"status": getattr(self.status, "value", str(self.status)),
+                "step": self.current_step}
+
+
+def ctl_crash_lane(
+    seed: int,
+    crash: bool,
+    params: CtlCrashLaneParams = CtlCrashLaneParams(),
+) -> dict:
+    """One seeded storm through the REAL control plane — FleetScheduler +
+    ServingFleet journaling every state change to a
+    :class:`~tpu_engine.journal.ControlPlaneJournal` — with chaos
+    preemptions drawn from ``FaultPlan.random(seed)`` and, when ``crash``
+    is set, a ``FaultKind.CONTROLPLANE_CRASH`` consumed mid-storm via the
+    injector seam.
+
+    The crash drops the scheduler and fleet objects on the floor (no
+    shutdown — the host died), leaves a torn half-written line on the
+    live journal file, and lets live reality diverge from the journal:
+    every third running training job and the first serving replica die
+    with the host, the rest keep running orphaned. Recovery builds fresh
+    objects and runs ``restore`` + ``re_adopt`` against a fresh journal
+    handle — twice, from the same bytes, to prove the rebuild is
+    byte-identical (``snapshot_state`` digests) — then drives the storm
+    to completion. MTTR is virtual-clock time from the kill to the last
+    journaled obligation (every training job completed, every accepted
+    request answered)."""
+    import gc
+
+    from tpu_engine import goodput as goodput_mod
+    from tpu_engine import journal as journal_mod
+    from tpu_engine import tracing as tracing_mod
+    from tpu_engine.mesh_runtime import MeshConfig
+    from tpu_engine.scheduler import FleetScheduler, JobPriority, SubmissionState
+    from tpu_engine.serving_fleet import (
+        AutoscalerConfig,
+        ReplicaAutoscaler,
+        ServingFleet,
+        ServingReplicaSpec,
+    )
+    from tpu_engine.sharding import TPUTrainConfig
+    from tpu_engine.supervisor import JobStatus
+
+    p = params
+    vclock = VirtualClock(0.0)
+    rec = FlightRecorder(
+        max_spans=4096, max_events=8192, clock=vclock,
+        id_factory=deterministic_ids("ctlcrash"),
+    )
+    hist = historian_mod.MetricHistorian(clock=vclock)
+    ledger = GoodputLedger(clock=vclock, max_tracked=4096)
+
+    old_rec = tracing_mod.get_recorder()
+    old_hist = historian_mod.get_historian()
+    old_ledger = goodput_mod.get_ledger()
+    tracing_mod.set_recorder(rec)
+    historian_mod.set_historian(hist)
+    goodput_mod.set_ledger(ledger)
+    gc_was_enabled = gc.isenabled()
+    gc.disable()
+    tmp = tempfile.TemporaryDirectory(prefix="ctl_crash_")
+    try:
+        journal = journal_mod.ControlPlaneJournal(
+            os.path.join(tmp.name, "ctl_journal.jsonl"), clock=vclock
+        )
+
+        cfg = TPUTrainConfig(
+            model_name="gpt-tiny", mesh=MeshConfig(data=1, fsdp=1),
+            micro_batch_size=1, seq_len=32, precision="fp32",
+            total_steps=5, activation_checkpointing=False,
+        )
+        jcount = iter(range(1 << 30))
+
+        def make_train_job(sub) -> _CtlTrainJob:
+            n = next(jcount)
+            return _CtlTrainJob(
+                vclock, p.job_base_s + p.job_spread_s * (n % p.job_spread_mod),
+                JobStatus,
+            )
+
+        def new_sched() -> FleetScheduler:
+            s = FleetScheduler(
+                max_concurrent_jobs=p.max_concurrent,
+                backfill_depth=p.max_concurrent,
+                job_factory=make_train_job,
+                poll_interval_s=3600.0,
+                grow_back=False,
+                hetero_rebalance=False,
+                max_finished_history=4096,
+            )
+            s._ensure_thread = lambda: None  # the lane owns the poll cadence
+            return s
+
+        spec = ServingReplicaSpec(
+            model_name="gpt-tiny", max_slots=p.replica_slots, max_len=128
+        )
+
+        def replica_job_factory(sub, spec_) -> _CtlReplicaJob:
+            return _CtlReplicaJob(spec_.max_slots, JobStatus)
+
+        def new_fleet(s, j) -> ServingFleet:
+            return ServingFleet(
+                s, spec,
+                autoscaler=ReplicaAutoscaler(AutoscalerConfig(
+                    min_replicas=1, max_replicas=max(4, p.n_replicas),
+                )),
+                replica_job_factory=replica_job_factory,
+                journal=j,
+            )
+
+        sched = new_sched()
+        sched.attach_journal(journal)
+        fleet = new_fleet(sched, journal)
+        fleet.scale_to(p.n_replicas)
+
+        # Chaos storm: the SEEDED random plan picks the preemption polls;
+        # the crash itself is an explicit spec consumed through the
+        # injector seam (never part of random draws — see faults.py).
+        storm = FaultPlan.random(
+            seed, n_faults=p.n_chaos_faults, max_step=4 * p.crash_at_poll
+        )
+        storm_polls = sorted(
+            s.at_step for s in storm.specs if s.at_step is not None
+        )
+        injector = FaultInjector(FaultPlan(seed=seed, specs=(
+            [FaultSpec(kind=FaultKind.CONTROLPLANE_CRASH,
+                       at_step=p.crash_at_poll)] if crash else []
+        )))
+
+        train_sids: List[str] = []
+        fids: List[str] = []
+        done_fids: set = set()
+        submitted = 0
+        polls = storms = 0
+        crashed = False
+        t_crash: Optional[float] = None
+        recovery: Optional[dict] = None
+        readopt: Optional[dict] = None
+        double_identical = False
+        held_recovered: List[str] = []
+        t_done: Optional[float] = None
+        max_polls = 400 + 40 * p.n_train_jobs
+
+        def _train_done() -> int:
+            return sum(
+                1 for sid in train_sids
+                if (s := sched.get(sid)) is not None
+                and s.state == SubmissionState.COMPLETED
+            )
+
+        while True:
+            # -- offered load ------------------------------------------------
+            if submitted < p.n_train_jobs:
+                k = min(p.submit_chunk, p.n_train_jobs - submitted)
+                for _ in range(k):
+                    sub = sched.submit(
+                        cfg, priority=JobPriority.NORMAL,
+                        submitter=f"team-{submitted % 4}",
+                    )
+                    train_sids.append(sub.submission_id)
+                    submitted += 1
+            if len(fids) < p.n_requests:
+                for _ in range(min(p.requests_per_poll,
+                                   p.n_requests - len(fids))):
+                    prompt = [(seed * 131 + len(fids) * 17 + k) % 5003
+                              for k in range(16)]
+                    fids.append(fleet.submit_request(
+                        prompt, max_new_tokens=p.tokens_per_request,
+                    ))
+            # -- chaos preemptions (the storm) -------------------------------
+            while storm_polls and storm_polls[0] <= polls:
+                storm_polls.pop(0)
+                storms += 1
+                for sid in train_sids:
+                    s = sched.get(sid)
+                    if (
+                        s is not None
+                        and s.state == SubmissionState.RUNNING
+                        and isinstance(s.job, _CtlTrainJob)
+                    ):
+                        s.job.preempt()
+                        break
+            # -- one control pass --------------------------------------------
+            sched.poll()
+            for eng in fleet.running_replicas().values():
+                eng.step(p.engine_tokens_per_poll)
+            for fid in fids:
+                if fid in done_fids:
+                    continue
+                if fleet.result(fid).get("status") == "done":
+                    done_fids.add(fid)
+            polls += 1
+            if polls % p.snapshot_every_polls == 0:
+                journal.snapshot(
+                    journal_mod.collect_sections(scheduler=sched,
+                                                 serving=fleet),
+                    ts=vclock.now(),
+                )
+            # -- the kill point ----------------------------------------------
+            if crash and not crashed and injector.take_controlplane_crash(polls):
+                crashed = True
+                t_crash = vclock.now()
+                # Live reality at the moment of death: every third running
+                # training job and the first replica die WITH the host;
+                # everything else keeps running orphaned.
+                live_jobs: Dict[str, Any] = {}
+                nth_train = 0
+                replica_vanished = False
+                for s in sorted(sched._subs.values(), key=lambda x: x.seq):
+                    if s.state not in (
+                        SubmissionState.RUNNING, SubmissionState.CANCELLING
+                    ) or s.job is None:
+                        continue
+                    if s.workload == "training":
+                        nth_train += 1
+                        if nth_train % 3 == 0:
+                            continue  # died with the host
+                    elif not replica_vanished:
+                        replica_vanished = True
+                        continue  # this replica's host died too
+                    live_jobs[s.submission_id] = s.job
+                # The crash lands mid-append: a torn half-line on the live
+                # file that ingestion must skip, not raise on.
+                with open(journal.path, "a", encoding="utf-8") as f:
+                    f.write('{"record":"event","kind":"sched.su')
+                # The old process is gone — no shutdown, no cleanup.
+                journal2 = journal_mod.ControlPlaneJournal(
+                    journal.path, clock=vclock
+                )
+                journal_mod.set_active_journal(journal2)
+                sched2 = new_sched()
+                recovery = sched2.restore(
+                    journal2, live_jobs=live_jobs, now=vclock.now()
+                )
+                digest1 = json.dumps(sched2.snapshot_state(), sort_keys=True)
+                # Double recovery from the same bytes must be byte-identical.
+                sched3 = new_sched()
+                sched3.restore(journal2, live_jobs=live_jobs,
+                               now=vclock.now())
+                digest2 = json.dumps(sched3.snapshot_state(), sort_keys=True)
+                fleet3 = new_fleet(sched3, None)
+                r3 = fleet3.re_adopt(journal2, redispatch=False)
+                # Now the real recovery: re-adopt + re-dispatch the
+                # vanished replica, then a fresh settling snapshot.
+                fleet2 = new_fleet(sched2, None)
+                readopt = fleet2.re_adopt(journal2)
+                double_identical = (
+                    digest1 == digest2
+                    and readopt["held_fids"] == r3["held_fids"]
+                    and readopt["replicas_readopted"]
+                    == r3["replicas_readopted"]
+                )
+                held_recovered = list(readopt["held_fids"])
+                sched, fleet, journal = sched2, fleet2, journal2
+                journal.snapshot(
+                    journal_mod.collect_sections(scheduler=sched,
+                                                 serving=fleet),
+                    ts=vclock.now(),
+                )
+            if not crash and t_crash is None and polls >= p.crash_at_poll:
+                # The no-crash reference clocks its "MTTR" from the same
+                # poll the crash run dies at.
+                t_crash = vclock.now()
+            # -- done? -------------------------------------------------------
+            if (
+                submitted >= p.n_train_jobs
+                and len(fids) >= p.n_requests
+                and _train_done() >= p.n_train_jobs
+                and len(done_fids) >= p.n_requests
+            ):
+                t_done = vclock.now()
+                break
+            vclock.advance(p.poll_dt_s)
+            if polls > max_polls:
+                raise RuntimeError(
+                    f"ctl_crash lane wedged: {_train_done()}/{p.n_train_jobs} "
+                    f"jobs, {len(done_fids)}/{p.n_requests} requests "
+                    f"after {polls} polls"
+                )
+
+        mttr_s = round(t_done - (t_crash if t_crash is not None else 0.0), 3)
+        if crash:
+            journal_mod.note_mttr(mttr_s)
+        out = {
+            "crash": crash,
+            "polls": polls,
+            "storm_preemptions": storms,
+            "sim_s": round(vclock.now(), 3),
+            "t_crash": t_crash,
+            "mttr_s": mttr_s,
+            "train_submitted": submitted,
+            "train_completed": _train_done(),
+            "train_subs_final": sum(
+                1 for sid in train_sids if sched.get(sid) is not None
+            ),
+            "requests_total": len(fids),
+            "requests_completed": len(done_fids),
+            "journal": journal.stats(),
+        }
+        if crash:
+            held_done = sum(1 for fid in held_recovered if fid in done_fids)
+            out.update({
+                "recovery": recovery,
+                "re_adopt": {
+                    k: v for k, v in (readopt or {}).items() if k != "ingest"
+                },
+                "double_recovery_identical": double_identical,
+                "held_recovered": len(held_recovered),
+                "held_done": held_done,
+                "ingest": (recovery or {}).get("ingest", {}),
+            })
+        return out
+    finally:
+        journal_mod.clear_active_journal()
+        tmp.cleanup()
+        if gc_was_enabled:
+            gc.enable()
+        tracing_mod.set_recorder(old_rec)
+        historian_mod.set_historian(old_hist)
+        goodput_mod.set_ledger(old_ledger)
+
+
+def ctl_crash_ab(
+    seed: int = 0,
+    params: CtlCrashLaneParams = CtlCrashLaneParams(),
+) -> dict:
+    """The durable-control-plane exit gate: the same seeded storm with and
+    without a mid-storm control-plane kill. Gates: nothing the dead
+    process had accepted is lost or duplicated, every held serving
+    request completes, orphans are re-adopted (never re-launched), the
+    vanished replica is re-dispatched, double recovery from the same
+    journal bytes is byte-identical, the torn tail is skipped not raised,
+    and crash-recovery MTTR stays within ``mttr_budget_ratio`` of the
+    no-crash reference."""
+    base = ctl_crash_lane(seed, crash=False, params=params)
+    cr = ctl_crash_lane(seed, crash=True, params=params)
+
+    budget = round(params.mttr_budget_ratio * base["mttr_s"], 3)
+    ratio = round(cr["mttr_s"] / max(base["mttr_s"], 1e-9), 3)
+    ingest = cr.get("ingest", {})
+    gates = {
+        "zero_lost_submissions": (
+            cr["train_completed"] == params.n_train_jobs
+        ),
+        "zero_duplicated_submissions": (
+            cr["train_subs_final"] == params.n_train_jobs
+            and cr["train_submitted"] == params.n_train_jobs
+        ),
+        "held_requests_complete": (
+            cr["held_recovered"] > 0
+            and cr["held_done"] == cr["held_recovered"]
+            and cr["requests_completed"] == params.n_requests
+        ),
+        "orphans_readopted": (
+            (cr.get("recovery") or {}).get("readopted", 0) > 0
+        ),
+        "vanished_training_requeued": (
+            (cr.get("recovery") or {}).get("requeued_vanished", 0) >= 1
+        ),
+        "vanished_replica_redispatched": (
+            (cr.get("re_adopt") or {}).get("replicas_redispatched", 0) >= 1
+        ),
+        "no_phantom_double_grants": (
+            (cr.get("recovery") or {}).get("double_grants", 0) == 0
+        ),
+        "double_recovery_identical": bool(cr.get("double_recovery_identical")),
+        "torn_tail_skipped_not_raised": (
+            (ingest.get("skipped_by_reason") or {}).get("torn_tail", 0) == 1
+        ),
+        "mttr_within_budget": cr["mttr_s"] <= budget,
+    }
+    return {
+        "baseline": base,
+        "crashed": cr,
+        "mttr_ratio": ratio,
+        "mttr_budget_s": budget,
+        "gates": gates,
+        "ok": all(gates.values()),
+    }
+
+
+def ctl_crash_bench_line(seed: int = 0, ab: Optional[dict] = None) -> dict:
+    """The durable control plane's deterministic bench line, shared by
+    ``bench.py`` and ``tools/bench_sentinel.py``. The gated value is the
+    crash-recovery / no-crash MTTR ratio on the seeded storm — the exit
+    criterion is that killing and restoring the control plane mid-storm
+    costs at most 1.5× the no-crash completion time, with zero lost or
+    duplicated submissions and every held request answered."""
+    res = ab if ab is not None else ctl_crash_ab(seed=seed)
+    cr = res["crashed"]
+    return {
+        "metric": "ctl_crash",
+        "value": res["mttr_ratio"],
+        "unit": "crash-recovery / no-crash MTTR ratio",
+        "crash_mttr_s": cr["mttr_s"],
+        "baseline_mttr_s": res["baseline"]["mttr_s"],
+        "mttr_budget_s": res["mttr_budget_s"],
+        "train_completed": cr["train_completed"],
+        "requests_completed": cr["requests_completed"],
+        "held_recovered": cr["held_recovered"],
+        "jobs_readopted": (cr.get("recovery") or {}).get("readopted", 0),
+        "requeued_vanished": (
+            (cr.get("recovery") or {}).get("requeued_vanished", 0)),
+        "replicas_redispatched": (
+            (cr.get("re_adopt") or {}).get("replicas_redispatched", 0)),
+        "double_grants": (cr.get("recovery") or {}).get("double_grants", 0),
+        "journal_appends": cr["journal"]["appends_total"],
+        "journal_snapshots": cr["journal"]["snapshots_total"],
         "gates": res["gates"],
         "ok": res["ok"],
     }
